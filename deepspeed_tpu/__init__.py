@@ -1,0 +1,37 @@
+"""deepspeed_tpu: a TPU-native training & inference framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capability surface of the
+reference DeepSpeed (Snowflake-Labs/DeepSpeed): engine + JSON config, ZeRO
+1/2/3-equivalent sharding, mixed precision, pipeline/tensor/expert/sequence
+parallelism, checkpointing, kernels, inference, and observability — designed
+for SPMD over a named device mesh rather than ported from the reference's
+CUDA/hook architecture.
+
+Top-level API parity (reference ``deepspeed/__init__.py``):
+  initialize()        -> (engine, optimizer, dataloader, lr_scheduler)
+  init_inference()    -> InferenceEngine   (see deepspeed_tpu/inference)
+"""
+
+from deepspeed_tpu.version import __version__
+from deepspeed_tpu.config import DeepSpeedTPUConfig
+from deepspeed_tpu.topology import build_mesh, get_mesh, set_mesh
+
+__git_hash__ = None
+__git_branch__ = None
+
+
+def initialize(*args, **kwargs):
+    """Create a training engine (reference ``deepspeed.initialize`` __init__.py:69).
+
+    Lazy import so that ``import deepspeed_tpu`` stays cheap.
+    """
+    from deepspeed_tpu.runtime.engine_builder import initialize as _initialize
+
+    return _initialize(*args, **kwargs)
+
+
+def init_inference(*args, **kwargs):
+    """Create an inference engine (reference ``deepspeed.init_inference`` __init__.py:291)."""
+    from deepspeed_tpu.inference.engine import init_inference as _init_inference
+
+    return _init_inference(*args, **kwargs)

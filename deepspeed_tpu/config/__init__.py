@@ -1,0 +1,12 @@
+from deepspeed_tpu.config.config import (
+    DeepSpeedTPUConfig,
+    EngineConfig,
+    FP16Config,
+    BF16Config,
+    ZeroConfig,
+    OffloadConfig,
+    MeshConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+)
+from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel

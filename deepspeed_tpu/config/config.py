@@ -1,0 +1,378 @@
+"""The framework config: JSON/dict -> typed config tree.
+
+TPU-native analog of the reference's ``deepspeed/runtime/config.py``
+(``DeepSpeedConfig`` :708). Accepts the same JSON surface where it makes sense
+on TPU (batch triad, optimizer, scheduler, fp16/bf16, zero_optimization,
+gradient_clipping, monitors, flops profiler, activation checkpointing), plus a
+TPU-specific ``mesh`` section declaring named parallelism axes
+(dp/fsdp/tp/sp/ep/pp) in place of the reference's implicit world-size plumbing.
+
+Batch triad arithmetic (reference ``runtime/config.py:983``):
+``train_batch_size = micro_batch_per_device * gradient_accumulation_steps * dp_world``
+where ``dp_world`` is the product of the data-like mesh axes (dp * fsdp).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from pydantic import Field
+
+from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.utils.logging import logger
+
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+
+class FP16Config(DeepSpeedConfigModel):
+    """fp16 section (reference ``runtime/fp16/loss_scaler.py`` semantics)."""
+
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+    @property
+    def dynamic(self) -> bool:
+        return self.loss_scale == 0.0
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    # fp32 gradient accumulation across microbatches (reference bf16_optimizer
+    # immediate_grad_update analog — on TPU this picks the accum dtype).
+    accumulate_grads_in_fp32: bool = True
+
+
+class OffloadDeviceEnum:
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class OffloadConfig(DeepSpeedConfigModel):
+    """offload_optimizer / offload_param sections (reference ``zero/offload_config.py``)."""
+
+    device: str = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0
+    max_in_cpu: int = 1_000_000_000
+
+
+class ZeroConfig(DeepSpeedConfigModel):
+    """zero_optimization section (reference ``runtime/zero/config.py:86``).
+
+    On TPU, stages map to sharding placements of one jitted program:
+      stage 0: params+grads+opt replicated (plain DP, psum grads)
+      stage 1: optimizer state sharded over data axes
+      stage 2: + gradients reduce-scattered / accumulated sharded
+      stage 3: + parameters sharded over the ``fsdp`` mesh axis
+    """
+
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: bool = True  # XLA latency-hiding scheduler does this; kept for schema parity
+    offload_param: Optional[OffloadConfig] = None
+    offload_optimizer: Optional[OffloadConfig] = None
+    sub_group_size: int = 1_000_000_000
+    # stage-3 partitioning knobs
+    param_persistence_threshold: int = 100_000  # params smaller than this stay replicated
+    model_persistence_threshold: int = 9_223_372_036_854_775_807
+    max_live_parameters: int = 1_000_000_000
+    max_reuse_distance: int = 1_000_000_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    # ZeRO++ analogs (quantized collectives)
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    zero_hpz_partition_size: int = 1
+    # MiCS analog: shard params over a sub-group of the fsdp axis, replicate across groups
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+    round_robin_gradients: bool = False
+    ignore_unused_parameters: bool = True
+    log_trace_cache_warnings: bool = False
+
+    @property
+    def offload_optimizer_device(self) -> str:
+        return self.offload_optimizer.device if self.offload_optimizer else OffloadDeviceEnum.none
+
+    @property
+    def offload_param_device(self) -> str:
+        return self.offload_param.device if self.offload_param else OffloadDeviceEnum.none
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = "AdamW"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class MeshConfig(DeepSpeedConfigModel):
+    """TPU-specific: named parallelism axes over the device mesh.
+
+    Replaces the reference's process-group plumbing (``utils/groups.py``,
+    ``runtime/pipe/topology.py``). Sizes of -1 mean "absorb remaining devices".
+    Axis order here is the physical layout order (outermost first): pp rides
+    DCN when multi-slice; tp is innermost for fastest ICI.
+    """
+
+    pp: int = 1
+    dp: int = -1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+    # multi-slice: number of slices connected over DCN (1 = single slice)
+    num_slices: int = 1
+    dcn_axis: str = "dp"  # which axis spans DCN in multi-slice deployments
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """reference ``runtime/activation_checkpointing/config``; on TPU this maps
+    to jax.checkpoint (remat) policies applied per layer."""
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False  # maps to XLA host-memory offload of residuals
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU extension: jax.checkpoint policy name (see runtime/activation_checkpointing.py)
+    policy: str = "full"
+
+
+class TensorboardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+
+
+class WandbConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class PipelineConfig(DeepSpeedConfigModel):
+    stages: Union[int, str] = "auto"
+    partition: str = "parameters"
+    seed_layers: bool = False
+    activation_checkpoint_interval: int = 0
+    use_reentrant: bool = True
+
+
+class GradientCompressionConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    bits: int = 1  # 1-bit Adam analog via sign+error-feedback compression
+
+
+class DataEfficiencyConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = Field(default_factory=dict)
+    data_routing: Dict[str, Any] = Field(default_factory=dict)
+
+
+class EngineConfig(DeepSpeedConfigModel):
+    """Top-level typed config (reference ``DeepSpeedConfig`` runtime/config.py:708)."""
+
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    memory_breakdown: bool = False
+    dump_state: bool = False
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    gradient_clipping: float = 0.0
+    sparse_gradients: bool = False
+    disable_allgather: bool = False
+
+    seed: int = 1234
+
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
+    mesh: MeshConfig = Field(default_factory=MeshConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig
+    )
+    tensorboard: TensorboardConfig = Field(default_factory=TensorboardConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+    data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
+    gradient_compression: GradientCompressionConfig = Field(default_factory=GradientCompressionConfig)
+
+    # Inference / misc sections accepted for schema parity
+    communication_data_type: Optional[str] = None
+    checkpoint: Dict[str, Any] = Field(default_factory=dict)
+    elasticity: Dict[str, Any] = Field(default_factory=dict)
+    autotuning: Dict[str, Any] = Field(default_factory=dict)
+    compression_training: Dict[str, Any] = Field(default_factory=dict)
+
+
+class DeepSpeedTPUConfig:
+    """Parsed + resolved config. The runtime-facing object.
+
+    Resolves the batch-size triad against the mesh's data-parallel world size
+    exactly as the reference does (``runtime/config.py:938-1045``).
+    """
+
+    def __init__(self, config: Union[str, Dict[str, Any], None] = None, dp_world_size: Optional[int] = None):
+        if config is None:
+            config = {}
+        if isinstance(config, str):
+            with open(config, "r") as f:
+                config = json.load(f)
+        if not isinstance(config, dict):
+            raise ValueError(f"Expected a dict or a path to a JSON file, got {type(config)}")
+        self.raw: Dict[str, Any] = dict(config)
+        self.model = EngineConfig(**config)
+        self._dp_world_size = dp_world_size
+        self._resolve_batch_triad()
+
+    # -- batch triad -------------------------------------------------------
+    def _resolve_batch_triad(self) -> None:
+        m = self.model
+        train = m.train_batch_size
+        micro = m.train_micro_batch_size_per_gpu
+        gas = m.gradient_accumulation_steps
+        dp = self._dp_world_size or 1
+
+        if train is not None and micro is not None and gas is not None:
+            if train != micro * gas * dp:
+                raise ValueError(
+                    f"Inconsistent batch config: train_batch_size={train} != "
+                    f"micro_batch({micro}) * gas({gas}) * dp_world({dp})"
+                )
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp)
+            if train % (micro * dp) != 0 or gas == 0:
+                raise ValueError(
+                    f"train_batch_size={train} not divisible by micro_batch({micro}) * dp_world({dp})"
+                )
+        elif train is not None and gas is not None:
+            micro = train // (gas * dp)
+            if train % (gas * dp) != 0 or micro == 0:
+                raise ValueError(
+                    f"train_batch_size={train} not divisible by gas({gas}) * dp_world({dp})"
+                )
+        elif micro is not None:
+            gas = gas or 1
+            train = micro * gas * dp
+        elif train is not None:
+            micro = train // dp
+            gas = 1
+            if train % dp != 0 or micro == 0:
+                raise ValueError(f"train_batch_size={train} not divisible by dp_world({dp})")
+        else:
+            # only gas given (or nothing): micro defaults to 1
+            micro = 1
+            gas = gas or 1
+            train = micro * gas * dp
+
+        m.train_batch_size = train
+        m.train_micro_batch_size_per_gpu = micro
+        m.gradient_accumulation_steps = gas
+
+    # -- convenience accessors --------------------------------------------
+    @property
+    def train_batch_size(self) -> int:
+        return self.model.train_batch_size
+
+    @property
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self.model.train_micro_batch_size_per_gpu
+
+    @property
+    def gradient_accumulation_steps(self) -> int:
+        return self.model.gradient_accumulation_steps
+
+    @property
+    def zero_config(self) -> ZeroConfig:
+        return self.model.zero_optimization
+
+    @property
+    def zero_enabled(self) -> bool:
+        return self.model.zero_optimization.stage > 0
+
+    @property
+    def fp16_enabled(self) -> bool:
+        return self.model.fp16.enabled
+
+    @property
+    def bf16_enabled(self) -> bool:
+        return self.model.bf16.enabled
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.model.bf16.enabled:
+            return jnp.bfloat16
+        if self.model.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    @property
+    def gradient_clipping(self) -> float:
+        return self.model.gradient_clipping
+
+    @property
+    def mesh_config(self) -> MeshConfig:
+        return self.model.mesh
+
+    def print_config(self, name: str = "DeepSpeedTPUConfig") -> None:
+        logger.info(f"{name}:\n{json.dumps(self.model.model_dump(), indent=2, default=str)}")

@@ -1,0 +1,69 @@
+"""Rank-aware logging utilities.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py`` (``logger``,
+``log_dist``): a process-level logger plus rank-filtered helpers. On TPU the
+"rank" is the JAX process index (one process per host), so ``log_dist`` filters
+on ``jax.process_index()`` instead of torch.distributed rank.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setFormatter(logging.Formatter(LOG_FORMAT))
+    lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger(
+    level=getattr(logging, os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper(), logging.INFO)
+)
+
+
+def _process_index() -> int:
+    """Current host-process index (0 when JAX is uninitialized/single-process)."""
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - jax always importable in this env
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (default: rank 0 only).
+
+    ``ranks=[-1]`` (or None entry) means log on every process. Mirrors the
+    reference API ``deepspeed/utils/logging.py:log_dist``.
+    """
+    my_rank = _process_index()
+    ranks = list(ranks) if ranks is not None else [0]
+    if -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message: str) -> None:
+    if _process_index() == 0:
+        print(message, flush=True)
+
+
+def warning_once(message: str) -> None:
+    _warn_once(message)
+
+
+@functools.lru_cache(None)
+def _warn_once(message: str) -> None:
+    logger.warning(message)

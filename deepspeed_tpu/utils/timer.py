@@ -1,0 +1,170 @@
+"""Wall-clock and throughput timers.
+
+TPU-native analog of the reference's ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer`` :44, ``ThroughputTimer`` :199). Synchronization
+uses ``jax.block_until_ready`` on a token instead of accelerator events: JAX
+dispatch is async, so a timer stop must drain the device queue to be meaningful.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _sync() -> None:
+    """Drain async dispatch so host wall-clock brackets device work."""
+    try:
+        import jax
+
+        (jax.device_put(0.0) + 0).block_until_ready()
+    except Exception:  # pragma: no cover
+        pass
+
+
+class Timer:
+    """A single named wall-clock timer with accumulation."""
+
+    def __init__(self, name: str, synchronize: bool = True):
+        self.name = name
+        self.synchronize = synchronize
+        self.started = False
+        self._start_time = 0.0
+        self._elapsed = 0.0
+        self._record: List[float] = []
+
+    def start(self) -> None:
+        if self.started:
+            return
+        if self.synchronize:
+            _sync()
+        self._start_time = time.time()
+        self.started = True
+
+    def stop(self, record: bool = True) -> None:
+        if not self.started:
+            return
+        if self.synchronize:
+            _sync()
+        span = time.time() - self._start_time
+        self._elapsed += span
+        if record:
+            self._record.append(span)
+        self.started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        """Total accumulated seconds; optionally reset."""
+        now = time.time()
+        value = self._elapsed
+        if self.started:
+            value += now - self._start_time
+        if reset:
+            self._elapsed = 0.0
+            if self.started:
+                self._start_time = now  # don't re-count the span just reported
+        return value
+
+    def mean(self) -> float:
+        return sum(self._record) / len(self._record) if self._record else 0.0
+
+    def reset(self) -> None:
+        self.started = False
+        self._elapsed = 0.0
+        self._record = []
+
+
+class SynchronizedWallClockTimer:
+    """Group of named timers (reference ``utils/timer.py:44``)."""
+
+    def __init__(self, synchronize: bool = True):
+        self.timers: Dict[str, Timer] = {}
+        self.synchronize = synchronize
+
+    def __call__(self, name: str) -> Timer:
+        if name not in self.timers:
+            self.timers[name] = Timer(name, synchronize=self.synchronize)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True) -> str:
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        msg = "time (ms) | " + " | ".join(parts)
+        log_dist(msg, ranks=[0])
+        return msg
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPs reporting (reference ``utils/timer.py:199``)."""
+
+    def __init__(
+        self,
+        batch_size: int,
+        steps_per_output: int = 100,
+        monitor_memory: bool = False,
+        logging_fn=None,
+    ):
+        self.batch_size = max(1, batch_size)
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
+        self.started = False
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self._start_time = 0.0
+        self._initialized = False
+
+    def update_epoch_count(self) -> None:
+        self._initialized = False
+
+    def start(self) -> None:
+        self.started = True
+        if not self._initialized:
+            self._initialized = True
+        _sync()
+        self._start_time = time.time()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
+        if not self.started:
+            return
+        self.started = False
+        _sync()
+        duration = time.time() - self._start_time
+        self.total_elapsed_time += duration
+        self.step_elapsed_time += duration
+        if global_step:
+            self.global_step_count += 1
+            if report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"epoch step rate: {self.avg_samples_per_sec():.2f} samples/sec, "
+                    f"step time {self.step_elapsed_time / self.steps_per_output * 1000:.1f} ms"
+                )
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > 0 and self.total_elapsed_time > 0:
+            return self.global_step_count * self.batch_size / self.total_elapsed_time
+        return 0.0
+
+
+def trainable_parameters_numel(params) -> int:
+    """Total element count of a parameter pytree."""
+    import jax
+
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,46 @@
+"""Test harness: run every test on a virtual 8-device CPU mesh.
+
+TPU-native analog of the reference's distributed-in-one-box harness
+(``tests/unit/common.py`` — DistributedTest spawning N processes): JAX SPMD
+needs no process-per-rank, so we instead force the host CPU platform to expose
+8 virtual devices and run real multi-device sharding/collectives in-process.
+
+Note: the sandbox's sitecustomize registers an experimental TPU PJRT plugin
+("axon") at interpreter startup and pins JAX_PLATFORMS to it; initializing it
+alongside the forced-CPU config deadlocks. jax may already be imported by the
+time this conftest runs, so we force the platform via jax.config and drop the
+plugin's backend factory instead of relying on env vars alone.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge
+
+    xla_bridge._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(autouse=True)
+def _clear_mesh_state():
+    yield
+    from deepspeed_tpu.topology import mesh as mesh_mod
+
+    mesh_mod._ACTIVE_MESH = None

@@ -1,0 +1,98 @@
+"""Mesh/topology tests on the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.config import MeshConfig
+from deepspeed_tpu.topology import (
+    MESH_AXES,
+    ProcessTopology,
+    batch_pspec,
+    build_mesh,
+    get_data_parallel_world_size,
+    get_world_size,
+    mesh_context,
+    resolve_axis_sizes,
+    topology_from_mesh,
+)
+
+
+def test_resolve_axis_sizes_wildcard():
+    sizes = resolve_axis_sizes({"dp": -1, "tp": 2}, 8)
+    assert sizes["dp"] == 4 and sizes["tp"] == 2
+    assert np.prod([sizes[a] for a in MESH_AXES]) == 8
+
+
+def test_resolve_axis_sizes_exact():
+    sizes = resolve_axis_sizes({"dp": 2, "fsdp": 4}, 8)
+    assert sizes["dp"] == 2 and sizes["fsdp"] == 4
+
+
+def test_resolve_axis_sizes_errors():
+    with pytest.raises(ValueError):
+        resolve_axis_sizes({"dp": -1, "tp": -1}, 8)
+    with pytest.raises(ValueError):
+        resolve_axis_sizes({"dp": 3}, 8)
+    with pytest.raises(ValueError):
+        resolve_axis_sizes({"dp": -1, "tp": 3}, 8)
+
+
+def test_build_mesh_default(devices):
+    mesh = build_mesh()
+    assert mesh.size == 8
+    assert mesh.shape["dp"] == 8
+    assert mesh.axis_names == MESH_AXES
+
+
+def test_build_mesh_from_config(devices):
+    mesh = build_mesh(MeshConfig(dp=-1, fsdp=2, tp=2))
+    assert mesh.shape["fsdp"] == 2
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["dp"] == 2
+
+
+def test_world_size_helpers(devices):
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, sp=2, tp=1))
+    with mesh_context(mesh):
+        assert get_world_size() == 8
+        assert get_data_parallel_world_size() == 4  # dp * fsdp
+
+
+def test_batch_pspec(devices):
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, sp=2))
+    with mesh_context(mesh):
+        spec = batch_pspec()
+        assert spec == PartitionSpec(("dp", "fsdp"), "sp")
+    mesh2 = build_mesh(MeshConfig(dp=-1))
+    with mesh_context(mesh2):
+        assert batch_pspec() == PartitionSpec(("dp",))
+
+
+def test_sharded_array_roundtrip(devices):
+    """A batch sharded over the mesh reassembles to the original array."""
+    mesh = build_mesh(MeshConfig(dp=4, fsdp=2))
+    x = jnp.arange(16 * 4, dtype=jnp.float32).reshape(16, 4)
+    sharded = jax.device_put(x, NamedSharding(mesh, PartitionSpec(("dp", "fsdp"))))
+    np.testing.assert_array_equal(np.asarray(sharded), np.asarray(x))
+    # psum over data axes equals global sum
+    total = jax.jit(lambda a: a.sum())(sharded)
+    assert float(total) == float(x.sum())
+
+
+def test_process_topology_roundtrip():
+    topo = ProcessTopology(["pp", "dp", "tp"], [2, 2, 2])
+    assert topo.world_size == 8
+    for rank in range(8):
+        assert topo.get_rank(**topo.get_coord(rank)) == rank
+    assert topo.filter_match(pp=0) == [0, 1, 2, 3]
+    assert topo.get_dim("dp") == 2
+
+
+def test_topology_from_mesh(devices):
+    mesh = build_mesh(MeshConfig(dp=4, tp=2))
+    topo = topology_from_mesh(mesh)
+    assert topo.world_size == 8
+    assert topo.get_dim("tp") == 2

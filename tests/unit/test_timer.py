@@ -1,0 +1,35 @@
+"""Timer regression tests."""
+
+import time
+
+from deepspeed_tpu.utils.timer import Timer, SynchronizedWallClockTimer, ThroughputTimer
+
+
+def test_timer_elapsed_reset_while_running_does_not_double_count():
+    t = Timer("t", synchronize=False)
+    t.start()
+    time.sleep(0.03)
+    first = t.elapsed(reset=True)
+    time.sleep(0.03)
+    t.stop()
+    second = t.elapsed(reset=True)
+    assert first >= 0.025
+    # second interval must not include the first
+    assert second < first + 0.03
+
+
+def test_wallclock_group_and_log():
+    timers = SynchronizedWallClockTimer(synchronize=False)
+    timers("fwd").start()
+    timers("fwd").stop()
+    msg = timers.log(["fwd", "missing"])
+    assert "fwd" in msg
+
+
+def test_throughput_timer():
+    tt = ThroughputTimer(batch_size=4, steps_per_output=1000)
+    for _ in range(3):
+        tt.start()
+        tt.stop()
+    assert tt.global_step_count == 3
+    assert tt.avg_samples_per_sec() > 0
