@@ -1,0 +1,109 @@
+"""Checkpoint save/load on Orbax.
+
+TPU-native analog of the reference checkpoint path
+(``runtime/engine.py:3274 save_checkpoint`` / ``:2928 load_checkpoint`` and the
+``CheckpointEngine`` ABC ``runtime/checkpoint_engine/checkpoint_engine.py:9``).
+Layout parity: ``<dir>/<tag>/`` per checkpoint plus a ``latest`` file naming
+the newest tag. Orbax stores sharding metadata, so a checkpoint written on one
+mesh restores onto another (the "universal checkpoint" reshape the reference
+needs an offline tool for — ``checkpoint/ds_to_universal.py`` — comes free).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+LATEST_FILE = "latest"
+
+
+def _tag(step: int) -> str:
+    return f"global_step{step}"
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[Dict] = None, save_latest: bool = True) -> str:
+    tag = tag or _tag(engine.global_steps)
+    path = os.path.abspath(os.path.join(save_dir, tag))
+    os.makedirs(save_dir, exist_ok=True)
+
+    state = engine.state
+    payload = {
+        "step": state.step,
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "loss_scale": state.loss_scale._asdict(),
+        "rng": state.rng,
+    }
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, payload, force=True)
+
+    meta = {
+        "client_state": client_state or {},
+        "mesh_shape": {k: int(v) for k, v in dict(engine.mesh.shape).items()},
+        "zero_stage": engine.zero_config.stage,
+        "version": 1,
+    }
+    with open(os.path.join(save_dir, f"{tag}.meta.json"), "w") as f:
+        json.dump(meta, f)
+    if save_latest:
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+            f.write(tag)
+    log_dist(f"saved checkpoint {path}", ranks=[0])
+    return path
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True) -> Tuple[Optional[str], Dict]:
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.exists(latest):
+            logger.warning(f"no '{LATEST_FILE}' file in {load_dir}; nothing loaded")
+            return None, {}
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = os.path.abspath(os.path.join(load_dir, tag))
+    if not os.path.isdir(path):
+        logger.warning(f"checkpoint {path} not found; nothing loaded")
+        return None, {}
+
+    state = engine.state
+    target = {
+        "step": state.step,
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "loss_scale": state.loss_scale._asdict(),
+        "rng": state.rng,
+    }
+    restore_args = jax.tree_util.tree_map(
+        lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding) if isinstance(x, jax.Array) else ocp.RestoreArgs(),
+        target,
+    )
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(path, item=target, restore_args=restore_args)
+
+    from deepspeed_tpu.runtime.engine import TrainState
+    from deepspeed_tpu.runtime.precision import LossScaleState
+
+    engine.state = TrainState(
+        step=restored["step"],
+        params=restored["params"],
+        opt_state=restored["opt_state"] if load_optimizer_states else state.opt_state,
+        loss_scale=LossScaleState(**restored["loss_scale"]),
+        rng=restored["rng"],
+    )
+
+    client_state: Dict[str, Any] = {}
+    meta_path = os.path.join(load_dir, f"{tag}.meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            client_state = json.load(f).get("client_state", {})
+    log_dist(f"loaded checkpoint {path} (step {int(restored['step'])})", ranks=[0])
+    return path, client_state

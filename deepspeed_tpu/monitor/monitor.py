@@ -1,0 +1,109 @@
+"""Experiment monitoring fan-out.
+
+TPU-native analog of ``deepspeed/monitor/monitor.py:30 MonitorMaster`` with the
+TensorBoard/W&B/CSV backends (per-backend files in ``deepspeed/monitor/``).
+Comet is not available in this environment and is gated off.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class _Writer:
+    def write_scalars(self, step: int, scalars: Dict[str, float]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class CSVWriter(_Writer):
+    """reference ``monitor/csv_monitor.py``: one CSV per metric name."""
+
+    def __init__(self, output_path: str, job_name: str = "job"):
+        self.dir = os.path.join(output_path or "csv_monitor", job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._files = {}
+
+    def write_scalars(self, step: int, scalars: Dict[str, float]) -> None:
+        for name, value in scalars.items():
+            fname = os.path.join(self.dir, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, float(value)])
+
+
+class TensorBoardWriter(_Writer):
+    def __init__(self, output_path: str, job_name: str = "job"):
+        from torch.utils.tensorboard import SummaryWriter  # torch-cpu is baked in
+
+        self.writer = SummaryWriter(log_dir=os.path.join(output_path or "tb_logs", job_name))
+
+    def write_scalars(self, step: int, scalars: Dict[str, float]) -> None:
+        for name, value in scalars.items():
+            self.writer.add_scalar(name, float(value), step)
+
+    def flush(self) -> None:
+        self.writer.flush()
+
+    def close(self) -> None:
+        self.writer.close()
+
+
+class WandbWriter(_Writer):
+    def __init__(self, project: str, group: Optional[str] = None, team: Optional[str] = None):
+        import wandb
+
+        wandb.init(project=project, group=group, entity=team)
+        self._wandb = wandb
+
+    def write_scalars(self, step: int, scalars: Dict[str, float]) -> None:
+        self._wandb.log(dict(scalars), step=step)
+
+
+class MonitorMaster:
+    """Fan-out writer (reference ``monitor/monitor.py:30``)."""
+
+    def __init__(self, engine_config):
+        self.writers: List[_Writer] = []
+        if engine_config.csv_monitor.enabled:
+            self.writers.append(
+                CSVWriter(engine_config.csv_monitor.output_path, engine_config.csv_monitor.job_name)
+            )
+        if engine_config.tensorboard.enabled:
+            try:
+                self.writers.append(
+                    TensorBoardWriter(engine_config.tensorboard.output_path, engine_config.tensorboard.job_name)
+                )
+            except Exception as e:
+                logger.warning(f"tensorboard writer unavailable: {e}")
+        if engine_config.wandb.enabled:
+            try:
+                self.writers.append(
+                    WandbWriter(engine_config.wandb.project, engine_config.wandb.group, engine_config.wandb.team)
+                )
+            except Exception as e:
+                logger.warning(f"wandb writer unavailable: {e}")
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.writers)
+
+    def write_scalars(self, step: int, scalars: Dict[str, float]) -> None:
+        for w in self.writers:
+            w.write_scalars(step, scalars)
+
+    def flush(self) -> None:
+        for w in self.writers:
+            w.flush()

@@ -1,0 +1,98 @@
+"""Pipeline-parallel model description.
+
+TPU-native analog of ``deepspeed/runtime/pipe/module.py`` (``LayerSpec`` :30,
+``TiedLayerSpec`` :77, ``PipelineModule`` :86). A model is declared as an
+ordered list of layer specs; the pipeline engine partitions them into stages
+over the ``pp`` mesh axis. Execution (1F1B) lives in pipeline_engine.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class LayerSpec:
+    """Deferred layer constructor (reference ``pipe/module.py:30``)."""
+
+    def __init__(self, typename: Callable, *args, **kwargs):
+        self.typename = typename
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.args, **self.kwargs)
+
+    def __repr__(self) -> str:
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose parameters are shared across stages (reference :77)."""
+
+    def __init__(self, key: str, typename: Callable, *args, forward_fn=None, tied_weight_attr="embedding", **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Balanced contiguous partition bounds (len = num_parts + 1)."""
+    bounds = [0]
+    for p in range(num_parts):
+        bounds.append(bounds[-1] + num_items // num_parts + (1 if p < num_items % num_parts else 0))
+    return bounds
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Weight-balanced contiguous partition via prefix-sum bisection
+    (the reference's ``ds_utils.partition_balanced`` strategy)."""
+    prefix = np.concatenate([[0.0], np.cumsum(np.asarray(weights, dtype=np.float64))])
+    total = prefix[-1]
+    bounds = [0]
+    for p in range(1, num_parts):
+        target = total * p / num_parts
+        idx = int(np.searchsorted(prefix, target))
+        idx = max(bounds[-1] + 1, min(idx, len(weights) - (num_parts - p)))
+        bounds.append(idx)
+    bounds.append(len(weights))
+    return bounds
+
+
+class PipelineModule:
+    """Ordered layer-spec model for pipeline execution (reference :86).
+
+    ``layers`` is a list of LayerSpec / callables / Flax modules. Each layer's
+    ``__call__(carry, train=...)`` maps the activation pytree through; the
+    first layer receives the batch.
+    """
+
+    def __init__(
+        self,
+        layers: Sequence[Any],
+        num_stages: Optional[int] = None,
+        loss_fn: Optional[Callable] = None,
+        partition_method: str = "uniform",
+        activation_checkpoint_interval: int = 0,
+        seed_layers: bool = False,
+    ):
+        self.layer_specs = [l if isinstance(l, LayerSpec) else LayerSpec(lambda l=l: l) for l in layers]
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed_layers = seed_layers
+
+    def __len__(self) -> int:
+        return len(self.layer_specs)
+
+    def partition_layers(self, num_stages: int, weights: Optional[Sequence[float]] = None) -> List[int]:
+        """Stage bounds (reference ``_partition_layers`` pipe/module.py:393)."""
+        method = self.partition_method.lower()
+        if method == "uniform" or weights is None:
+            return partition_uniform(len(self.layer_specs), num_stages)
+        if method in ("parameters", "balanced"):
+            return partition_balanced(weights, num_stages)
+        raise ValueError(f"Unknown partition_method {self.partition_method!r}")

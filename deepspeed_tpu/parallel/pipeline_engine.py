@@ -1,0 +1,77 @@
+"""Pipeline-parallel training engine.
+
+TPU-native analog of ``deepspeed/runtime/pipe/engine.py`` (``PipelineEngine``
+:61). The reference interprets a 1F1B instruction schedule with torch p2p
+sends; on TPU the plan is a compiled microbatch loop over the ``pp`` mesh axis
+(collective_permute between stage neighbors inside one jitted program).
+
+Current state: with ``pp == 1`` the PipelineModule executes as a plain layer
+chain through the standard engine (sequential composition + loss_fn), which is
+the reference's degenerate single-stage path. The multi-stage 1F1B schedule is
+implemented in ``parallel/pipe_schedule.py`` (see TrainSchedule) and wired here
+as it lands.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from deepspeed_tpu.runtime.engine import DeepSpeedTPUEngine
+from deepspeed_tpu.runtime.model import ModelSpec
+from deepspeed_tpu.parallel.pipeline import PipelineModule
+
+
+def _spec_from_pipeline_module(module: PipelineModule) -> ModelSpec:
+    """Sequentially compose layer specs into one ModelSpec (pp=1 path)."""
+    layers = [spec.build() for spec in module.layer_specs]
+    loss_fn = module.loss_fn
+
+    def init_fn(rng):
+        params = []
+        carry_shape = None
+        for i, layer in enumerate(layers):
+            layer_rng = jax.random.fold_in(rng, i)
+            if hasattr(layer, "init"):
+                raise ValueError(
+                    "Flax modules inside PipelineModule need explicit example "
+                    "activations; use LayerSpec with pure (init, apply) pairs "
+                    "or pass model_parameters to initialize()"
+                )
+            params.append(None)
+        return params
+
+    def loss(params, batch, rng):
+        h = batch
+        for i, layer in enumerate(layers):
+            h = layer(h) if params[i] is None else layer(params[i], h)
+        if loss_fn is not None:
+            if isinstance(batch, dict) and "labels" in batch:
+                return loss_fn(h, batch["labels"])
+            return loss_fn(h, batch)
+        return h
+
+    return ModelSpec(init_fn=init_fn, loss_fn=loss, name="pipeline")
+
+
+class PipelineEngine(DeepSpeedTPUEngine):
+    """Engine for PipelineModule models (reference ``pipe/engine.py:61``)."""
+
+    def __init__(self, module: PipelineModule, config, mesh=None, **kwargs):
+        import deepspeed_tpu.topology.mesh as mesh_mod
+
+        self.pipeline_module = module
+        pp = mesh.shape["pp"] if mesh is not None else getattr(config.mesh_config, "pp", 1)
+        if pp > 1:
+            raise NotImplementedError(
+                "multi-stage pipeline execution (pp > 1) is under construction: "
+                "the 1F1B schedule lives in parallel/pipe_schedule.py and is not "
+                "yet wired into a compiled stage loop. Use pp=1 (layer chaining) "
+                "or shard via dp/fsdp/tp/sp for now."
+            )
+        spec = _spec_from_pipeline_module(module)
+        super().__init__(model=spec, config=config, mesh=mesh, **kwargs)
+
+    def train_batch(self, batch: Any = None, data_iter: Optional[Any] = None):
+        return super().train_batch(batch=batch, data_iter=data_iter)

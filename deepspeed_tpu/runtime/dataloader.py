@@ -1,0 +1,105 @@
+"""Data loading: distributed sampling + repeating loader.
+
+TPU-native analog of ``deepspeed/runtime/dataloader.py`` (``DeepSpeedDataLoader``
+:17, ``RepeatingLoader`` :41). In SPMD JAX there is no per-rank sampler: every
+host feeds its local slice of a *globally consistent* batch order. This loader
+produces global micro-batches (leading dim = micro_batch * dp_world) from an
+indexable dataset with a seeded per-epoch shuffle, matching the reference's
+``DistributedSampler`` semantics when restricted to one host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterable to restart on StopIteration (reference :41)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedTPUDataLoader:
+    """Batches an indexable dataset into global micro-batches.
+
+    ``dataset`` may be: a dict/pytree of equal-length numpy arrays, a sequence
+    of samples (each a pytree), or anything with ``__len__``/``__getitem__``.
+    """
+
+    def __init__(
+        self,
+        dataset: Any,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        collate_fn=None,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn
+        self.epoch = 0
+        self._arrays = self._as_arrays(dataset)
+        n = self._length()
+        self.num_batches = n // batch_size if drop_last else -(-n // batch_size)
+
+    @staticmethod
+    def _as_arrays(dataset) -> Optional[Any]:
+        """If the dataset is a pytree of arrays (columnar), keep it as such."""
+        if isinstance(dataset, dict):
+            return {k: np.asarray(v) for k, v in dataset.items()}
+        if isinstance(dataset, np.ndarray):
+            return dataset
+        return None
+
+    def _length(self) -> int:
+        if isinstance(self._arrays, dict):
+            return len(next(iter(self._arrays.values())))
+        if self._arrays is not None:
+            return len(self._arrays)
+        return len(self.dataset)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return self.num_batches
+
+    def __iter__(self) -> Iterator[Any]:
+        n = self._length()
+        order = np.arange(n)
+        if self.shuffle:
+            order = np.random.default_rng(self.seed + self.epoch).permutation(n)
+        for b in range(self.num_batches):
+            idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+            if self._arrays is not None:
+                if isinstance(self._arrays, dict):
+                    yield {k: v[idx] for k, v in self._arrays.items()}
+                else:
+                    yield self._arrays[idx]
+            else:
+                samples = [self.dataset[int(i)] for i in idx]
+                if self.collate_fn is not None:
+                    yield self.collate_fn(samples)
+                elif isinstance(samples[0], dict):
+                    yield {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+                else:
+                    yield np.stack(samples)
+        self.epoch += 1

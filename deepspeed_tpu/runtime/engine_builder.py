@@ -1,0 +1,91 @@
+"""deepspeed_tpu.initialize() — the front door.
+
+API parity with the reference ``deepspeed.initialize`` (``deepspeed/__init__.py:69``):
+returns ``(engine, optimizer, training_dataloader, lr_scheduler)``. Dispatch to
+the pipeline engine happens here when the model is a PipelineModule (reference
+:209), mirroring the reference's selection logic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from deepspeed_tpu.config.config import DeepSpeedTPUConfig
+from deepspeed_tpu.runtime.engine import DeepSpeedTPUEngine
+from deepspeed_tpu.runtime.model import ModelSpec, as_model_spec
+from deepspeed_tpu.topology.mesh import build_mesh, get_data_parallel_world_size
+from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.version import __version__
+
+
+def initialize(
+    args: Any = None,
+    model: Any = None,
+    optimizer: Any = None,
+    model_parameters: Any = None,
+    training_data: Any = None,
+    lr_scheduler: Any = None,
+    mesh: Any = None,
+    dist_init_required: Optional[bool] = None,
+    config: Any = None,
+    config_params: Any = None,
+    example_batch: Any = None,
+    seed: Optional[int] = None,
+) -> Tuple[DeepSpeedTPUEngine, Any, Any, Any]:
+    """Create the training engine.
+
+    model: ModelSpec, Flax module (with example_batch), or PipelineModule.
+    optimizer: optional optax GradientTransformation (else from config).
+    config: dict or path to JSON (``config_params`` accepted for parity).
+    """
+    log_dist(f"deepspeed_tpu {__version__} initialize", ranks=[0])
+    if model is None:
+        raise ValueError("model is required")
+    if config is None:
+        config = config_params
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None)
+    if config is None:
+        raise ValueError("config (dict or JSON path) is required")
+
+    cfg = DeepSpeedTPUConfig(config)
+    mesh = mesh if mesh is not None else build_mesh(cfg.mesh_config)
+    cfg = DeepSpeedTPUConfig(cfg.raw, dp_world_size=get_data_parallel_world_size(mesh))
+
+    # Pipeline dispatch (reference __init__.py:209)
+    from deepspeed_tpu.parallel.pipeline import PipelineModule  # local import: avoid cycle
+
+    if isinstance(model, PipelineModule):
+        from deepspeed_tpu.parallel.pipeline_engine import PipelineEngine
+
+        engine = PipelineEngine(
+            module=model,
+            config=cfg,
+            mesh=mesh,
+            optimizer=optimizer,
+            lr_scheduler=lr_scheduler,
+            model_parameters=model_parameters,
+            training_data=training_data,
+            seed=seed,
+        )
+    else:
+        spec = as_model_spec(model, example_batch=example_batch)
+        engine = DeepSpeedTPUEngine(
+            model=spec,
+            config=cfg,
+            mesh=mesh,
+            optimizer=optimizer,
+            lr_scheduler=lr_scheduler,
+            model_parameters=model_parameters,
+            training_data=training_data,
+            seed=seed,
+        )
+
+    # Monitoring (reference engine.py:268 MonitorMaster)
+    mc = cfg.model
+    if mc.tensorboard.enabled or mc.csv_monitor.enabled or mc.wandb.enabled:
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+        engine.monitor = MonitorMaster(mc)
+
+    return engine, getattr(engine, "tx", optimizer), engine.training_dataloader, lr_scheduler

@@ -1,0 +1,78 @@
+"""Model abstraction handed to the engine.
+
+The reference wraps a ``torch.nn.Module``; the TPU engine wraps a *pure
+function pair* (init, loss). A Flax linen module whose ``__call__`` returns a
+scalar loss (or ``(loss, aux)``) adapts directly — this matches the reference
+convention where the client model's forward returns the loss
+(``runtime/engine.py:2041`` forward → client module).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+
+
+LossFn = Callable[[Any, Any, jax.Array], Any]  # (params, batch, rng) -> loss | (loss, aux)
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """Pure-function model contract.
+
+    init_fn(rng) -> params pytree
+    loss_fn(params, batch, rng) -> scalar loss, or (loss, aux pytree)
+    apply_fn(params, batch) -> model outputs (inference forward; optional)
+    """
+
+    init_fn: Callable[[jax.Array], Any]
+    loss_fn: LossFn
+    apply_fn: Optional[Callable[[Any, Any], Any]] = None
+    name: str = "model"
+
+    @classmethod
+    def from_flax(
+        cls,
+        module,
+        example_batch: Any,
+        loss_output: bool = True,
+        mutable: bool = False,
+        name: Optional[str] = None,
+    ) -> "ModelSpec":
+        """Adapt a Flax linen module whose __call__(batch) returns loss/(loss, aux)."""
+
+        def init_fn(rng):
+            params_rng, dropout_rng = jax.random.split(rng)
+            variables = module.init(
+                {"params": params_rng, "dropout": dropout_rng}, example_batch, train=False
+            )
+            return variables["params"]
+
+        def loss_fn(params, batch, rng):
+            out = module.apply({"params": params}, batch, train=True, rngs={"dropout": rng})
+            return out
+
+        def apply_fn(params, batch):
+            return module.apply({"params": params}, batch, train=False)
+
+        if not loss_output:
+            raise ValueError(
+                "from_flax requires the module to return its loss; wrap it or "
+                "construct ModelSpec directly with a custom loss_fn"
+            )
+        return cls(init_fn=init_fn, loss_fn=loss_fn, apply_fn=apply_fn, name=name or type(module).__name__)
+
+
+def as_model_spec(model: Any, example_batch: Any = None) -> ModelSpec:
+    if isinstance(model, ModelSpec):
+        return model
+    # Duck-type flax linen modules
+    if hasattr(model, "init") and hasattr(model, "apply"):
+        if example_batch is None:
+            raise ValueError("example_batch is required to adapt a Flax module")
+        return ModelSpec.from_flax(model, example_batch)
+    raise TypeError(
+        f"model must be a ModelSpec or a Flax module, got {type(model)}"
+    )

@@ -1,0 +1,128 @@
+"""Mixed precision: dtype policy + dynamic loss scaling.
+
+TPU-native analog of the reference fp16/bf16 wrappers
+(``runtime/fp16/loss_scaler.py:91 DynamicLossScaler``,
+``runtime/fp16/fused_optimizer.py:33``, ``runtime/bf16_optimizer.py:35``).
+The master-fp32-copy + overflow-check + skip-step machinery is expressed as a
+functional state threaded through the compiled train step: master params stay
+fp32, compute happens in bf16/fp16, the scaler state updates with
+``lax``-friendly arithmetic so the whole thing lives under one ``jit``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    """Dynamic loss-scaler state (reference ``DynamicLossScaler`` semantics)."""
+
+    loss_scale: jax.Array  # f32 scalar
+    growth_tracker: jax.Array  # i32: consecutive good steps
+    hysteresis: jax.Array  # i32: overflows tolerated before backoff
+    skipped_steps: jax.Array  # i32: total skipped updates
+
+
+def make_loss_scale_state(
+    enabled: bool,
+    initial_scale_power: int = 16,
+    static_loss_scale: float = 0.0,
+    hysteresis: int = 2,
+) -> LossScaleState:
+    if not enabled:
+        scale = 1.0
+    elif static_loss_scale and static_loss_scale > 0:
+        scale = float(static_loss_scale)
+    else:
+        scale = float(2**initial_scale_power)
+    return LossScaleState(
+        loss_scale=jnp.asarray(scale, jnp.float32),
+        growth_tracker=jnp.asarray(0, jnp.int32),
+        hysteresis=jnp.asarray(hysteresis, jnp.int32),
+        skipped_steps=jnp.asarray(0, jnp.int32),
+    )
+
+
+def all_finite(tree: Any) -> jax.Array:
+    """True iff every element of every leaf is finite (overflow check).
+
+    Analog of the reference's ``_has_inf_or_nan`` scan
+    (``zero/stage_1_and_2.py:2038``), fused by XLA into the backward pass.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    return jnp.all(jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]))
+
+
+def update_loss_scale(
+    state: LossScaleState,
+    grads_finite: jax.Array,
+    *,
+    dynamic: bool,
+    scale_window: int = 1000,
+    scale_factor: float = 2.0,
+    min_scale: float = 1.0,
+    max_scale: float = 2.0**32,
+    init_hysteresis: int = 2,
+    consecutive_hysteresis: bool = False,
+) -> LossScaleState:
+    """One scaler update. jit-safe (no Python branching on traced values)."""
+    if not dynamic:
+        return state._replace(
+            skipped_steps=state.skipped_steps + jnp.where(grads_finite, 0, 1).astype(jnp.int32)
+        )
+
+    # --- overflow branch ---------------------------------------------------
+    hysteresis_exhausted = state.hysteresis <= 1
+    overflow_scale = jnp.where(
+        hysteresis_exhausted,
+        jnp.maximum(state.loss_scale / scale_factor, min_scale),
+        state.loss_scale,
+    )
+    overflow_hyst = jnp.where(hysteresis_exhausted, state.hysteresis, state.hysteresis - 1)
+
+    # --- good-step branch --------------------------------------------------
+    new_tracker = state.growth_tracker + 1
+    grow = new_tracker >= scale_window
+    good_scale = jnp.where(grow, jnp.minimum(state.loss_scale * scale_factor, max_scale), state.loss_scale)
+    good_tracker = jnp.where(grow, 0, new_tracker).astype(jnp.int32)
+    good_hyst = (
+        jnp.asarray(init_hysteresis, jnp.int32) if consecutive_hysteresis else state.hysteresis
+    )
+
+    return LossScaleState(
+        loss_scale=jnp.where(grads_finite, good_scale, overflow_scale).astype(jnp.float32),
+        growth_tracker=jnp.where(grads_finite, good_tracker, 0).astype(jnp.int32),
+        hysteresis=jnp.where(grads_finite, good_hyst, overflow_hyst).astype(jnp.int32),
+        skipped_steps=state.skipped_steps + jnp.where(grads_finite, 0, 1).astype(jnp.int32),
+    )
+
+
+def cast_floating(tree: Any, dtype) -> Any:
+    """Cast float leaves to ``dtype`` (int/bool leaves untouched)."""
+
+    def _cast(x):
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return jnp.asarray(x, dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+def global_norm(tree: Any) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
+
+
+def clip_by_global_norm(tree: Any, max_norm: float, norm: jax.Array = None) -> Tuple[Any, jax.Array]:
+    """Global-norm gradient clipping (reference ``runtime/utils.py clip_grad_norm_``)."""
+    if norm is None:
+        norm = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda x: (x.astype(jnp.float32) * scale).astype(x.dtype), tree), norm

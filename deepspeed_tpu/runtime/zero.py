@@ -1,0 +1,141 @@
+"""ZeRO stages as sharding placements.
+
+TPU-native re-design of the reference ZeRO stack (``zero/stage_1_and_2.py``,
+``zero/stage3.py``, ``zero/partition_parameters.py`` — ~11k LoC of hook/bucket
+machinery). On TPU the same memory states are obtained by *placing* the train
+state on the mesh and letting XLA schedule the collectives:
+
+  stage 0: params/grads/opt replicated; grads all-reduced (psum) over data axes
+  stage 1: optimizer state + fp32 master params sharded over the data axes
+           (update computed on the shard, updated weights all-gathered —
+           exactly the reference's partitioned fp32 update + bucketed
+           allgather, ``stage_1_and_2.py:1835``)
+  stage 2: + gradient accumulation buffers sharded (each micro-batch's grads
+           are reduce-scattered into the shard instead of all-reduced,
+           ``stage_1_and_2.py:1057 average_tensor``)
+  stage 3: + parameters themselves sharded over the ``fsdp`` mesh axis
+           per-tensor; XLA inserts per-layer allgathers during fwd/bwd,
+           replacing the fetch/prefetch coordinator
+           (``partitioned_param_coordinator.py``) with compiler scheduling.
+
+MiCS (``zero/mics.py``) falls out of the mesh shape: ``fsdp < dp_world`` gives
+sub-group sharding with replication across groups.
+
+The unit of partitioning is a whole tensor dimension (largest dimension
+divisible by the shard count), not a flat byte range: XLA needs dimension
+shardings. Tensors too small to matter (< ``param_persistence_threshold``
+elements, reference ``zero/config.py``) stay replicated, which mirrors the
+reference's persistent-parameter optimization (``parameter_offload.py:261``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.config.config import ZeroConfig
+from deepspeed_tpu.topology.mesh import BATCH_AXES
+
+# Leaves smaller than this stay replicated in stage-1/2 opt-state sharding
+# (sharding a 10-element bias buys nothing and costs collective latency).
+DEFAULT_SHARD_MIN_NUMEL = 2048
+
+
+def _shardable_dim(shape: Sequence[int], n_shards: int, min_numel: int) -> Optional[int]:
+    """Pick the dimension to shard: largest dim divisible by ``n_shards``."""
+    if n_shards <= 1:
+        return None
+    if int(np.prod(shape or (0,))) < max(min_numel, n_shards):
+        return None
+    candidates = [i for i, d in enumerate(shape) if d % n_shards == 0 and d >= n_shards]
+    if not candidates:
+        return None
+    return max(candidates, key=lambda i: shape[i])
+
+
+def auto_partition_spec(
+    shape: Sequence[int],
+    mesh: Mesh,
+    axes: Tuple[str, ...],
+    min_numel: int = DEFAULT_SHARD_MIN_NUMEL,
+) -> PartitionSpec:
+    """Shard the largest divisible dimension of ``shape`` over ``axes`` (jointly)."""
+    live = tuple(a for a in axes if mesh.shape[a] > 1)
+    if not live:
+        return PartitionSpec()
+    n = int(np.prod([mesh.shape[a] for a in live]))
+    dim = _shardable_dim(shape, n, min_numel)
+    if dim is None:
+        return PartitionSpec()
+    spec: list = [None] * len(shape)
+    spec[dim] = live if len(live) > 1 else live[0]
+    return PartitionSpec(*spec)
+
+
+def param_partition_spec(shape: Sequence[int], mesh: Mesh, zero_config: ZeroConfig) -> PartitionSpec:
+    """PartitionSpec for a *parameter* under the configured ZeRO stage.
+
+    Stage 3 shards over ``fsdp`` (and for MiCS semantics the mesh shape itself
+    encodes the sub-group). Stages 0-2 keep parameters replicated.
+    """
+    if zero_config.stage < 3:
+        return PartitionSpec()
+    return auto_partition_spec(
+        shape, mesh, axes=("fsdp",), min_numel=max(zero_config.param_persistence_threshold, 1)
+    )
+
+
+def master_partition_spec(shape: Sequence[int], mesh: Mesh, zero_config: ZeroConfig) -> PartitionSpec:
+    """PartitionSpec for fp32 master params / optimizer moments / grad accumulators.
+
+    Stage >=1 shards these over all data-like axes (dp and fsdp jointly) —
+    the ZeRO insight that optimizer state need only exist once per data-
+    parallel world. Stage 3 master state additionally must stay compatible
+    with the param placement, so it uses the same data axes (a superset of
+    fsdp).
+    """
+    if zero_config.stage < 1:
+        return PartitionSpec()
+    return auto_partition_spec(shape, mesh, axes=BATCH_AXES, min_numel=DEFAULT_SHARD_MIN_NUMEL)
+
+
+def state_sharding(tree: Any, mesh: Mesh, spec_fn) -> Any:
+    """Map ``spec_fn(shape) -> PartitionSpec`` over a pytree of array specs/arrays."""
+
+    def _one(leaf):
+        shape = getattr(leaf, "shape", ())
+        if shape is None or len(shape) == 0:
+            return NamedSharding(mesh, PartitionSpec())
+        return NamedSharding(mesh, spec_fn(tuple(shape)))
+
+    return jax.tree_util.tree_map(_one, tree)
+
+
+def params_sharding(params: Any, mesh: Mesh, zero_config: ZeroConfig) -> Any:
+    return state_sharding(params, mesh, lambda s: param_partition_spec(s, mesh, zero_config))
+
+
+def master_sharding(tree: Any, mesh: Mesh, zero_config: ZeroConfig) -> Any:
+    """Sharding for master params + optimizer state leaves.
+
+    Under stage 3 a leaf keeps the param placement when it is already sharded
+    over fsdp; data-axis sharding applies on top for moments. For simplicity
+    and correctness we use the joint data-axes rule for every float leaf —
+    scalars (step counts) replicate.
+    """
+    return state_sharding(tree, mesh, lambda s: master_partition_spec(s, mesh, zero_config))
+
+
+def grads_sharding(params: Any, mesh: Mesh, zero_config: ZeroConfig) -> Any:
+    """Sharding for the gradient-accumulation buffer.
+
+    Stage >=2 shards it like the master state (reduce-scatter per micro-batch);
+    stages 0/1 keep full (replicated) gradients, matching the reference's
+    allreduce-then-partition behavior.
+    """
+    if zero_config.stage < 2:
+        return state_sharding(params, mesh, lambda s: PartitionSpec())
+    return master_sharding(params, mesh, zero_config)

@@ -1,0 +1,49 @@
+"""Tiny model fixtures (analog of reference ``tests/unit/simple_model.py``)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.model import ModelSpec
+
+
+class SimpleMLP(nn.Module):
+    """Regression MLP: batch = {'x': [B, D], 'y': [B, 1]} -> (loss, preds)."""
+
+    hidden: int = 32
+    depth: int = 2
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        h = batch["x"]
+        for _ in range(self.depth):
+            h = nn.Dense(self.hidden)(h)
+            h = nn.relu(h)
+        pred = nn.Dense(1)(h)
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, pred
+
+
+def simple_model_spec(dim: int = 16, hidden: int = 32, depth: int = 2) -> ModelSpec:
+    module = SimpleMLP(hidden=hidden, depth=depth)
+    example = {"x": jnp.zeros((2, dim)), "y": jnp.zeros((2, 1))}
+    return ModelSpec.from_flax(module, example)
+
+
+def _teacher(dim: int) -> np.ndarray:
+    # fixed across batches so there is something to learn
+    return np.random.default_rng(1234).normal(size=(dim, 1)).astype(np.float32)
+
+
+def random_batch(batch_size: int, dim: int = 16, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(batch_size, dim)).astype(np.float32)
+    y = x @ _teacher(dim) + 0.01 * rng.normal(size=(batch_size, 1)).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def make_dataset(n: int = 256, dim: int = 16, seed: int = 0):
+    return random_batch(n, dim, seed)
