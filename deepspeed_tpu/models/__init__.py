@@ -1,0 +1,8 @@
+from deepspeed_tpu.models.transformer import (
+    PRESETS,
+    CausalLM,
+    TransformerConfig,
+    causal_lm_partition_rules,
+    causal_lm_spec,
+    cross_entropy_loss,
+)

@@ -1,0 +1,329 @@
+"""Decoder-only transformer family (the framework's flagship model).
+
+The reference ships transformer implementations for inference injection
+(``deepspeed/module_inject/containers/*``, ``model_implementations/``) and a
+legacy fused training layer (``ops/transformer/transformer.py:296``). Here the
+model is a first-class Flax module designed for TPU:
+
+  - one config covers Llama-style (RMSNorm + RoPE + SwiGLU + GQA) and
+    GPT-2-style (LayerNorm + learned positions + GELU) decoders
+  - ``nn.scan`` over layers: one compiled block, stacked params (fast compile,
+    XLA-friendly), optional ``nn.remat`` for activation checkpointing
+    (the analog of ``runtime/activation_checkpointing``)
+  - attention dispatches through the ops registry so the Pallas flash kernel
+    replaces the XLA einsum path on TPU (``deepspeed_tpu/ops``)
+  - ``partition_rules`` provide tensor-parallel placements (the AutoTP analog,
+    reference ``module_inject/auto_tp.py:193``) that the engine composes with
+    ZeRO sharding
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.runtime.model import ModelSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    intermediate_size: int = 1408
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None  # None => MHA
+    head_dim: Optional[int] = None  # None => hidden // heads
+    max_seq_len: int = 2048
+    norm: str = "rmsnorm"  # rmsnorm | layernorm
+    activation: str = "silu_glu"  # silu_glu | gelu
+    position: str = "rope"  # rope | learned
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dropout: float = 0.0
+    tie_embeddings: bool = False
+    remat: bool = False
+    scan_layers: bool = True
+    attn_impl: str = "auto"  # auto | xla | flash
+    dtype: Any = jnp.float32  # activation dtype inside the module
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def dims_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Approximate training FLOPs/token (fwd+bwd, 6ND + attention)."""
+        n = self.num_params()
+        attn = 12 * self.num_layers * self.hidden_size * seq_len  # score+value matmuls
+        return 6 * n + attn
+
+    def num_params(self) -> int:
+        h, v, l = self.hidden_size, self.vocab_size, self.num_layers
+        hd = self.dims_per_head
+        qkv = h * hd * (self.num_heads + 2 * self.kv_heads) + hd * self.num_heads * h
+        if self.activation == "silu_glu":
+            mlp = 3 * h * self.intermediate_size
+        else:
+            mlp = 2 * h * self.intermediate_size
+        emb = v * h * (1 if self.tie_embeddings else 2)
+        return l * (qkv + mlp + 2 * h) + emb + h
+
+
+# ---------------------------------------------------------------- presets
+PRESETS = {
+    "tiny": TransformerConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                              num_layers=2, num_heads=4, max_seq_len=128),
+    "gpt2-125m": TransformerConfig(vocab_size=50257, hidden_size=768, intermediate_size=3072,
+                                   num_layers=12, num_heads=12, max_seq_len=1024,
+                                   norm="layernorm", activation="gelu", position="learned",
+                                   tie_embeddings=True),
+    "llama3-8b": TransformerConfig(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                                   num_layers=32, num_heads=32, num_kv_heads=8, max_seq_len=8192,
+                                   rope_theta=500000.0),
+    "llama3-1b": TransformerConfig(vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+                                   num_layers=16, num_heads=32, num_kv_heads=8, max_seq_len=8192),
+}
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        from deepspeed_tpu.ops import rms_norm
+
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        return rms_norm(x, scale, eps=self.eps)
+
+
+def _norm(config: TransformerConfig, name: str):
+    if config.norm == "rmsnorm":
+        return RMSNorm(eps=config.norm_eps, name=name)
+    return nn.LayerNorm(epsilon=config.norm_eps, name=name)
+
+
+def rope_tables(seq_len: int, dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    freqs = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    angles = jnp.outer(t, freqs)  # [S, dim/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array) -> jax.Array:
+    """x: [B, S, H, D]; cos/sin: [maxS, D/2]; positions: [B, S]."""
+    from deepspeed_tpu.ops import rope as rope_op
+
+    return rope_op(x, cos, sin, positions)
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask, positions, train: bool):
+        cfg = self.config
+        hd = cfg.dims_per_head
+        q = nn.DenseGeneral((cfg.num_heads, hd), use_bias=cfg.norm == "layernorm",
+                            dtype=cfg.dtype, name="wq")(x)
+        k = nn.DenseGeneral((cfg.kv_heads, hd), use_bias=cfg.norm == "layernorm",
+                            dtype=cfg.dtype, name="wk")(x)
+        v = nn.DenseGeneral((cfg.kv_heads, hd), use_bias=cfg.norm == "layernorm",
+                            dtype=cfg.dtype, name="wv")(x)
+
+        if cfg.position == "rope":
+            cos, sin = rope_tables(cfg.max_seq_len, hd, cfg.rope_theta)
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+
+        from deepspeed_tpu.ops import causal_attention
+
+        out = causal_attention(q, k, v, mask=mask, impl=cfg.attn_impl)  # [B,S,H,hd]
+        out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), use_bias=cfg.norm == "layernorm",
+                              dtype=cfg.dtype, name="wo")(out)
+        if cfg.dropout > 0:
+            out = nn.Dropout(cfg.dropout, deterministic=not train)(out)
+        return out
+
+
+class MLP(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.config
+        bias = cfg.norm == "layernorm"
+        if cfg.activation == "silu_glu":
+            gate = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype, name="w_gate")(x)
+            up = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype, name="w_up")(x)
+            h = nn.silu(gate) * up
+        else:
+            h = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype, name="w_up")(x)
+            h = nn.gelu(h)
+        out = nn.Dense(cfg.hidden_size, use_bias=bias, dtype=cfg.dtype, name="w_down")(h)
+        if cfg.dropout > 0:
+            out = nn.Dropout(cfg.dropout, deterministic=not train)(out)
+        return out
+
+
+class Block(nn.Module):
+    # ``train`` is a module attribute (not a call kwarg) because nn.scan does
+    # not forward kwargs through the scanned call.
+    config: TransformerConfig
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, carry, _=None):
+        x, mask, positions = carry
+        x = x + Attention(self.config, name="attn")(
+            _norm(self.config, "attn_norm")(x), mask, positions, self.train
+        )
+        x = x + MLP(self.config, name="mlp")(_norm(self.config, "mlp_norm")(x), self.train)
+        return (x, mask, positions), None
+
+
+class CausalLM(nn.Module):
+    """Decoder-only LM. batch: {'input_ids': [B,S], optional 'labels',
+    'attention_mask', 'position_ids'} -> (loss, logits)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        cfg = self.config
+        ids = batch["input_ids"]
+        B, S = ids.shape
+        positions = batch.get("position_ids")
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        pad_mask = batch.get("attention_mask")  # [B, S] 1=keep
+
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="embed")(ids)
+        if cfg.position == "learned":
+            pos_emb = self.param(
+                "pos_embed", nn.initializers.normal(0.02), (cfg.max_seq_len, cfg.hidden_size)
+            )
+            x = x + pos_emb[None, :S, :].astype(cfg.dtype)
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(Block, prevent_cse=False)
+        if cfg.scan_layers:
+            stack = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, train, name="layers")
+            (x, _, _), _ = stack((x, pad_mask, positions), None)
+        else:
+            for i in range(cfg.num_layers):
+                (x, _, _), _ = block_cls(cfg, train, name=f"layer_{i}")((x, pad_mask, positions), None)
+
+        x = _norm(cfg, "final_norm")(x)
+        if cfg.tie_embeddings:
+            embed = self.variables["params"]["embed"]["embedding"]
+            logits = x @ embed.T.astype(cfg.dtype)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
+
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate([ids[:, 1:], jnp.full((B, 1), -100, dtype=ids.dtype)], axis=1)
+        loss = cross_entropy_loss(logits, labels, pad_mask)
+        return loss, logits
+
+
+def cross_entropy_loss(logits, labels, pad_mask=None, ignore_index: int = -100):
+    """Mean token cross entropy in fp32 with ignore mask."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    if pad_mask is not None:
+        valid = valid & (pad_mask > 0)
+    safe_labels = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * valid
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+# ------------------------------------------------------- tensor parallelism
+def causal_lm_partition_rules(path: str, shape: tuple) -> Optional[P]:
+    """AutoTP-style placement rules for CausalLM parameters.
+
+    Column-parallel: q/k/v, gate/up projections, lm_head (output dim over tp).
+    Row-parallel: o and down projections (input dim over tp).
+    Embedding: vocab dim over tp. Right-aligned so the scan's leading layer
+    dimension stays unsharded. (Reference analog: ``module_inject/auto_tp.py``
+    tp_parser + LinearLayer/LinearAllreduce.)
+
+    ``path`` is a ``jax.tree_util.keystr`` string, i.e. bracket form like
+    ``"['layers']['attn']['wq']['kernel']"`` — match whole quoted names.
+    """
+
+    def has(token: str) -> bool:
+        return f"'{token}'" in path
+
+    def right(*entries):
+        pad = len(shape) - len(entries)
+        if pad < 0:
+            return None
+        return P(*([None] * pad + list(entries)))
+
+    if has("pos_embed"):
+        return None
+    if has("embed") and has("embedding"):
+        return right("tp", None)
+    kernel = has("kernel")
+    if kernel and (has("wq") or has("wk") or has("wv")):
+        # DenseGeneral kernel [emb, heads, head_dim]: shard heads over tp
+        return right(None, "tp", None) if len(shape) >= 3 else right(None, "tp")
+    if kernel and has("wo"):
+        # DenseGeneral kernel [heads, head_dim, emb]: shard heads over tp
+        return right("tp", None, None) if len(shape) >= 3 else right("tp", None)
+    if kernel and (has("w_gate") or has("w_up")):
+        return right(None, "tp")
+    if kernel and has("w_down"):
+        return right("tp", None)
+    if kernel and has("lm_head"):
+        return right(None, "tp")
+    if has("bias"):
+        # biases of column-parallel layers follow the output (head) dim
+        if has("wq") or has("wk") or has("wv"):
+            return right("tp", None) if len(shape) >= 2 else None
+        if has("w_gate") or has("w_up"):
+            return right("tp")
+    return None
+
+
+def causal_lm_spec(config: TransformerConfig, example_seq_len: int = 8) -> ModelSpec:
+    """Build the engine-facing ModelSpec for a CausalLM."""
+    module = CausalLM(config)
+    example = {"input_ids": jnp.zeros((2, example_seq_len), jnp.int32)}
+
+    def init_fn(rng):
+        p_rng, d_rng = jax.random.split(rng)
+        return module.init({"params": p_rng, "dropout": d_rng}, example, train=False)["params"]
+
+    def loss_fn(params, batch, rng):
+        return module.apply({"params": params}, batch, train=True, rngs={"dropout": rng})
+
+    def apply_fn(params, batch):
+        return module.apply({"params": params}, batch, train=False)
+
+    return ModelSpec(
+        init_fn=init_fn,
+        loss_fn=loss_fn,
+        apply_fn=apply_fn,
+        name=f"CausalLM({config.hidden_size}x{config.num_layers})",
+        partition_rules=causal_lm_partition_rules,
+    )

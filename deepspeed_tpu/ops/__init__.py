@@ -1,0 +1,27 @@
+"""deepspeed_tpu.ops: the kernel layer (op_builder + csrc analog).
+
+Ops are registered per-backend (xla fallback, pallas TPU kernels) and resolved
+through the registry at call time. Import order matters only in that the
+pallas module registers its implementations on import; it degrades gracefully
+off-TPU.
+"""
+
+from deepspeed_tpu.ops.registry import available_impls, dispatch, op_report, register
+from deepspeed_tpu.ops.attention import causal_attention
+from deepspeed_tpu.ops.norms import layer_norm, rms_norm
+from deepspeed_tpu.ops.rope import rope
+
+# Pallas kernels register themselves when importable (TPU or interpret mode).
+try:  # pragma: no cover - exercised on TPU
+    from deepspeed_tpu.ops.pallas import register_all as _register_pallas
+
+    _register_pallas()
+except ModuleNotFoundError:
+    pass  # pallas kernel package not built yet
+except Exception as _e:  # noqa: BLE001 - degrade to xla impls, but say so
+    from deepspeed_tpu.utils.logging import logger as _logger
+
+    _logger.warning(
+        f"pallas kernel registration failed ({type(_e).__name__}: {_e}); "
+        f"all ops fall back to XLA implementations"
+    )

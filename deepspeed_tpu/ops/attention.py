@@ -1,0 +1,48 @@
+"""Attention ops.
+
+The XLA implementation is the universal fallback (fused by the compiler); the
+Pallas flash kernel (``ops/pallas/flash_attention.py``) registers under the
+same op name and wins dispatch on TPU. Reference analog: the inference/training
+softmax+context CUDA kernels (``csrc/transformer/inference/csrc/softmax.cu``
+etc.) and Triton flash variants (``ops/transformer/inference/triton/``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.registry import dispatch, register
+
+_NEG_INF = -1e9  # mask fill well below any real score but finite for fp16 safety
+
+
+@register("causal_attention", "xla")
+def _xla_causal_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, S, Hkv, D]
+    v: jax.Array,  # [B, S, Hkv, D]
+    mask: Optional[jax.Array] = None,  # [B, S] 1=keep (padding mask)
+) -> jax.Array:
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    assert H % Hkv == 0, f"query heads {H} not a multiple of kv heads {Hkv}"
+    G = H // Hkv
+
+    qg = q.reshape(B, S, Hkv, G, D).astype(jnp.float32) * (D**-0.5)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k.astype(jnp.float32))
+
+    causal = jnp.tril(jnp.ones((S, S), bool))
+    keep = causal[None, None, None]
+    if mask is not None:
+        keep = keep & (mask[:, None, None, None, :] > 0)
+    scores = jnp.where(keep, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(B, S, H, D)
+
+
+def causal_attention(q, k, v, mask=None, impl: str = "auto"):
+    return dispatch("causal_attention", impl)(q, k, v, mask=mask)

@@ -1,0 +1,35 @@
+"""Rotary position embedding op.
+
+Reference analog: ``csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu``
+and the fused KV+RoPE ragged kernel (``linear_blocked_kv_rotary``). Half-split
+(Llama/NeoX) convention: the head dim is split into two halves rotated against
+each other.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.registry import dispatch, register
+
+
+@register("rope", "xla")
+def _xla_rope(
+    x: jax.Array,  # [B, S, H, D]
+    cos: jax.Array,  # [maxS, D/2]
+    sin: jax.Array,  # [maxS, D/2]
+    positions: jax.Array,  # [B, S] int
+) -> jax.Array:
+    dtype = x.dtype
+    d2 = x.shape[-1] // 2
+    cos_p = cos[positions][:, :, None, :].astype(jnp.float32)  # [B,S,1,D/2]
+    sin_p = sin[positions][:, :, None, :].astype(jnp.float32)
+    x1 = x[..., :d2].astype(jnp.float32)
+    x2 = x[..., d2:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos_p - x2 * sin_p, x2 * cos_p + x1 * sin_p], axis=-1)
+    return out.astype(dtype)
+
+
+def rope(x, cos, sin, positions, impl: str = "auto"):
+    return dispatch("rope", impl)(x, cos, sin, positions)

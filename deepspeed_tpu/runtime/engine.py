@@ -155,17 +155,47 @@ class DeepSpeedTPUEngine:
         master_f32 = cast_floating(model_parameters, jnp.float32)
 
         param_shapes = jax.eval_shape(lambda: master_f32)
-        self.param_sharding = zero_mod.master_sharding(param_shapes, mesh, self.zero_config) \
-            if self.zero_config.stage >= 1 else zero_mod.params_sharding(param_shapes, mesh, self.zero_config)
-        # Stage 3: master params use the fsdp param placement so compute params
-        # inherit it without an extra reshard.
+
+        # Model-parallel base placements (AutoTP rules) — ZeRO composes on top.
+        base_specs = self._build_base_specs(param_shapes)
+        self._base_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), base_specs
+        )
         if self.zero_config.stage >= 3:
-            self.param_sharding = zero_mod.params_sharding(param_shapes, mesh, self.zero_config)
+            # Stage 3: master params use the fsdp param placement so compute
+            # params inherit it without an extra reshard.
+            self.param_sharding = zero_mod.params_sharding(param_shapes, mesh, self.zero_config, base_specs)
+        elif self.zero_config.stage >= 1:
+            self.param_sharding = zero_mod.master_sharding(param_shapes, mesh, self.zero_config, base_specs)
+        else:
+            self.param_sharding = self._base_shardings
 
         params = jax.device_put(master_f32, self.param_sharding)
 
         opt_shapes = jax.eval_shape(self.tx.init, params)
-        self.opt_sharding = zero_mod.master_sharding(opt_shapes, mesh, self.zero_config)
+        replicated_sh = NamedSharding(mesh, PartitionSpec())
+        try:
+            # Optimizer moments inherit their parameter's placement exactly
+            # (no resharding in the update); non-param leaves replicate.
+            self.opt_sharding = optax.tree_map_params(
+                self.tx,
+                lambda _leaf, sh: sh,
+                opt_shapes,
+                self.param_sharding,
+                transform_non_params=lambda _leaf: replicated_sh,
+            )
+        except Exception as e:
+            # Custom client transforms that tree_map_params cannot traverse:
+            # fall back to the shape-based data-axes rule. This loses any
+            # model-parallel (tp) placement for the moments (opt-state tree
+            # structure differs from params, so base specs cannot be mapped),
+            # costing a reshard per update — make it visible.
+            logger.warning(
+                f"optimizer-state placement fell back to the shape-based rule "
+                f"(tree_map_params failed: {type(e).__name__}: {e}); tp placements "
+                f"are not propagated to optimizer moments"
+            )
+            self.opt_sharding = zero_mod.master_sharding(opt_shapes, mesh, self.zero_config)
         opt_state = jax.jit(self.tx.init, out_shardings=self.opt_sharding)(params)
 
         ls_state = make_loss_scale_state(
@@ -191,7 +221,19 @@ class DeepSpeedTPUEngine:
             loss_scale=jax.tree_util.tree_map(lambda _: replicated, ls_state),
             rng=replicated,
         )
-        self.grad_sharding = zero_mod.grads_sharding(param_shapes, mesh, self.zero_config)
+        self.grad_sharding = zero_mod.grads_sharding(param_shapes, mesh, self.zero_config, base_specs)
+
+    def _build_base_specs(self, param_shapes) -> Any:
+        """Per-param model-parallel PartitionSpecs from the model's rules."""
+        rules = self.model.partition_rules
+        if rules is None:
+            return jax.tree_util.tree_map(lambda _: PartitionSpec(), param_shapes)
+
+        def one(key_path, leaf):
+            spec = rules(jax.tree_util.keystr(key_path), tuple(leaf.shape))
+            return spec if spec is not None else PartitionSpec()
+
+        return jax.tree_util.tree_map_with_path(one, param_shapes)
 
     # ----------------------------------------------------------- train step
     def _loss_and_aux(self, params, batch, rng):
@@ -204,13 +246,10 @@ class DeepSpeedTPUEngine:
         compute = cast_floating(master_params, self.compute_dtype)
         if self.zero_config.stage in (1, 2):
             # Updated shards -> full weights: the stage-1/2 post-step allgather
-            # (reference stage_1_and_2.py:1835ff), done in 16-bit.
-            compute = jax.lax.with_sharding_constraint(
-                compute,
-                jax.tree_util.tree_map(
-                    lambda _: NamedSharding(self.mesh, PartitionSpec()), master_params
-                ),
-            )
+            # (reference stage_1_and_2.py:1835ff), done in 16-bit. Model-
+            # parallel (tp) placements are preserved; only data-axis shards
+            # gather.
+            compute = jax.lax.with_sharding_constraint(compute, self._base_shardings)
         return compute
 
     def _build_train_step(self) -> Callable:

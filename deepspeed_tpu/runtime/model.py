@@ -31,6 +31,10 @@ class ModelSpec:
     loss_fn: LossFn
     apply_fn: Optional[Callable[[Any, Any], Any]] = None
     name: str = "model"
+    # Optional model-parallel placement rules (the AutoTP analog): maps a
+    # parameter path string + shape to a PartitionSpec carrying e.g. 'tp'
+    # entries, or None for default placement. ZeRO sharding composes on top.
+    partition_rules: Optional[Callable[[str, tuple], Optional[Any]]] = None
 
     @classmethod
     def from_flax(

@@ -44,16 +44,26 @@ from deepspeed_tpu.topology.mesh import BATCH_AXES
 DEFAULT_SHARD_MIN_NUMEL = 2048
 
 
-def _shardable_dim(shape: Sequence[int], n_shards: int, min_numel: int) -> Optional[int]:
-    """Pick the dimension to shard: largest dim divisible by ``n_shards``."""
-    if n_shards <= 1:
-        return None
-    if int(np.prod(shape or (0,))) < max(min_numel, n_shards):
-        return None
-    candidates = [i for i, d in enumerate(shape) if d % n_shards == 0 and d >= n_shards]
-    if not candidates:
-        return None
-    return max(candidates, key=lambda i: shape[i])
+def _fill_largest_free_dim(
+    base: list,
+    shape: Sequence[int],
+    mesh: Mesh,
+    axes: Tuple[str, ...],
+    min_numel: int,
+) -> list:
+    """Shared policy: shard the largest dim of ``shape`` not already occupied
+    in ``base`` (and divisible by the joint axis size) over ``axes``."""
+    live = tuple(a for a in axes if mesh.shape[a] > 1)
+    if not live:
+        return base
+    n = int(np.prod([mesh.shape[a] for a in live]))
+    if int(np.prod(shape or (0,))) < max(min_numel, n):
+        return base
+    free = [i for i, e in enumerate(base) if e is None and shape[i] % n == 0 and shape[i] >= n]
+    if free:
+        dim = max(free, key=lambda i: shape[i])
+        base[dim] = live if len(live) > 1 else live[0]
+    return base
 
 
 def auto_partition_spec(
@@ -63,79 +73,91 @@ def auto_partition_spec(
     min_numel: int = DEFAULT_SHARD_MIN_NUMEL,
 ) -> PartitionSpec:
     """Shard the largest divisible dimension of ``shape`` over ``axes`` (jointly)."""
-    live = tuple(a for a in axes if mesh.shape[a] > 1)
-    if not live:
-        return PartitionSpec()
-    n = int(np.prod([mesh.shape[a] for a in live]))
-    dim = _shardable_dim(shape, n, min_numel)
-    if dim is None:
-        return PartitionSpec()
-    spec: list = [None] * len(shape)
-    spec[dim] = live if len(live) > 1 else live[0]
-    return PartitionSpec(*spec)
+    spec = _fill_largest_free_dim([None] * len(shape), shape, mesh, axes, min_numel)
+    return PartitionSpec(*spec) if any(e is not None for e in spec) else PartitionSpec()
 
 
-def param_partition_spec(shape: Sequence[int], mesh: Mesh, zero_config: ZeroConfig) -> PartitionSpec:
+def param_partition_spec(
+    shape: Sequence[int],
+    mesh: Mesh,
+    zero_config: ZeroConfig,
+    base_spec: Optional[PartitionSpec] = None,
+) -> PartitionSpec:
     """PartitionSpec for a *parameter* under the configured ZeRO stage.
 
-    Stage 3 shards over ``fsdp`` (and for MiCS semantics the mesh shape itself
-    encodes the sub-group). Stages 0-2 keep parameters replicated.
+    ``base_spec`` carries model-parallel placements (e.g. a ``tp`` entry from
+    AutoTP rules); stage 3 then shards the largest still-unsharded dimension
+    over ``fsdp``. Stages 0-2 keep only the base (model-parallel) placement.
     """
-    if zero_config.stage < 3:
-        return PartitionSpec()
-    return auto_partition_spec(
-        shape, mesh, axes=("fsdp",), min_numel=max(zero_config.param_persistence_threshold, 1)
-    )
+    base = list(base_spec) if base_spec is not None else []
+    base = base + [None] * (len(shape) - len(base))
+    if zero_config.stage >= 3:
+        base = _fill_largest_free_dim(
+            base, shape, mesh, ("fsdp",), max(zero_config.param_persistence_threshold, 1)
+        )
+    return PartitionSpec(*base) if any(e is not None for e in base) else PartitionSpec()
 
 
-def master_partition_spec(shape: Sequence[int], mesh: Mesh, zero_config: ZeroConfig) -> PartitionSpec:
+def master_partition_spec(
+    shape: Sequence[int],
+    mesh: Mesh,
+    zero_config: ZeroConfig,
+    base_spec: Optional[PartitionSpec] = None,
+) -> PartitionSpec:
     """PartitionSpec for fp32 master params / optimizer moments / grad accumulators.
 
-    Stage >=1 shards these over all data-like axes (dp and fsdp jointly) —
-    the ZeRO insight that optimizer state need only exist once per data-
-    parallel world. Stage 3 master state additionally must stay compatible
-    with the param placement, so it uses the same data axes (a superset of
-    fsdp).
+    Stage >=1 shards the largest free dimension over the data axes (dp and
+    fsdp jointly) — the ZeRO insight that optimizer state need only exist once
+    per data-parallel world. Model-parallel placements from ``base_spec``
+    (e.g. ``tp`` entries) are preserved.
     """
-    if zero_config.stage < 1:
-        return PartitionSpec()
-    return auto_partition_spec(shape, mesh, axes=BATCH_AXES, min_numel=DEFAULT_SHARD_MIN_NUMEL)
+    base = list(base_spec) if base_spec is not None else []
+    base = base + [None] * (len(shape) - len(base))
+    if zero_config.stage >= 1:
+        base = _fill_largest_free_dim(base, shape, mesh, BATCH_AXES, DEFAULT_SHARD_MIN_NUMEL)
+    return PartitionSpec(*base) if any(e is not None for e in base) else PartitionSpec()
 
 
-def state_sharding(tree: Any, mesh: Mesh, spec_fn) -> Any:
-    """Map ``spec_fn(shape) -> PartitionSpec`` over a pytree of array specs/arrays."""
+def state_sharding(tree: Any, mesh: Mesh, spec_fn, base_specs: Any = None) -> Any:
+    """Map ``spec_fn(shape, base_spec) -> PartitionSpec`` over a pytree.
 
-    def _one(leaf):
+    ``base_specs`` (same structure as ``tree``) carries model-parallel specs.
+    """
+
+    def _one(leaf, base):
         shape = getattr(leaf, "shape", ())
         if shape is None or len(shape) == 0:
             return NamedSharding(mesh, PartitionSpec())
-        return NamedSharding(mesh, spec_fn(tuple(shape)))
+        return NamedSharding(mesh, spec_fn(tuple(shape), base))
 
-    return jax.tree_util.tree_map(_one, tree)
-
-
-def params_sharding(params: Any, mesh: Mesh, zero_config: ZeroConfig) -> Any:
-    return state_sharding(params, mesh, lambda s: param_partition_spec(s, mesh, zero_config))
-
-
-def master_sharding(tree: Any, mesh: Mesh, zero_config: ZeroConfig) -> Any:
-    """Sharding for master params + optimizer state leaves.
-
-    Under stage 3 a leaf keeps the param placement when it is already sharded
-    over fsdp; data-axis sharding applies on top for moments. For simplicity
-    and correctness we use the joint data-axes rule for every float leaf —
-    scalars (step counts) replicate.
-    """
-    return state_sharding(tree, mesh, lambda s: master_partition_spec(s, mesh, zero_config))
+    if base_specs is None:
+        # PartitionSpec is a pytree leaf, so an empty spec is a safe "no base"
+        base_specs = jax.tree_util.tree_map(lambda _: PartitionSpec(), tree)
+    return jax.tree_util.tree_map(_one, tree, base_specs)
 
 
-def grads_sharding(params: Any, mesh: Mesh, zero_config: ZeroConfig) -> Any:
+def params_sharding(params: Any, mesh: Mesh, zero_config: ZeroConfig, base_specs: Any = None) -> Any:
+    return state_sharding(
+        params, mesh, lambda s, b: param_partition_spec(s, mesh, zero_config, b), base_specs
+    )
+
+
+def master_sharding(tree: Any, mesh: Mesh, zero_config: ZeroConfig, base_specs: Any = None) -> Any:
+    """Sharding for fp32 master params / grad accumulators (data-axes rule)."""
+    return state_sharding(
+        tree, mesh, lambda s, b: master_partition_spec(s, mesh, zero_config, b), base_specs
+    )
+
+
+def grads_sharding(params: Any, mesh: Mesh, zero_config: ZeroConfig, base_specs: Any = None) -> Any:
     """Sharding for the gradient-accumulation buffer.
 
     Stage >=2 shards it like the master state (reduce-scatter per micro-batch);
-    stages 0/1 keep full (replicated) gradients, matching the reference's
-    allreduce-then-partition behavior.
+    stages 0/1 keep full gradients (model-parallel placement only), matching
+    the reference's allreduce-then-partition behavior.
     """
     if zero_config.stage < 2:
-        return state_sharding(params, mesh, lambda s: PartitionSpec())
-    return master_sharding(params, mesh, zero_config)
+        return state_sharding(
+            params, mesh, lambda s, b: PartitionSpec(*b) if b else PartitionSpec(), base_specs
+        )
+    return master_sharding(params, mesh, zero_config, base_specs)
