@@ -51,6 +51,13 @@ class TransformerConfig:
     scan_layers: bool = True
     attn_impl: str = "auto"  # auto | xla | flash
     dtype: Any = jnp.float32  # activation dtype inside the module
+    # MoE (0 experts => dense MLP). Mirrors reference moe/layer.py knobs.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_min_capacity: int = 4
+    moe_aux_loss_weight: float = 0.01
+    moe_drop_tokens: bool = True
 
     @property
     def kv_heads(self) -> int:
@@ -145,8 +152,12 @@ class Attention(nn.Module):
             k = apply_rope(k, cos, sin, positions)
 
         from deepspeed_tpu.ops import causal_attention
+        from deepspeed_tpu.parallel.ulysses import ulysses_shard, ulysses_unshard
 
+        # Ulysses SP: seq-shard -> head-shard all-to-all around exact attention
+        q, k, v = ulysses_shard(q), ulysses_shard(k), ulysses_shard(v)
         out = causal_attention(q, k, v, mask=mask, impl=cfg.attn_impl)  # [B,S,H,hd]
+        out = ulysses_unshard(out)
         out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), use_bias=cfg.norm == "layernorm",
                               dtype=cfg.dtype, name="wo")(out)
         if cfg.dropout > 0:
@@ -182,12 +193,33 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _=None):
-        x, mask, positions = carry
-        x = x + Attention(self.config, name="attn")(
-            _norm(self.config, "attn_norm")(x), mask, positions, self.train
+        x, mask, positions, aux = carry
+        cfg = self.config
+        x = x + Attention(cfg, name="attn")(
+            _norm(cfg, "attn_norm")(x), mask, positions, self.train
         )
-        x = x + MLP(self.config, name="mlp")(_norm(self.config, "mlp_norm")(x), self.train)
-        return (x, mask, positions), None
+        h = _norm(cfg, "mlp_norm")(x)
+        if cfg.num_experts > 0:
+            from deepspeed_tpu.parallel.moe import MoEConfig, MoELayer
+
+            moe_cfg = MoEConfig(
+                num_experts=cfg.num_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                min_capacity=cfg.moe_min_capacity,
+                drop_tokens=cfg.moe_drop_tokens,
+                aux_loss_weight=cfg.moe_aux_loss_weight,
+            )
+            l_aux, out = MoELayer(
+                moe_cfg, cfg.hidden_size, cfg.intermediate_size,
+                activation=cfg.activation, dtype=cfg.dtype, train=self.train,
+                name="moe",
+            )(h)
+            x = x + out
+            aux = aux + l_aux
+        else:
+            x = x + MLP(cfg, name="mlp")(h, self.train)
+        return (x, mask, positions, aux), None
 
 
 class CausalLM(nn.Module):
@@ -213,6 +245,7 @@ class CausalLM(nn.Module):
             )
             x = x + pos_emb[None, :S, :].astype(cfg.dtype)
 
+        aux = jnp.zeros((), jnp.float32)
         block_cls = Block
         if cfg.remat:
             block_cls = nn.remat(Block, prevent_cse=False)
@@ -224,10 +257,10 @@ class CausalLM(nn.Module):
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, train, name="layers")
-            (x, _, _), _ = stack((x, pad_mask, positions), None)
+            (x, _, _, aux), _ = stack((x, pad_mask, positions, aux), None)
         else:
             for i in range(cfg.num_layers):
-                (x, _, _), _ = block_cls(cfg, train, name=f"layer_{i}")((x, pad_mask, positions), None)
+                (x, _, _, aux), _ = block_cls(cfg, train, name=f"layer_{i}")((x, pad_mask, positions, aux), None)
 
         x = _norm(cfg, "final_norm")(x)
         if cfg.tie_embeddings:
@@ -240,6 +273,9 @@ class CausalLM(nn.Module):
         if labels is None:
             labels = jnp.concatenate([ids[:, 1:], jnp.full((B, 1), -100, dtype=ids.dtype)], axis=1)
         loss = cross_entropy_loss(logits, labels, pad_mask)
+        if cfg.num_experts > 0:
+            # aux is pre-weighted by MoELayer; average over layers
+            loss = loss + aux / cfg.num_layers
         return loss, logits
 
 
@@ -279,6 +315,10 @@ def causal_lm_partition_rules(path: str, shape: tuple) -> Optional[P]:
             return None
         return P(*([None] * pad + list(entries)))
 
+    if has("experts") or has("gate"):
+        from deepspeed_tpu.parallel.moe import moe_partition_rules
+
+        return moe_partition_rules(path, shape)
     if has("pos_embed"):
         return None
     if has("embed") and has("embedding"):

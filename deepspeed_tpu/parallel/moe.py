@@ -1,0 +1,230 @@
+"""Mixture-of-Experts with expert parallelism.
+
+TPU-native re-design of the reference MoE stack (``deepspeed/moe/``):
+``TopKGate`` (``moe/sharded_moe.py:449``; top1/top2/topk gating fns :183, :290,
+:374 with capacity factor, load-balancing aux loss, random token priority),
+``Experts`` (``moe/experts.py:13``) and ``MOELayer`` (``sharded_moe.py:533``)
+whose einsum dispatch/combine the TPU version keeps, replacing the explicit
+``_AllToAll`` autograd op (:96) with sharding constraints over the ``ep`` mesh
+axis that XLA lowers to all-to-all on ICI.
+
+Data layout: tokens [T, M] -> dispatch einsum -> [E, C, M] (expert, capacity,
+model). Expert weights are stacked [E, M, H]/[E, H, M] and sharded over ``ep``,
+so the [E, C, M] activation resharding onto ``ep`` IS the dispatch all-to-all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.topology.mesh import get_mesh, has_mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None  # None | 'RSample' | 'Jitter'
+    drop_tokens: bool = True
+    use_rts: bool = True  # random token selection for priority under drops
+    aux_loss_weight: float = 0.01
+    # token distribution follows the reference's expert-data decomposition:
+    # experts shard over 'ep'; dp ranks inside an ep group replicate experts.
+
+
+def _ep_constrain(x: jax.Array, spec: P) -> jax.Array:
+    if not has_mesh():
+        return x
+    mesh = get_mesh()
+    if mesh.shape["ep"] <= 1:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _capacity(num_tokens: int, num_experts: int, factor: float, min_capacity: int, top_k: int) -> int:
+    cap = int(num_tokens * top_k * factor / num_experts)
+    return max(cap, min_capacity)
+
+
+def top_k_gating(
+    logits: jax.Array,  # [T, E]
+    top_k: int,
+    capacity: int,
+    rng: Optional[jax.Array] = None,
+    use_rts: bool = True,
+    drop_tokens: bool = True,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Generic top-k gating (covers the reference's top1/top2/topk gates).
+
+    Returns (l_aux, combine_weights [T, E, C], dispatch_mask [T, E, C], exp_counts [E]).
+    Load-balancing aux loss is the standard me*ce formulation
+    (``sharded_moe.py`` top1gating): E * sum_e mean_prob_e * frac_tokens_e.
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    topk_vals, topk_idx = jax.lax.top_k(probs, top_k)  # [T, k]
+    masks = jax.nn.one_hot(topk_idx, E, dtype=jnp.float32)  # [T, k, E]
+
+    # aux loss from the top-1 assignment (reference top1gating/top2gating)
+    me = probs.mean(axis=0)  # [E]
+    ce = masks[:, 0, :].mean(axis=0)  # fraction routed per expert (1st choice)
+    l_aux = jnp.sum(me * ce) * E
+
+    # Without drops, capacity must still be static under jit: the worst case
+    # is every (token, choice) slot routed to one expert. (The reference grows
+    # capacity to max(exp_counts) dynamically — impossible in XLA.)
+    if not drop_tokens:
+        capacity = T * top_k
+
+    # position of each token within its expert's capacity, priority by order
+    # (optionally randomized: random token selection, ``use_rts``)
+    if use_rts and rng is not None:
+        priority = jax.random.uniform(rng, (T,))
+        order = jnp.argsort(priority)
+        inv_order = jnp.argsort(order)
+        masks = masks[order]
+    # cumulative count per expert across (choice, token) slots — second
+    # choices queue behind first choices for the same expert (reference top2)
+    flat = jnp.concatenate([masks[:, j, :] for j in range(top_k)], axis=0)  # [k*T, E]
+    positions = jnp.cumsum(flat, axis=0) - flat  # [k*T, E]
+    pos_in_expert = (positions * flat).sum(axis=-1)  # [k*T]
+    keep = pos_in_expert < capacity
+    flat = flat * keep[:, None]
+
+    # back to [T, k, E]
+    per_k = jnp.stack(jnp.split(flat, top_k, axis=0), axis=1)  # [T, k, E]
+    per_k_pos = jnp.stack(jnp.split(pos_in_expert, top_k, axis=0), axis=1)  # [T, k]
+    if use_rts and rng is not None:
+        per_k = per_k[inv_order]
+        per_k_pos = per_k_pos[inv_order]
+
+    # renormalize kept gate values over k (reference top2: normalize by sum)
+    kept_gate = (per_k.sum(axis=-1) * topk_vals).astype(jnp.float32)  # [T, k]
+    denom = jnp.clip(kept_gate.sum(axis=-1, keepdims=True), 1e-9, None)
+    gate_w = kept_gate / denom
+
+    cap_oh = jax.nn.one_hot(per_k_pos.astype(jnp.int32), capacity, dtype=jnp.float32)  # [T,k,C]
+    combine = jnp.einsum("tk,tke,tkc->tec", gate_w, per_k, cap_oh)
+    dispatch = (combine > 0).astype(logits.dtype)
+    exp_counts = flat.sum(axis=0).astype(jnp.int32)
+    return l_aux.astype(jnp.float32), combine.astype(logits.dtype), dispatch, exp_counts
+
+
+class TopKGate(nn.Module):
+    """Gate module (reference ``TopKGate`` sharded_moe.py:449)."""
+
+    config: MoEConfig
+    model_dim: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        cfg = self.config
+        T = x.shape[0]
+        if cfg.noisy_gate_policy not in (None, "RSample", "Jitter"):
+            raise ValueError(f"unknown noisy_gate_policy {cfg.noisy_gate_policy!r}")
+        gate_in = x.astype(jnp.float32)
+        noisy = train and self.has_rng("dropout")
+        if noisy and cfg.noisy_gate_policy == "Jitter":
+            # multiplicative uniform jitter on the gate input (reference
+            # ``multiplicative_jitter`` in sharded_moe.py)
+            eps = 1e-2
+            gate_in = gate_in * jax.random.uniform(
+                self.make_rng("dropout"), gate_in.shape, minval=1.0 - eps, maxval=1.0 + eps
+            )
+        # gate math in fp32 (reference casts wg to fp32)
+        logits = nn.Dense(cfg.num_experts, use_bias=False, dtype=jnp.float32, name="wg")(gate_in)
+        if noisy and cfg.noisy_gate_policy == "RSample":
+            noise = jax.random.normal(self.make_rng("dropout"), logits.shape)
+            logits = logits + noise / cfg.num_experts
+        factor = cfg.capacity_factor if train else cfg.eval_capacity_factor
+        capacity = _capacity(T, cfg.num_experts, factor, cfg.min_capacity, cfg.top_k)
+        rng = self.make_rng("dropout") if (train and cfg.use_rts and self.has_rng("dropout")) else None
+        l_aux, combine, dispatch, _counts = top_k_gating(
+            logits, cfg.top_k, capacity, rng=rng, use_rts=cfg.use_rts and train,
+            drop_tokens=cfg.drop_tokens,
+        )
+        return l_aux, combine, dispatch
+
+
+class Experts(nn.Module):
+    """Stacked expert FFNs (reference ``Experts`` moe/experts.py:13).
+
+    Weights: [E, M, H] / [E, H, M], sharded over the ``ep`` mesh axis via the
+    partition rules below — grouped matmul over experts maps to one einsum.
+    """
+
+    num_experts: int
+    model_dim: int
+    hidden_dim: int
+    activation: str = "silu_glu"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:  # x: [E, C, M]
+        E, M, H = self.num_experts, self.model_dim, self.hidden_dim
+        init = nn.initializers.lecun_normal()
+        if self.activation == "silu_glu":
+            w_gate = self.param("w_gate", init, (E, M, H))
+            w_up = self.param("w_up", init, (E, M, H))
+            w_down = self.param("w_down", init, (E, H, M))
+            h = jax.nn.silu(jnp.einsum("ecm,emh->ech", x, w_gate.astype(self.dtype)))
+            h = h * jnp.einsum("ecm,emh->ech", x, w_up.astype(self.dtype))
+        else:
+            w_up = self.param("w_up", init, (E, M, H))
+            w_down = self.param("w_down", init, (E, H, M))
+            h = jax.nn.gelu(jnp.einsum("ecm,emh->ech", x, w_up.astype(self.dtype)))
+        return jnp.einsum("ech,ehm->ecm", h, w_down.astype(self.dtype))
+
+
+class MoELayer(nn.Module):
+    """MoE feed-forward layer (reference ``MoE`` moe/layer.py:17 + ``MOELayer``).
+
+    Input [B, S, M] -> (l_aux, output [B, S, M]). The einsum dispatch/combine
+    masks follow the reference; the all-to-all is the ``ep`` resharding of the
+    [E, C, M] activations.
+    """
+
+    config: MoEConfig
+    model_dim: int
+    hidden_dim: int
+    activation: str = "silu_glu"
+    dtype: jnp.dtype = jnp.float32
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        B, S, M = x.shape
+        tokens = x.reshape(B * S, M)
+        l_aux, combine, dispatch = TopKGate(self.config, M, name="gate")(tokens, self.train)
+        # dispatch: [T, E, C] x [T, M] -> [E, C, M], then shard E over ep
+        expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(self.dtype), tokens)
+        expert_in = _ep_constrain(expert_in, P("ep", None, None))  # all-to-all in
+        expert_out = Experts(
+            self.config.num_experts, M, self.hidden_dim, self.activation, self.dtype, name="experts"
+        )(expert_in)
+        expert_out = _ep_constrain(expert_out, P("ep", None, None))
+        out = jnp.einsum("tec,ecm->tm", combine.astype(self.dtype), expert_out)
+        # returned aux loss is already weighted — callers add it to their loss
+        return self.config.aux_loss_weight * l_aux, out.reshape(B, S, M)
+
+
+def moe_partition_rules(path: str, shape: tuple) -> Optional[P]:
+    """Expert weights shard over 'ep'; gate stays replicated."""
+
+    def has(token: str) -> bool:
+        return f"'{token}'" in path
+
+    if has("experts") and (has("w_gate") or has("w_up") or has("w_down")):
+        pad = len(shape) - 3
+        return P(*([None] * pad + ["ep", None, None])) if pad >= 0 else None
+    return None

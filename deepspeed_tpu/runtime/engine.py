@@ -397,6 +397,7 @@ class DeepSpeedTPUEngine:
         """
         if (batch is None) == (data_iter is None):
             raise ValueError("provide exactly one of batch= or data_iter=")
+        set_mesh(self.mesh)  # models read the active mesh at trace time
         if batch is not None:
             placed = self._shard_global_batch(batch)
         else:
@@ -424,6 +425,7 @@ class DeepSpeedTPUEngine:
     # --- forward / backward / step parity path ----------------------------
     def forward(self, batch: Any) -> Any:
         """Inference/eval forward returning model outputs (loss by default)."""
+        set_mesh(self.mesh)
         if self._eval_step is None:
             def eval_fn(params, batch, rng):
                 loss, aux = self._loss_and_aux(self._compute_params(params), batch, jax.random.wrap_key_data(rng))
@@ -444,6 +446,7 @@ class DeepSpeedTPUEngine:
         recomputes forward+backward for the micro-batch (``batch`` or the one
         passed to the last ``forward``). ``train_batch`` is the efficient path.
         """
+        set_mesh(self.mesh)
         if batch is None:
             batch = getattr(self, "_last_batch", None)
             if batch is None:
