@@ -279,6 +279,107 @@ class CausalLM(nn.Module):
         return loss, logits
 
 
+# --------------------------------------------------- pipelined execution
+def _embed_tokens(params, cfg: TransformerConfig, ids):
+    """Functional twin of the embedding front-end of ``CausalLM.__call__``."""
+    x = jnp.take(params["embed"]["embedding"], ids, axis=0).astype(cfg.dtype)
+    if cfg.position == "learned":
+        x = x + params["pos_embed"][None, : ids.shape[1], :].astype(cfg.dtype)
+    return x
+
+
+def _apply_norm(norm_params, cfg: TransformerConfig, x):
+    """Functional twin of ``_norm`` (RMSNorm / flax LayerNorm)."""
+    if cfg.norm == "rmsnorm":
+        from deepspeed_tpu.ops import rms_norm
+
+        return rms_norm(x, norm_params["scale"], eps=cfg.norm_eps)
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
+    y = y * norm_params["scale"].astype(jnp.float32) + norm_params["bias"].astype(jnp.float32)
+    return y.astype(cfg.dtype)
+
+
+def _lm_head_and_loss(params, cfg: TransformerConfig, x, batch, aux):
+    x = _apply_norm(params["final_norm"], cfg, x)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["embedding"].T.astype(cfg.dtype)
+    else:
+        logits = x @ params["lm_head"]["kernel"].astype(cfg.dtype)
+    ids = batch["input_ids"]
+    labels = batch.get("labels")
+    if labels is None:
+        B = ids.shape[0]
+        labels = jnp.concatenate([ids[:, 1:], jnp.full((B, 1), -100, dtype=ids.dtype)], axis=1)
+    loss = cross_entropy_loss(logits, labels, batch.get("attention_mask"))
+    if cfg.num_experts > 0:
+        loss = loss + aux / cfg.num_layers
+    return loss, logits
+
+
+def pipelined_causal_lm_loss(params, batch, rng, *, config: TransformerConfig,
+                             num_microbatches: int, mesh, train: bool = True):
+    """CausalLM forward+loss with the layer stack executed as an SPMD pipeline
+    over the ``pp`` mesh axis (see ``parallel/pipeline_spmd.spmd_pipeline``).
+
+    Embedding and the LM head run outside the pipeline (replicated over pp,
+    sharded over dp/tp as usual); the batch splits into ``num_microbatches``
+    along dim 0. For dense models this is numerically identical to the
+    unpipelined model (same param tree; dropout patterns differ). For MoE
+    models, gate capacity and the load-balancing aux loss are computed
+    per-microbatch rather than over the full batch — the same per-microbatch
+    routing semantics the reference has under gradient accumulation.
+    """
+    from deepspeed_tpu.parallel.pipeline_spmd import spmd_pipeline
+
+    cfg = config
+    if not cfg.scan_layers:
+        raise ValueError("pipelined execution requires scan_layers=True (stacked layer params)")
+    M = num_microbatches
+    ids = batch["input_ids"]
+    B, S = ids.shape
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by pipeline microbatches {M}")
+    positions = batch.get("position_ids")
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    pad_mask = batch.get("attention_mask")
+
+    x = _embed_tokens(params, cfg, ids)
+    split = lambda v: v.reshape((M, B // M) + v.shape[1:])
+    # Activations + aux ride the ring; mask/positions are stage-invariant and
+    # go through side_stream (indexed locally, no inter-stage comm).
+    stream = (split(x), jnp.zeros((M,), jnp.float32))
+    side = (None if pad_mask is None else split(pad_mask), split(positions))
+
+    block = Block(cfg, train)
+
+    def stage_fn(stage_layers, carry, side, srng):
+        x, aux = carry
+        mask, pos = side
+        n_local = jax.tree_util.tree_leaves(stage_layers)[0].shape[0]
+        rngs = jax.random.split(srng, n_local)
+
+        def body(c, xs):
+            lp, r = xs
+            c2, _ = block.apply({"params": lp}, c, rngs={"dropout": r})
+            return c2, None
+
+        if cfg.remat:
+            body = jax.checkpoint(body, prevent_cse=False)
+        (x, _, _, aux), _ = jax.lax.scan(body, (x, mask, pos, aux), (stage_layers, rngs))
+        return (x, aux)
+
+    x_out, aux = spmd_pipeline(
+        stage_fn, params["layers"], stream, mesh=mesh, rng=rng, side_stream=side
+    )
+    x_full = x_out.reshape((B,) + x_out.shape[2:])
+    # Equal-size microbatches: mean of per-microbatch means == full-batch mean.
+    return _lm_head_and_loss(params, cfg, x_full, batch, aux.mean())
+
+
 def cross_entropy_loss(logits, labels, pad_mask=None, ignore_index: int = -100):
     """Mean token cross entropy in fp32 with ignore mask."""
     logits = logits.astype(jnp.float32)
@@ -345,8 +446,34 @@ def causal_lm_partition_rules(path: str, shape: tuple) -> Optional[P]:
     return None
 
 
-def causal_lm_spec(config: TransformerConfig, example_seq_len: int = 8) -> ModelSpec:
-    """Build the engine-facing ModelSpec for a CausalLM."""
+def pipeline_partition_rules(path: str, shape: tuple) -> Optional[P]:
+    """Partition rules with the stacked layer dim sharded over ``pp``.
+
+    Composes with the tp rules (which are right-aligned, leaving dim 0 free on
+    scanned-layer leaves). With a pp=1 mesh the ``pp`` entry is a no-op, so
+    these rules are safe unconditionally for pipelined specs.
+    """
+    base = causal_lm_partition_rules(path, shape)
+    if "'layers'" in path:
+        entries = list(base) if base is not None else []
+        entries += [None] * (len(shape) - len(entries))
+        if entries and entries[0] is None:
+            entries[0] = "pp"
+        return P(*entries)
+    return base
+
+
+def causal_lm_spec(
+    config: TransformerConfig,
+    example_seq_len: int = 8,
+    pipeline_microbatches: int = 0,
+) -> ModelSpec:
+    """Build the engine-facing ModelSpec for a CausalLM.
+
+    ``pipeline_microbatches > 1`` enables pipelined execution of the layer
+    stack over the mesh's ``pp`` axis (reference ``PipelineModule`` +
+    ``PipelineEngine`` path); with pp == 1 the plain forward is used.
+    """
     module = CausalLM(config)
     example = {"input_ids": jnp.zeros((2, example_seq_len), jnp.int32)}
 
@@ -355,6 +482,15 @@ def causal_lm_spec(config: TransformerConfig, example_seq_len: int = 8) -> Model
         return module.init({"params": p_rng, "dropout": d_rng}, example, train=False)["params"]
 
     def loss_fn(params, batch, rng):
+        if pipeline_microbatches > 1:
+            from deepspeed_tpu.topology.mesh import get_mesh, has_mesh
+
+            if has_mesh() and get_mesh().shape["pp"] > 1:
+                return pipelined_causal_lm_loss(
+                    params, batch, rng, config=config,
+                    num_microbatches=pipeline_microbatches,
+                    mesh=get_mesh(), train=True,
+                )
         return module.apply({"params": params}, batch, train=True, rngs={"dropout": rng})
 
     def apply_fn(params, batch):
@@ -365,5 +501,5 @@ def causal_lm_spec(config: TransformerConfig, example_seq_len: int = 8) -> Model
         loss_fn=loss_fn,
         apply_fn=apply_fn,
         name=f"CausalLM({config.hidden_size}x{config.num_layers})",
-        partition_rules=causal_lm_partition_rules,
+        partition_rules=pipeline_partition_rules if pipeline_microbatches > 1 else causal_lm_partition_rules,
     )

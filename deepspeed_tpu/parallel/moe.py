@@ -51,7 +51,10 @@ def _ep_constrain(x: jax.Array, spec: P) -> jax.Array:
 
 
 def _capacity(num_tokens: int, num_experts: int, factor: float, min_capacity: int, top_k: int) -> int:
-    cap = int(num_tokens * top_k * factor / num_experts)
+    import math
+
+    # ceil, matching the reference's _capacity (sharded_moe.py ceil semantics)
+    cap = math.ceil(num_tokens * top_k * factor / num_experts)
     return max(cap, min_capacity)
 
 

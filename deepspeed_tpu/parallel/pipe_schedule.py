@@ -1,0 +1,178 @@
+"""Pipeline instruction schedules (host-side, for parity/introspection).
+
+API parity with ``runtime/pipe/schedule.py`` (``PipeSchedule`` :189 base,
+``InferenceSchedule``, ``TrainSchedule``): generators yielding per-tick
+instruction lists for a given (micro_batches, stages, stage_id). On TPU the
+compiled SPMD pipeline (``pipeline_spmd.spmd_pipeline``) executes the whole
+schedule inside one XLA program, so these classes are NOT an execution engine;
+they exist to (a) document/verify the tick→microbatch mapping the compiled
+loop implements, (b) drive schedule-visualization and debugging tools, and
+(c) keep the reference's public schedule API importable.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    """Base instruction (reference ``schedule.py:327``)."""
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{type(self).__name__}({args})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    pass
+
+
+class ForwardPass(PipeInstruction):
+    pass
+
+
+class BackwardPass(PipeInstruction):
+    pass
+
+
+class SendActivation(PipeInstruction):
+    pass
+
+
+class RecvActivation(PipeInstruction):
+    pass
+
+
+class SendGrad(PipeInstruction):
+    pass
+
+
+class RecvGrad(PipeInstruction):
+    pass
+
+
+class PipeSchedule:
+    """Schedule generator base (reference ``schedule.py:11``)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        if not 0 <= stage_id < stages:
+            raise ValueError(f"stage_id {stage_id} out of range for {stages} stages")
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    @property
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    @property
+    def stage(self) -> int:
+        return self.stage_id
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def __iter__(self):
+        return self.steps()
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only fill-and-drain (reference ``schedule.py:86``).
+
+    This is exactly the tick mapping of the compiled SPMD pipeline: at tick t,
+    stage i runs forward on microbatch t - i when 0 <= t - i < M.
+    """
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for t in range(total_steps):
+            cmds: List[PipeInstruction] = []
+            micro_batch_id = t - self.stage_id
+            active = 0 <= micro_batch_id < self.micro_batches
+            if active:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=micro_batch_id % 2))
+                else:
+                    cmds.append(RecvActivation(buffer_id=micro_batch_id % 2))
+                cmds.append(ForwardPass(buffer_id=micro_batch_id % 2))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=micro_batch_id % 2))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B train schedule (reference ``schedule.py:189``).
+
+    Forward ticks fill, then forwards and backwards interleave one-for-one,
+    then backwards drain; ends with grad reduction + optimizer step. The
+    compiled pipeline realizes the same dependency order via XLA's reverse-mode
+    scan; peak live microbatches per stage matches ``num_pipe_buffers``.
+    """
+
+    def steps(self):
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        fwd_id, bwd_id = 0, 0
+        for step_id in range(total_steps):
+            cmds: List[PipeInstruction] = []
+            is_fwd = self._is_forward_tick(step_id)
+            if is_fwd and fwd_id < self.micro_batches:
+                buf = fwd_id % self.num_pipe_buffers
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=buf))
+                else:
+                    cmds.append(RecvActivation(buffer_id=buf))
+                cmds.append(ForwardPass(buffer_id=buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=buf))
+                fwd_id += 1
+            elif (not is_fwd) and bwd_id < fwd_id:
+                buf = bwd_id % self.num_pipe_buffers
+                if not self.is_last_stage:
+                    cmds.append(RecvGrad(buffer_id=buf))
+                cmds.append(BackwardPass(buffer_id=buf))
+                if not self.is_first_stage:
+                    cmds.append(SendGrad(buffer_id=buf))
+                bwd_id += 1
+            yield cmds
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+    def _is_forward_tick(self, step_id: int) -> bool:
+        # Offset by stage depth so forwards/backwards interleave 1F1B-style
+        # (reference ``_step_to_micro_batch`` even/odd logic, schedule.py:262).
+        offset = self.stages - self.stage_id - 1
+        return ((step_id + offset) % 2) == 0
+
+    @property
+    def num_pipe_buffers(self) -> int:
+        # In-flight microbatches at this stage's steady state (reference :236).
+        return min(self.stages - self.stage_id + 1, self.micro_batches)

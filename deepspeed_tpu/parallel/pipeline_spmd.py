@@ -1,0 +1,155 @@
+"""Compiled SPMD pipeline parallelism.
+
+TPU-native replacement for the reference's interpreted 1F1B instruction
+schedule (``runtime/pipe/engine.py:61`` ``PipelineEngine._exec_schedule``,
+``runtime/pipe/schedule.py:189`` ``TrainSchedule``, ``runtime/pipe/p2p.py``).
+
+The reference runs a per-rank Python loop issuing torch p2p sends/recvs per
+microbatch. On TPU the whole pipeline is ONE jitted program: stage parameters
+are sharded over the ``pp`` mesh axis, and a ``lax.scan`` over schedule ticks
+moves activations between neighbor stages with ``lax.ppermute`` (collective
+permute rides the ICI torus). Backward-through-the-scan gives the reverse
+pipeline schedule automatically — XLA schedules the backward ppermutes the
+same way the reference interprets ``SendGrad/RecvGrad`` instructions.
+
+Schedule: GPipe-style fill-and-drain over ``T = M + S - 1`` ticks (M
+microbatches, S stages). At tick ``t`` stage ``i`` processes microbatch
+``t - i`` (when valid). Activation memory matches 1F1B's steady state when
+``stage_fn`` is rematerialized (``jax.checkpoint``), because XLA frees
+per-tick activations after each backward tick.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+# stage_fn(stage_params, carry, rng) -> carry
+StageFn = Callable[[Any, Any, jax.Array], Any]
+
+
+def spmd_pipeline(
+    stage_fn: StageFn,
+    stage_params: Any,
+    stream: Any,
+    *,
+    mesh: Mesh,
+    rng: jax.Array,
+    side_stream: Any = None,
+) -> Any:
+    """Run ``stage_fn`` as a pipeline over the ``pp`` mesh axis.
+
+    Args:
+      stage_fn: processes ONE microbatch through ONE stage's layers. Called as
+        ``stage_fn(local_params, carry, rng)`` — or, when ``side_stream`` is
+        given, ``stage_fn(local_params, carry, side, rng)``. Receives the
+        stage-local slice of ``stage_params`` (leading layer dim divided by
+        the number of stages).
+      stage_params: pytree whose leaves are stacked per-layer ``[L, ...]``;
+        dim 0 is sharded over ``pp`` (L % pp_size == 0).
+      stream: microbatch carry stream pytree, leaves ``[M, ...]``; replicated
+        over ``pp`` (may be sharded over other mesh axes, e.g. batch over dp).
+        These leaves travel stage-to-stage through the ring.
+      mesh: the device mesh with a ``pp`` axis.
+      rng: base PRNG key; folded per tick for dropout.
+      side_stream: optional pytree of per-microbatch inputs ``[M, ...]`` that
+        are *invariant across stages* (e.g. attention masks, positions). They
+        are indexed locally per tick instead of riding the ppermute ring, so
+        they cost no inter-stage communication.
+
+    Returns:
+      Pytree of ``[M, ...]`` last-stage outputs (of the carry stream only),
+      replicated over ``pp``.
+
+    Must be called under ``jax.jit`` (the engine always does): eager dispatch
+    of partial-manual shard_map trips an upstream jax check in this version.
+    """
+    S = mesh.shape["pp"]
+    M = jax.tree_util.tree_leaves(stream)[0].shape[0]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] % S:
+            raise ValueError(
+                f"stacked layer dim {leaf.shape[0]} not divisible by pp={S}; "
+                f"choose num_layers divisible by the pp mesh axis"
+            )
+
+    def call_stage(params, carry, side, r):
+        if side_stream is None:
+            return stage_fn(params, carry, r)
+        return stage_fn(params, carry, side, r)
+
+    def side_at(side, idx):
+        return jax.tree_util.tree_map(lambda v: v[jnp.clip(idx, 0, M - 1)], side)
+
+    if S == 1:
+        def body(_, xs):
+            mb, t = xs
+            side = side_at(side_stream, t) if side_stream is not None else None
+            return (), call_stage(stage_params, mb, side, jax.random.fold_in(rng, t))
+
+        _, out = lax.scan(body, (), (stream, jnp.arange(M)))
+        return out
+
+    T = M + S - 1
+    perm = [(j, (j + 1) % S) for j in range(S)]
+
+    def run(params, stream, side_stream, rng):
+        i = lax.axis_index("pp")
+
+        # Pad the stream with S-1 drain ticks (zeros; dead compute is masked).
+        def pad(x):
+            return jnp.concatenate([x, jnp.zeros((S - 1,) + x.shape[1:], x.dtype)], axis=0)
+
+        padded = jax.tree_util.tree_map(pad, stream)
+        zero_carry = jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape[1:], x.dtype), stream)
+        out_init = jax.tree_util.tree_map(jnp.zeros_like, stream)
+
+        def tick(carry, xs):
+            recv, out_buf = carry
+            mb, t = xs
+            # Stage 0 ingests the next microbatch; others consume the permuted
+            # activation from their predecessor (reference RecvActivation).
+            x = jax.tree_util.tree_map(lambda a, b: jnp.where(i == 0, a, b), mb, recv)
+            # Stage i processes microbatch t-i: index its side inputs locally.
+            side = side_at(side_stream, t - i) if side_stream is not None else None
+            y = call_stage(params, x, side, jax.random.fold_in(rng, t))
+            # Last stage commits microbatch t-(S-1) to the output buffer.
+            mb_idx = t - (S - 1)
+            write = (i == S - 1) & (mb_idx >= 0)
+            idx = jnp.maximum(mb_idx, 0)
+            out_buf = jax.tree_util.tree_map(
+                lambda buf, yv: jnp.where(
+                    write,
+                    lax.dynamic_update_slice_in_dim(buf, yv[None].astype(buf.dtype), idx, 0),
+                    buf,
+                ),
+                out_buf,
+                y,
+            )
+            # Shift activations to the next stage (reference SendActivation).
+            recv = jax.tree_util.tree_map(lambda v: lax.ppermute(v, "pp", perm), y)
+            return (recv, out_buf), None
+
+        (_, out_buf), _ = lax.scan(tick, (zero_carry, out_init), (padded, jnp.arange(T)))
+        # Only the last stage holds real outputs; broadcast to all pp ranks.
+        return jax.tree_util.tree_map(
+            lambda v: lax.psum(jnp.where(i == S - 1, v, jnp.zeros_like(v)), "pp"), out_buf
+        )
+
+    return jax.shard_map(
+        run,
+        mesh=mesh,
+        axis_names={"pp"},
+        in_specs=(P("pp"), P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stage_params, stream, side_stream, rng)
+
+
+def pipeline_bubble_fraction(num_microbatches: int, num_stages: int) -> float:
+    """Idle fraction of the fill-and-drain schedule: (S-1)/(M+S-1)."""
+    return (num_stages - 1) / (num_microbatches + num_stages - 1)
