@@ -1,0 +1,22 @@
+"""deepspeed_tpu.comm: collectives facade + telemetry.
+
+Reference analog: ``deepspeed/comm`` (``comm/comm.py`` module-level collectives,
+``utils/comms_logging.py`` CommsLogger). See ``comm/comm.py`` here for the
+design mapping onto XLA in-program collectives.
+"""
+
+from deepspeed_tpu.comm.comm import (
+    CommsLogger,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    comms_logger,
+    get_rank,
+    get_world_size,
+    init_distributed,
+    log_summary,
+    ppermute,
+    reduce_scatter,
+)

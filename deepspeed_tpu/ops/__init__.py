@@ -10,6 +10,7 @@ from deepspeed_tpu.ops.registry import available_impls, dispatch, op_report, reg
 from deepspeed_tpu.ops.attention import causal_attention
 from deepspeed_tpu.ops.norms import layer_norm, rms_norm
 from deepspeed_tpu.ops.rope import rope
+from deepspeed_tpu.ops.quant import dequantize_int8, quantize_int8
 
 # Pallas kernels register themselves when importable (TPU or interpret mode).
 try:  # pragma: no cover - exercised on TPU

@@ -1,0 +1,14 @@
+"""Pallas TPU kernels.
+
+``register_all()`` imports each kernel module, which registers its 'pallas'
+implementations in the op registry (``deepspeed_tpu/ops/registry.py``). Each
+module is the TPU-native answer to a CUDA kernel family in the reference
+(cited per-module). On non-TPU backends the kernels run in interpreter mode
+so the same code paths are exercised by the CPU test harness.
+"""
+
+
+def register_all() -> None:
+    from deepspeed_tpu.ops.pallas import flash_attention  # noqa: F401
+    from deepspeed_tpu.ops.pallas import norms  # noqa: F401
+    from deepspeed_tpu.ops.pallas import quantizer  # noqa: F401

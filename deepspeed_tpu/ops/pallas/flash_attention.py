@@ -1,0 +1,375 @@
+"""Flash attention as a Pallas TPU kernel (fwd + custom-VJP bwd).
+
+TPU-native replacement for the reference's attention kernel families:
+``csrc/transformer/inference/csrc/softmax.cu`` (masked/alibi softmax),
+``csrc/transformer/softmax_kernels.cu`` and the Triton flash variants
+(``deepspeed/ops/transformer/inference/triton/attention.py``). Design is
+blockwise online-softmax (Flash-Attention-2 style): the score matrix is never
+materialized in HBM; K/V stream through VMEM in (block_k x head_dim) tiles
+while running max/denominator/accumulator live in VMEM scratch.
+
+Layout: inputs are [B, S, H, D] (framework-native); the kernel works on
+[B, H, S, D]. GQA/MQA is handled in the index maps (kv head = q head // G),
+so grouped heads re-read the same KV tile — no KV replication in HBM.
+
+Causality and padding are one combined mask: the wrapper always passes a
+[B, S] keep-mask (ones when the caller gave none) and pads S up to the block
+size with zeros, so in-kernel there is a single masking path.
+
+Grid is (B, H, num_q_blocks, num_kv_blocks) — the last axis iterates
+sequentially per TPU core, accumulating into scratch, writing out on the last
+kv step. Blocks strictly above the diagonal write nothing and skip the matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.ops.registry import register
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+DEFAULT_BLOCK_Q = 128
+_LANES = 8  # lse/delta lane width in HBM (block last dim == array last dim satisfies Mosaic tiling); m/l scratch pad internally
+DEFAULT_BLOCK_K = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _vma(*arrays):
+    """Union of varying-manual-axes of the inputs: propagated to out_shape so
+    the kernels compose inside shard_map (jax>=0.9 check_vma)."""
+    vma = frozenset()
+    for a in arrays:
+        vma = vma | getattr(jax.typeof(a), "vma", frozenset())
+    return vma
+
+
+def _cdiv(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, block_q, block_k, causal):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        q = q_ref[0, 0]  # [block_q, D]  (pre-scaled by 1/sqrt(D))
+        k = k_ref[0, 0]  # [block_k, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+
+        keep = mask_ref[0, 0, :] > 0  # [block_k] padding keep-mask
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            keep = keep[None, :] & (cols <= rows)
+        else:
+            keep = jnp.broadcast_to(keep[None, :], s.shape)
+        s = jnp.where(keep, s, _NEG_INF)
+
+        m_prev = jnp.max(m_ref[:], axis=-1, keepdims=True)  # [block_q, 1] (lanes equal)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # All-masked rows keep m at -inf; guard exp against (-inf) - (-inf).
+        m_safe = jnp.where(m_cur == _NEG_INF, 0.0, m_cur)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(keep, p, 0.0)
+
+        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
+        l_prev = jnp.max(l_ref[:], axis=-1, keepdims=True)
+        l_cur = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        # Lower-triangular block band only (diag included); skip above-diagonal.
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = jnp.max(l_ref[:], axis=-1, keepdims=True)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        m = jnp.max(m_ref[:], axis=-1, keepdims=True)
+        # logsumexp per row (lane-broadcast); fully-masked rows get -inf.
+        lse = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _flash_fwd(q, k, v, mask, block_q: int, block_k: int, causal: bool):
+    """q,k,v: [B, H(q/kv), S, D] (q pre-scaled). mask: [B, S] int32. Returns (out, lse)."""
+    B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    G = H // Hkv
+    nq, nk = _cdiv(S, block_q), _cdiv(S, block_k)
+
+    grid = (B, H, nq, nk)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_k), lambda b, h, qi, ki: (b, 0, ki)),  # mask
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype, vma=_vma(q, k, v, mask)),
+            jax.ShapeDtypeStruct((B, H, S, _LANES), jnp.float32, vma=_vma(q, k, v, mask)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(mask, q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref, *, block_q, block_k, causal):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+
+        keep = mask_ref[0, 0, :] > 0
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            keep = keep[None, :] & (cols <= rows)
+        else:
+            keep = jnp.broadcast_to(keep[None, :], s.shape)
+
+        lse = jnp.max(lse_ref[0, 0], axis=-1, keepdims=True)  # [block_q, 1]
+        p = jnp.where(keep, jnp.exp(s - jnp.where(lse == _NEG_INF, 0.0, lse)), 0.0)
+        dp = jax.lax.dot_general(
+            do_ref[0, 0].astype(jnp.float32), v_ref[0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - jnp.max(delta_ref[0, 0], axis=-1, keepdims=True))
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k, causal):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+
+        keep = mask_ref[0, 0, :] > 0
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            keep = keep[None, :] & (cols <= rows)
+        else:
+            keep = jnp.broadcast_to(keep[None, :], s.shape)
+
+        lse = jnp.max(lse_ref[0, 0], axis=-1, keepdims=True)
+        p = jnp.where(keep, jnp.exp(s - jnp.where(lse == _NEG_INF, 0.0, lse)), 0.0)
+        do = do_ref[0, 0].astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - jnp.max(delta_ref[0, 0], axis=-1, keepdims=True))
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, mask, out, lse, do, block_q: int, block_k: int, causal: bool):
+    B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    G = H // Hkv
+    nq, nk = _cdiv(S, block_q), _cdiv(S, block_k)
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,S]
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k, causal=causal),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_k), lambda b, h, qi, ki: (b, 0, ki)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), jnp.float32, vma=_vma(q, k, v, mask, do)),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=_interpret(),
+    )(mask, q, k, v, do, lse, delta)
+
+    # dk/dv are per *query* head here; grouped heads are summed below.
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k, causal=causal),
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_k), lambda b, h, ki, qi: (b, 0, ki)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h // G, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h // G, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), jnp.float32, vma=_vma(q, k, v, mask, do)),
+            jax.ShapeDtypeStruct((B, H, S, D), jnp.float32, vma=_vma(q, k, v, mask, do)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(mask, q, k, v, do, lse, delta)
+
+    if G > 1:
+        dk = dk.reshape(B, Hkv, G, S, D).sum(axis=2)
+        dv = dv.reshape(B, Hkv, G, S, D).sum(axis=2)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public op: [B, S, H, D] layout, custom VJP, padding + causal handling
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_attention(q, k, v, mask, block_q, block_k, causal):
+    out, _ = _flash_core(q, k, v, mask, block_q, block_k, causal)
+    return out
+
+
+def _flash_core(q, k, v, mask, block_q, block_k, causal):
+    scale = q.shape[-1] ** -0.5
+    qs = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)  # [B,H,S,D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out, lse = _flash_fwd(qs, kt, vt, mask, block_q, block_k, causal)
+    return out.transpose(0, 2, 1, 3), (qs, kt, vt, lse, out)
+
+
+def _flash_vjp_fwd(q, k, v, mask, block_q, block_k, causal):
+    out, (qs, kt, vt, lse, out_bhsd) = _flash_core(q, k, v, mask, block_q, block_k, causal)
+    return out, (qs, kt, vt, mask, lse, out_bhsd)
+
+
+def _flash_vjp_bwd(block_q, block_k, causal, res, g):
+    qs, kt, vt, mask, lse, out_bhsd = res
+    do = g.transpose(0, 2, 1, 3)
+    dq, dk, dv = _flash_bwd(qs, kt, vt, mask, out_bhsd, lse, do, block_q, block_k, causal)
+    scale = qs.shape[-1] ** -0.5
+    dq = (dq * scale).transpose(0, 2, 1, 3).astype(qs.dtype)
+    dk = dk.transpose(0, 2, 1, 3).astype(kt.dtype)
+    dv = dv.transpose(0, 2, 1, 3).astype(vt.dtype)
+    return dq, dk, dv, None
+
+
+_flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@register("causal_attention", "pallas")
+def flash_causal_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, S, Hkv, D]
+    v: jax.Array,  # [B, S, Hkv, D]
+    mask: Optional[jax.Array] = None,  # [B, S] 1=keep
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jax.Array:
+    B, S, H, D = q.shape
+    block_q = min(block_q, max(S, 8))
+    block_k = min(block_k, max(S, 8))
+    Sp = _cdiv(S, max(block_q, block_k)) * max(block_q, block_k)
+
+    keep = jnp.ones((B, S), jnp.int32) if mask is None else mask.astype(jnp.int32)
+    if Sp != S:
+        pad = Sp - S
+        q = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        keep = jnp.pad(keep, ((0, 0), (0, pad)))
+
+    out = _flash_attention(q, k, v, keep[:, None, :], block_q, block_k, True)
+    return out[:, :S]

@@ -1,0 +1,95 @@
+"""Quantized collectives (ZeRO++ analogs) composed inside shard_map.
+
+Reference analog: ``deepspeed/runtime/comm/coalesced_collectives.py`` —
+``all_to_all_quant_reduce`` (:31, qgZ: quantize grads, 2-hop all-to-all,
+dequant-reduce) and the qwZ quantized weight allgather
+(``zero/partition_parameters.py:1200`` ``all_gather_coalesced(quantize=True)``),
+backed by ``csrc/quantization/swizzled_quantize.cu`` / ``quant_reduce.cu``.
+
+TPU-native redesign: quantization is the Pallas/XLA int8 block quantizer
+(``ops/quant.py``) and the communication is a plain ``jax.lax`` collective the
+compiler schedules over ICI — the "2-hop intra-then-inter node" trick in the
+reference exists because NCCL trees are latency-bound across nodes; on a TPU
+slice XLA already routes all_to_all over ICI optimally, and on multi-slice
+meshes the hierarchical hop falls out of splitting the axis (ici x dcn) in the
+mesh rather than hand-written kernels.
+
+Blocking invariant: quantization blocks never straddle a shard boundary — each
+destination shard is padded up to a whole number of blocks before quantization
+so the (values, scales) pairs stay aligned through the collective.
+
+These functions must run inside ``shard_map`` (axis names bound). Comm volume:
+int8 values + one f32 scale per block ~= 4x reduction vs f32, 2x vs bf16.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.comm import comm as dist
+from deepspeed_tpu.ops.quant import dequantize_int8, quantize_int8
+
+DEFAULT_BLOCK = 2048
+
+
+def _padded(n: int, block: int) -> int:
+    return -(-n // block) * block
+
+
+def quantized_reduce_scatter(grad: jax.Array, axis: str, block_size: int = DEFAULT_BLOCK) -> jax.Array:
+    """qgZ analog: int8-quantized gradient reduce-scatter over ``axis``.
+
+    Input: full local gradient [N] (N divisible by axis size). Output: this
+    rank's reduced shard [N / world], averaged over ranks. Exact math:
+    quantize per destination shard -> all_to_all -> dequantize -> mean.
+    """
+    n = jax.lax.axis_size(axis)
+    flat = grad.reshape(-1)
+    N = flat.shape[0]
+    assert N % n == 0, f"grad numel {N} not divisible by axis size {n}"
+    shard = N // n
+    block = min(block_size, shard)
+    shard_p = _padded(shard, block)  # blocks stay within one destination shard
+    rows = flat.reshape(n, shard)
+    if shard_p != shard:
+        rows = jnp.pad(rows, ((0, 0), (0, shard_p - shard)))
+
+    vals, scales = quantize_int8(rows, block_size=block)  # row-aligned: shard_p % block == 0
+    vals = vals.reshape(n, shard_p)
+    scales = scales.reshape(n, shard_p // block)
+
+    # Each rank receives every peer's int8 copy of *its* shard (+ scales).
+    vals_t = dist.all_to_all(vals, axis, split_axis=0, concat_axis=0)  # [n, shard_p]
+    scales_t = dist.all_to_all(scales, axis, split_axis=0, concat_axis=0)
+
+    deq = dequantize_int8(
+        vals_t.reshape(-1), scales_t.reshape(-1), (n, shard_p), dtype=jnp.float32,
+        block_size=block,
+    )
+    return jnp.mean(deq[:, :shard], axis=0).astype(grad.dtype)
+
+
+def quantized_all_gather(x: jax.Array, axis: str, block_size: int = DEFAULT_BLOCK) -> jax.Array:
+    """qwZ analog: int8-quantized weight allgather over ``axis``.
+
+    Input: local shard [M]; output: dequantized full buffer [world * M] in
+    x.dtype. Halves (vs bf16) the allgather bytes on the wire.
+    """
+    flat = x.reshape(-1)
+    M = flat.shape[0]
+    block = min(block_size, M)
+    M_p = _padded(M, block)
+    if M_p != M:
+        flat = jnp.pad(flat, (0, M_p - M))
+
+    vals, scales = quantize_int8(flat, block_size=block)
+    # Gather the *padded* blocked buffers so per-rank block boundaries survive.
+    vals_g = dist.all_gather(vals.reshape(1, M_p), axis, concat_axis=0)  # [n, M_p]
+    scales_g = dist.all_gather(scales.reshape(1, -1), axis, concat_axis=0)
+    n = jax.lax.axis_size(axis)
+    deq = dequantize_int8(
+        vals_g.reshape(-1), scales_g.reshape(-1), (n, M_p), dtype=x.dtype,
+        block_size=block,
+    )
+    return deq[:, :M].reshape(n * M)
