@@ -1,0 +1,60 @@
+"""Inference config (reference ``deepspeed/inference/config.py`` —
+``DeepSpeedInferenceConfig`` pydantic model, tp via ``DeepSpeedTPConfig``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from pydantic import Field
+
+from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
+
+_DTYPES = {
+    "bf16": jnp.bfloat16,
+    "bfloat16": jnp.bfloat16,
+    "fp16": jnp.float16,
+    "float16": jnp.float16,
+    "half": jnp.float16,
+    "fp32": jnp.float32,
+    "float32": jnp.float32,
+    "int8": jnp.int8,
+}
+
+
+class TPConfig(DeepSpeedConfigModel):
+    """Tensor-parallel sizing (reference ``DeepSpeedTPConfig``)."""
+
+    enabled: bool = True
+    tp_size: int = 1
+
+
+class QuantConfig(DeepSpeedConfigModel):
+    """Weight-only quantization (reference ``QuantizationConfig`` — int8 woq)."""
+
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 128
+
+
+class InferenceConfig(DeepSpeedConfigModel):
+    """Reference ``DeepSpeedInferenceConfig`` (inference/config.py:77)."""
+
+    dtype: str = "bf16"
+    tensor_parallel: TPConfig = Field(default_factory=TPConfig)
+    quant: QuantConfig = Field(default_factory=QuantConfig)
+    max_out_tokens: int = 1024  # hard cap on generate(max_new_tokens=...)
+    min_out_tokens: int = 1  # reserved (reference scheduler admission knob)
+    max_batch_size: Optional[int] = None  # hard cap on generate batch size
+    replace_with_kernel_inject: bool = True  # accepted for parity; Pallas ops
+    # are selected via the ops registry rather than module swapping
+    seq_bucket: int = 64  # pad prompt lengths up to a multiple (compile reuse)
+    kv_cache_dtype: Optional[str] = None  # default: same as dtype
+
+    @property
+    def jax_dtype(self) -> Any:
+        return _DTYPES[self.dtype.lower()]
+
+    @property
+    def kv_dtype(self) -> Any:
+        return _DTYPES[(self.kv_cache_dtype or self.dtype).lower()]
