@@ -29,12 +29,10 @@ from deepspeed_tpu.inference.config import InferenceConfig
 from deepspeed_tpu.inference.model import KVCache, decode_step, init_cache, prefill
 from deepspeed_tpu.inference.sampling import sample_logits
 from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig, causal_lm_partition_rules
+from deepspeed_tpu.parallel.autotp import place_parameters
+from deepspeed_tpu.inference.ragged import _round_up
 from deepspeed_tpu.topology.mesh import build_mesh, set_mesh
 from deepspeed_tpu.utils.logging import log_dist
-
-
-def _round_up(n: int, mult: int) -> int:
-    return ((n + mult - 1) // mult) * mult
 
 
 class InferenceEngine:
@@ -63,23 +61,7 @@ class InferenceEngine:
                 "weight-only quantization lands with the v2 engine; run bf16/fp16 for now"
             )
 
-        def _place(path, leaf):
-            spec = causal_lm_partition_rules(jax.tree_util.keystr(path), leaf.shape) or P()
-            # drop axes that don't divide the dim (reference tp_shard.get_shard_size
-            # handles uneven shards; XLA requires even — replicate instead)
-            entries = []
-            for dim, entry in enumerate(spec):
-                ok = entry is None or leaf.shape[dim] % int(
-                    np.prod([mesh.shape[a] for a in (entry if isinstance(entry, tuple) else (entry,))])
-                ) == 0
-                entries.append(entry if ok else None)
-            spec = P(*entries)
-            arr = jnp.asarray(leaf)
-            if jnp.issubdtype(arr.dtype, jnp.floating):
-                arr = arr.astype(dtype)
-            return jax.device_put(arr, NamedSharding(mesh, spec))
-
-        self.params = jax.tree_util.tree_map_with_path(_place, params)
+        self.params = place_parameters(params, mesh, causal_lm_partition_rules, dtype)
         n_params = sum(x.size for x in jax.tree_util.tree_leaves(self.params))
         log_dist(f"InferenceEngine: {n_params/1e6:.1f}M params, mesh={dict(mesh.shape)}, dtype={config.dtype}")
         self._generate_cache: Dict[tuple, Any] = {}
@@ -144,7 +126,7 @@ class InferenceEngine:
         B, S = ids.shape
         if attention_mask is None:
             attention_mask = np.ones((B, S), np.bool_)
-        amask = np.asarray(attention_mask, np.bool_)
+        amask = np.array(attention_mask, np.bool_)  # copy: never mutate caller's mask
         # Cache slots are written in order, so slot index must equal token
         # position: normalize HF-style left-padded rows to right-padding by
         # compacting each row's real tokens to the front.

@@ -1,0 +1,231 @@
+"""Continuous-batching inference engine (FastGen analog).
+
+TPU-native analog of reference ``InferenceEngineV2``
+(``inference/v2/engine_v2.py:30``): sequences identified by uid, tokens pushed
+via ``put(uids, tokens)``, KV state lives in a paged pool addressed through
+per-sequence block tables, and admission control (``can_schedule``/``query``)
+lets a serving loop pack prefill chunks and decodes into one step.
+
+Differences from the reference, by TPU design:
+  - one jitted ragged step program per (rows, chunk) bucket instead of a
+    kernel zoo; the paged gather/attention lives in ``paged.py``
+  - the scheduler-facing API is identical in shape, but scheduling quanta are
+    bucket sizes (static shapes) rather than arbitrary token counts
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.inference.config import InferenceConfig
+from deepspeed_tpu.inference.paged import PagedKVPool, init_pool, ragged_forward
+from deepspeed_tpu.inference.ragged import RaggedBatch, StateManager, build_ragged_batch
+from deepspeed_tpu.inference.sampling import sample_logits
+from deepspeed_tpu.models.transformer import TransformerConfig, causal_lm_partition_rules
+from deepspeed_tpu.parallel.autotp import place_parameters
+from deepspeed_tpu.topology.mesh import build_mesh, set_mesh
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class RaggedInferenceConfig(DeepSpeedConfigModel):
+    """v2 engine config (reference ``RaggedInferenceEngineConfig``:
+    state-manager + KV-cache sizing)."""
+
+    dtype: str = "bf16"
+    tp_size: int = 1
+    kv_block_size: int = 16
+    num_kv_blocks: int = 512
+    max_seqs: int = 64  # max concurrently tracked sequences
+    max_seq_len: Optional[int] = None  # default: model max_seq_len
+    row_bucket: int = 8
+    chunk_bucket: int = 16
+
+    @property
+    def jax_dtype(self):
+        from deepspeed_tpu.inference.config import _DTYPES
+
+        return _DTYPES[self.dtype.lower()]
+
+
+class InferenceEngineV2:
+    """uid-keyed continuous batching over a paged KV pool."""
+
+    def __init__(
+        self,
+        model_config: TransformerConfig,
+        params: Any,
+        config: Union[RaggedInferenceConfig, Dict, None] = None,
+        mesh: Optional[Mesh] = None,
+    ):
+        if config is None:
+            config = {}
+        if isinstance(config, dict):
+            config = RaggedInferenceConfig(**config)
+        self.model_config = model_config
+        self.config = config
+        if mesh is None:
+            mesh = build_mesh(axis_sizes={"tp": config.tp_size, "dp": -1})
+        self.mesh = mesh
+        set_mesh(mesh)
+
+        max_len = config.max_seq_len or model_config.max_seq_len
+        self.max_seq_len = max_len
+        self.max_pages = -(-max_len // config.kv_block_size)
+        self.state = StateManager(config.num_kv_blocks, config.kv_block_size, config.max_seqs,
+                                  max_blocks_per_seq=self.max_pages)
+
+        dtype = config.jax_dtype
+        self.params = place_parameters(params, mesh, causal_lm_partition_rules, dtype)
+        # KV pool: kv-head dim over tp, slots replicated over dp
+        pool = init_pool(model_config, config.num_kv_blocks, config.kv_block_size, dtype)
+        kv_spec = NamedSharding(mesh, P(None, None, "tp" if model_config.kv_heads % mesh.shape["tp"] == 0 else None, None))
+        self.pool = PagedKVPool(k=jax.device_put(pool.k, kv_spec), v=jax.device_put(pool.v, kv_spec))
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(self.params))
+        log_dist(
+            f"InferenceEngineV2: {n_params/1e6:.1f}M params, "
+            f"{config.num_kv_blocks}x{config.kv_block_size} KV slots, mesh={dict(mesh.shape)}"
+        )
+        self._step_cache: Dict[Tuple[int, int], Any] = {}
+
+    # ---------------------------------------------------------------- admission
+    def query(self, uid: int) -> Tuple[int, int]:
+        """(seen_tokens, free_kv_slots) for scheduler accounting (reference
+        ``engine_v2.query`` :158)."""
+        seq = self.state.get(uid)
+        seen = seq.seen_tokens if seq is not None else 0
+        return seen, self.state.free_blocks * self.config.kv_block_size
+
+    def can_schedule(self, uids: Sequence[int], token_counts: Sequence[int]) -> bool:
+        return self.state.can_schedule(uids, token_counts)
+
+    def flush(self, uid: int) -> None:
+        self.state.flush(uid)
+
+    # ---------------------------------------------------------------- put
+    def _step_fn(self, rows: int, chunk: int):
+        key = (rows, chunk)
+        if key not in self._step_cache:
+            cfg = self.model_config
+            bs = self.config.kv_block_size
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def step(params, pool, tokens, positions, new_lens, block_tables):
+                return ragged_forward(params, cfg, pool, tokens, positions, new_lens, block_tables, bs)
+
+            self._step_cache[key] = step
+        return self._step_cache[key]
+
+    def put(self, uids: Sequence[int], token_lists: Sequence[np.ndarray]) -> np.ndarray:
+        """Push new tokens for each uid; returns last-token logits [len(uids), V]
+        (reference ``engine_v2.put`` :107). Mixed prefill/decode is fine —
+        pass a whole prompt for new sequences and single tokens for decodes."""
+        if not self.can_schedule(uids, [len(t) for t in token_lists]):
+            raise RuntimeError("insufficient KV blocks/slots; call can_schedule first")
+        batch = build_ragged_batch(
+            self.state, uids, token_lists, self.max_pages,
+            self.config.row_bucket, self.config.chunk_bucket,
+        )
+        step = self._step_fn(batch.n_rows, batch.tokens.shape[1])
+        logits, self.pool = step(
+            self.params, self.pool,
+            jnp.asarray(batch.tokens), jnp.asarray(batch.positions),
+            jnp.asarray(batch.new_lens), jnp.asarray(batch.block_tables),
+        )
+        for uid, toks in zip(uids, token_lists):
+            self.state.get(uid).seen_tokens += len(toks)
+        return np.asarray(logits[: len(uids)])
+
+    # ---------------------------------------------------------------- serving loop
+    def generate(
+        self,
+        prompts: Sequence[np.ndarray],
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+    ) -> List[np.ndarray]:
+        """Convenience continuous-batching loop (the MII serving-layer analog).
+
+        Each step is ONE ``put`` mixing newly admitted prompts (prefill) with
+        single-token decodes of the active set. When the pool cannot fit the
+        next decode step, the youngest active sequence is preempted (flushed
+        and re-queued with its full context, reference FastGen scheduler
+        behavior) rather than crashing mid-generation.
+        """
+        prompts = [np.asarray(p, np.int32) for p in prompts]
+        for i, p in enumerate(prompts):
+            if len(p) + max_new_tokens > self.max_seq_len:
+                raise ValueError(
+                    f"prompt {i} ({len(p)} tokens) + max_new_tokens={max_new_tokens} "
+                    f"exceeds engine max_seq_len={self.max_seq_len}"
+                )
+        queue: List[int] = list(range(len(prompts)))  # idx, FIFO
+        gen: Dict[int, List[int]] = {i: [] for i in queue}
+        active: Dict[int, int] = {}  # uid -> idx
+        order: List[int] = []  # admission order (youngest last) for preemption
+        outputs: Dict[int, np.ndarray] = {}
+        rng = jax.random.PRNGKey(seed)
+        next_uid = 0
+
+        def context(idx: int) -> np.ndarray:
+            return np.concatenate([prompts[idx], np.asarray(gen[idx], np.int32)])
+
+        while queue or active:
+            # decode every active sequence
+            step_uids = list(active.keys())
+            step_tokens: List[np.ndarray] = [np.asarray([gen[active[u]][-1]], np.int32)
+                                             for u in step_uids]
+            counts = [1] * len(step_uids)
+            # make room for decodes: preempt youngest until the step fits
+            while step_uids and not self.state.can_schedule(step_uids, counts):
+                victim = order.pop()
+                i = step_uids.index(victim)
+                step_uids.pop(i), step_tokens.pop(i), counts.pop(i)
+                idx = active.pop(victim)
+                self.flush(victim)
+                queue.insert(0, idx)
+            # admit pending prompts that fit alongside the decodes
+            while queue and len(active) + 1 <= self.config.max_seqs:
+                idx = queue[0]
+                cand = context(idx)
+                if not self.state.can_schedule(step_uids + [next_uid], counts + [len(cand)]):
+                    break
+                queue.pop(0)
+                step_uids.append(next_uid)
+                step_tokens.append(cand)
+                counts.append(len(cand))
+                active[next_uid] = idx
+                order.append(next_uid)
+                next_uid += 1
+            if not step_uids:
+                raise RuntimeError(
+                    f"KV pool too small for a single sequence "
+                    f"({self.config.num_kv_blocks} blocks x {self.config.kv_block_size})"
+                )
+            logits = self.put(step_uids, step_tokens)
+            rng, sub = jax.random.split(rng)
+            toks = np.asarray(sample_logits(
+                jnp.asarray(logits), sub, do_sample=do_sample,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+            ))
+            for u, t in zip(step_uids, toks):
+                idx = active[u]
+                gen[idx].append(int(t))
+                if len(gen[idx]) >= max_new_tokens or (
+                    eos_token_id is not None and int(t) == eos_token_id
+                ):
+                    outputs[idx] = np.asarray(gen[idx], np.int32)
+                    active.pop(u)
+                    order.remove(u)
+                    self.flush(u)
+        return [outputs[i] for i in range(len(prompts))]

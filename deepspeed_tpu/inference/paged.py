@@ -1,0 +1,149 @@
+"""Paged KV cache + ragged forward step (device side).
+
+TPU-native analog of the reference FastGen kernel suite
+(``inference/v2/kernels/ragged_ops/``: ``blocked_flash`` paged attention,
+``linear_blocked_kv_rotary`` fused KV-insert+RoPE): the KV pool is a flat
+``[L, NB*bs + 1, kvH, hd]`` array (last slot = trash for pad-row writes), a
+sequence's cache is addressed through its block table, and one jitted step
+processes a mixed prefill/decode ragged batch:
+
+  - KV insert = one scatter per layer (``.at[idx].set``) at
+    ``block_table[pos // bs] * bs + pos % bs`` — the fused-KV-copy+RoPE kernel
+  - paged attention = gather the row's pages to ``[P*bs, kvH, hd]`` then
+    masked GQA attention (slot index within the gathered view == global
+    position, so causality is ``slot <= q_pos``). A Pallas flash-decode kernel
+    that skips the materialized gather is the registered fast path upgrade.
+
+Static shapes everywhere: (rows, chunk, pages) are bucketed by the host layer
+(``ragged.py``), so XLA compiles a handful of step programs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.model import _apply_norm, _attn_out, _mlp, _moe, _qkv
+from deepspeed_tpu.models.transformer import TransformerConfig, rope_tables
+from deepspeed_tpu.ops import rope as rope_op
+
+
+class PagedKVPool(NamedTuple):
+    """k/v: ``[L, NB*bs + 1, kvH, hd]`` flat slot-major pool; the final slot is
+    the trash slot (reference: FastGen preallocates the KV arena up front from
+    a memory budget, ``DSStateManager`` + ``KVCacheConfig``). ``block_size``
+    is carried by the engine, not here — this NamedTuple is a jit pytree and
+    must hold only arrays."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_slots(self) -> int:  # excludes trash
+        return self.k.shape[1] - 1
+
+
+def init_pool(
+    cfg: TransformerConfig, num_blocks: int, block_size: int, dtype: Any = jnp.bfloat16
+) -> PagedKVPool:
+    shape = (cfg.num_layers, num_blocks * block_size + 1, cfg.kv_heads, cfg.dims_per_head)
+    return PagedKVPool(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def _slot_ids(block_tables: jax.Array, positions: jax.Array, valid: jax.Array,
+              block_size: int, trash: int) -> jax.Array:
+    """Flat pool slot for each (row, token): bt[pos//bs]*bs + pos%bs, or trash."""
+    page = jnp.take_along_axis(block_tables, positions // block_size, axis=1)
+    slot = page * block_size + positions % block_size
+    return jnp.where(valid, slot, trash)
+
+
+def paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block_size):
+    """Masked GQA attention of new queries against paged caches.
+
+    q: [N, C, H, hd]; pool_{k,v}_l: [S_flat, kvH, hd] (one layer's pool);
+    block_tables: [N, P]; q_positions: [N, C]. Returns [N, C, H, hd].
+    """
+    N, C, H, hd = q.shape
+    P = block_tables.shape[1]
+    slot = block_tables[:, :, None] * block_size + jnp.arange(block_size)[None, None, :]
+    slot = slot.reshape(N, P * block_size)  # global position j -> pool slot
+    ck = pool_k_l[slot]  # [N, P*bs, kvH, hd]
+    cv = pool_v_l[slot]
+    kvH = ck.shape[2]
+    G = H // kvH
+    qg = q.reshape(N, C, kvH, G, hd)
+    scores = jnp.einsum("nckgd,ntkd->nkgct", qg, ck).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(hd))
+    t_idx = jnp.arange(P * block_size)
+    ok = t_idx[None, None, :] <= q_positions[:, :, None]  # causal over positions
+    scores = jnp.where(ok[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    ctx = jnp.einsum("nkgct,ntkd->nckgd", probs, cv)
+    return ctx.reshape(N, C, H, hd)
+
+
+def ragged_forward(
+    params,
+    cfg: TransformerConfig,
+    pool: PagedKVPool,
+    tokens: jax.Array,  # [N, C] int32
+    positions: jax.Array,  # [N, C] int32
+    new_lens: jax.Array,  # [N] int32
+    block_tables: jax.Array,  # [N, P] int32
+    block_size: int,
+) -> Tuple[jax.Array, PagedKVPool]:
+    """One mixed prefill/decode step -> (last-token logits [N, V], pool).
+
+    Reference analog: the whole FastGen model forward over a
+    ``RaggedBatchWrapper`` (``inference/v2/engine_v2.py:107`` → model
+    implementations → ragged kernels), as one XLA program.
+    """
+    N, C = tokens.shape
+    bs = block_size
+    trash = pool.k.shape[1] - 1
+    valid = jnp.arange(C)[None, :] < new_lens[:, None]  # [N, C]
+    slot = _slot_ids(block_tables, positions, valid, bs, trash)  # [N, C]
+    flat_slot = slot.reshape(-1)
+
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0).astype(cfg.dtype)
+    if cfg.position == "learned":
+        x = x + jnp.take(params["pos_embed"], positions, axis=0).astype(cfg.dtype)
+
+    if "layers" not in params:
+        raise ValueError("ragged inference requires scan_layers=True stacked params")
+
+    def body(x, xs):
+        lp, pk, pv = xs
+        h = _apply_norm(lp["attn_norm"], cfg, x)
+        q, k, v = _qkv(lp["attn"], cfg, h)
+        if cfg.position == "rope":
+            cos, sin = rope_tables(cfg.max_seq_len, cfg.dims_per_head, cfg.rope_theta)
+            q = rope_op(q, cos, sin, positions)
+            k = rope_op(k, cos, sin, positions)
+        kvH, hd = k.shape[-2], k.shape[-1]
+        pk = pk.at[flat_slot].set(k.astype(pk.dtype).reshape(-1, kvH, hd), mode="drop")
+        pv = pv.at[flat_slot].set(v.astype(pv.dtype).reshape(-1, kvH, hd), mode="drop")
+        ctx = paged_attention(q, pk, pv, block_tables, positions, bs)
+        x = x + _attn_out(lp["attn"], cfg, ctx)
+        h = _apply_norm(lp["mlp_norm"], cfg, x)
+        if cfg.num_experts > 0:
+            x = x + _moe(lp["moe"], cfg, h)
+        else:
+            x = x + _mlp(lp["mlp"], cfg, h)
+        return x, (pk, pv)
+
+    x, (k_new, v_new) = jax.lax.scan(body, x, (params["layers"], pool.k, pool.v))
+    pool = pool._replace(k=k_new, v=v_new)
+
+    x = _apply_norm(params["final_norm"], cfg, x)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(new_lens - 1, 0)[:, None, None], axis=1
+    )[:, 0]  # [N, E]
+    if cfg.tie_embeddings:
+        logits = last @ params["embed"]["embedding"].T.astype(cfg.dtype)
+    else:
+        logits = last @ params["lm_head"]["kernel"].astype(cfg.dtype)
+    return logits, pool

@@ -1,0 +1,194 @@
+"""Ragged-batch state management for continuous batching.
+
+TPU-native analog of the reference FastGen ragged layer
+(``inference/v2/ragged/``): ``BlockedAllocator`` (blocked_allocator.py:11),
+``DSSequenceDescriptor`` (sequence_descriptor.py), ``DSStateManager``
+(ragged_manager.py:19), and ``RaggedBatchWrapper`` (ragged_wrapper.py).
+
+All of this is host-side bookkeeping (numpy, no device work): the device sees
+only the dense arrays a ``RaggedBatch`` assembles — padded token/position
+matrices plus per-sequence block tables into the paged KV pool. Static shape
+buckets keep XLA recompiles rare; the pad rows write to a dedicated trash slot
+in the pool (see ``paged.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class BlockedAllocator:
+    """Free-list allocator for KV-cache blocks (reference
+    ``BlockedAllocator`` inference/v2/ragged/blocked_allocator.py:11)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"need at least 1 block, got {num_blocks}")
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self.num_blocks = num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(f"cannot allocate {n} blocks ({len(self._free)} free)")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b < 0 or b >= self.num_blocks or b in self._free:
+                raise ValueError(f"bad free of block {b}")
+            self._free.append(b)
+
+
+@dataclasses.dataclass
+class SequenceDescriptor:
+    """Per-sequence tracking (reference ``DSSequenceDescriptor``)."""
+
+    uid: int
+    seen_tokens: int = 0
+    blocks: List[int] = dataclasses.field(default_factory=list)
+
+    def blocks_needed(self, new_tokens: int, block_size: int) -> int:
+        total = self.seen_tokens + new_tokens
+        need = -(-total // block_size)  # ceil
+        return max(0, need - len(self.blocks))
+
+
+class StateManager:
+    """uid -> sequence state + block accounting (reference ``DSStateManager``
+    inference/v2/ragged/ragged_manager.py:19)."""
+
+    def __init__(self, num_blocks: int, block_size: int, max_seqs: int = 256,
+                 max_blocks_per_seq: Optional[int] = None):
+        self.allocator = BlockedAllocator(num_blocks)
+        self.block_size = block_size
+        self.max_seqs = max_seqs
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self._seqs: Dict[int, SequenceDescriptor] = {}
+
+    @property
+    def n_active(self) -> int:
+        return len(self._seqs)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    def get(self, uid: int) -> Optional[SequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def get_or_create(self, uid: int) -> SequenceDescriptor:
+        if uid not in self._seqs:
+            if len(self._seqs) >= self.max_seqs:
+                raise RuntimeError(f"max_seqs={self.max_seqs} active sequences reached")
+            self._seqs[uid] = SequenceDescriptor(uid)
+        return self._seqs[uid]
+
+    def can_schedule(self, uids: Sequence[int], token_counts: Sequence[int]) -> bool:
+        """Admission check (reference ``InferenceEngineV2.can_schedule`` :184)."""
+        need = 0
+        fresh = 0
+        for uid, n in zip(uids, token_counts):
+            seq = self._seqs.get(uid)
+            if seq is None:
+                fresh += 1
+                total_blocks = -(-n // self.block_size)
+                need += total_blocks
+            else:
+                total_blocks = len(seq.blocks) + seq.blocks_needed(n, self.block_size)
+                need += seq.blocks_needed(n, self.block_size)
+            if self.max_blocks_per_seq is not None and total_blocks > self.max_blocks_per_seq:
+                return False  # sequence would exceed engine max_seq_len
+        if len(self._seqs) + fresh > self.max_seqs:
+            return False
+        return need <= self.allocator.free_blocks
+
+    def extend(self, uid: int, new_tokens: int) -> SequenceDescriptor:
+        """Ensure blocks exist for ``new_tokens`` more tokens of ``uid``."""
+        seq = self.get_or_create(uid)
+        need = seq.blocks_needed(new_tokens, self.block_size)
+        if need:
+            seq.blocks.extend(self.allocator.allocate(need))
+        return seq
+
+    def flush(self, uid: int) -> None:
+        """Release a finished sequence (reference ``flush_uid`` engine_v2.py)."""
+        seq = self._seqs.pop(uid, None)
+        if seq is not None and seq.blocks:
+            self.allocator.free(seq.blocks)
+
+
+@dataclasses.dataclass
+class RaggedBatch:
+    """Dense view of one scheduling step (reference ``RaggedBatchWrapper``).
+
+    Rows are sequences; pad rows have ``new_lens == 0``. ``tokens`` is
+    right-padded to the chunk bucket; ``block_tables`` is padded with 0 (pad
+    slots never read: masked by position; never written: writes route to the
+    trash slot)."""
+
+    uids: List[int]
+    tokens: np.ndarray  # [N, C] int32
+    positions: np.ndarray  # [N, C] int32 (global position of each new token)
+    new_lens: np.ndarray  # [N] int32
+    block_tables: np.ndarray  # [N, P] int32
+    seen: np.ndarray  # [N] int32 (tokens already in cache, before this step)
+
+    @property
+    def n_rows(self) -> int:
+        return self.tokens.shape[0]
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def build_ragged_batch(
+    manager: StateManager,
+    uids: Sequence[int],
+    token_lists: Sequence[np.ndarray],
+    max_pages: int,
+    row_bucket: int = 8,
+    chunk_bucket: int = 8,
+) -> RaggedBatch:
+    """Allocate blocks and assemble the dense step arrays.
+
+    Caller must have checked ``can_schedule``; this raises if blocks run out.
+    """
+    n = len(uids)
+    assert n == len(token_lists) and n > 0
+    chunk = max(len(t) for t in token_lists)
+    chunk = _round_up(max(chunk, 1), chunk_bucket)
+    rows = _round_up(n, row_bucket)
+
+    tokens = np.zeros((rows, chunk), np.int32)
+    positions = np.zeros((rows, chunk), np.int32)
+    new_lens = np.zeros((rows,), np.int32)
+    block_tables = np.zeros((rows, max_pages), np.int32)
+    seen = np.zeros((rows,), np.int32)
+
+    for i, (uid, toks) in enumerate(zip(uids, token_lists)):
+        toks = np.asarray(toks, np.int32)
+        seq = manager.extend(uid, len(toks))
+        if len(seq.blocks) > max_pages:
+            raise RuntimeError(
+                f"uid {uid}: {len(seq.blocks)} blocks exceeds max_pages={max_pages} "
+                f"(sequence longer than engine max_seq_len)"
+            )
+        tokens[i, : len(toks)] = toks
+        positions[i, : len(toks)] = seq.seen_tokens + np.arange(len(toks))
+        new_lens[i] = len(toks)
+        block_tables[i, : len(seq.blocks)] = seq.blocks
+        seen[i] = seq.seen_tokens
+
+    return RaggedBatch(
+        uids=list(uids), tokens=tokens, positions=positions,
+        new_lens=new_lens, block_tables=block_tables, seen=seen,
+    )

@@ -1,0 +1,52 @@
+"""AutoTP: automatic tensor-parallel placement of parameter pytrees.
+
+TPU-native analog of reference AutoTP (``module_inject/auto_tp.py:193``
+``tp_parser``/``_replace`` + ``module_inject/layers.py`` LinearLayer/
+LinearAllreduce): instead of swapping modules and slicing weights rank by
+rank, placement is a PartitionSpec per parameter — XLA inserts the
+all-reduces a row-parallel linear needs. Rules are name-based functions
+``(keystr_path, shape) -> PartitionSpec | None`` (see
+``models/transformer.py:causal_lm_partition_rules``); this module applies
+them with the uneven-shard fallback the reference handles in
+``module_inject/tp_shard.py:get_shard_size`` (here: replicate any dim the
+mesh axis does not divide, since XLA requires even shards).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Callable[[str, tuple], Optional[P]]
+
+
+def divisible_spec(spec: Optional[P], shape: tuple, mesh: Mesh) -> P:
+    """Drop spec entries whose mesh-axis product does not divide the dim."""
+    if spec is None:
+        return P()
+    entries = []
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            entries.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        size = int(np.prod([mesh.shape[a] for a in axes]))
+        entries.append(entry if shape[dim] % size == 0 else None)
+    return P(*entries)
+
+
+def place_parameters(params: Any, mesh: Mesh, rules: Rules, dtype: Any = None) -> Any:
+    """device_put every leaf by its rule's spec (floats cast to ``dtype``)."""
+
+    def _place(path, leaf):
+        arr = jnp.asarray(leaf)
+        spec = divisible_spec(rules(jax.tree_util.keystr(path), arr.shape), arr.shape, mesh)
+        if dtype is not None and jnp.issubdtype(arr.dtype, jnp.floating):
+            arr = arr.astype(dtype)
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(_place, params)
