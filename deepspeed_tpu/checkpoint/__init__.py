@@ -1,0 +1,21 @@
+"""deepspeed_tpu.checkpoint: save/load, pluggable engines, universal reshape.
+
+Reference analogs: ``runtime/engine.py:3274/:2928`` (save/load),
+``runtime/checkpoint_engine/`` (engine ABC), ``checkpoint/ds_to_universal.py``
++ ``universal_checkpoint.py`` (mesh-independent atoms),
+``utils/zero_to_fp32.py`` (fp32 consolidation CLI).
+"""
+
+from deepspeed_tpu.checkpoint.checkpointing import load_checkpoint, save_checkpoint
+from deepspeed_tpu.checkpoint.engine import (
+    AsyncCheckpointEngine,
+    CheckpointEngine,
+    OrbaxCheckpointEngine,
+    get_checkpoint_engine,
+)
+from deepspeed_tpu.checkpoint.universal import (
+    convert_to_fp32_file,
+    get_fp32_state_dict_from_checkpoint,
+    load_universal,
+    save_universal,
+)

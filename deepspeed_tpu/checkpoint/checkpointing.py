@@ -29,7 +29,8 @@ def _tag(step: int) -> str:
 
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
-                    client_state: Optional[Dict] = None, save_latest: bool = True) -> str:
+                    client_state: Optional[Dict] = None, save_latest: bool = True,
+                    checkpoint_engine=None) -> str:
     tag = tag or _tag(engine.global_steps)
     path = os.path.abspath(os.path.join(save_dir, tag))
     os.makedirs(save_dir, exist_ok=True)
@@ -42,8 +43,18 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "loss_scale": state.loss_scale._asdict(),
         "rng": state.rng,
     }
-    with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(path, payload, force=True)
+    if checkpoint_engine is None:
+        checkpoint_engine = getattr(engine, "checkpoint_engine", None)
+    if checkpoint_engine is None:
+        from deepspeed_tpu.checkpoint.engine import OrbaxCheckpointEngine
+
+        checkpoint_engine = OrbaxCheckpointEngine()
+    checkpoint_engine.create(tag)
+    checkpoint_engine.save(payload, path)
+    if not getattr(checkpoint_engine, "async_save", False):
+        checkpoint_engine.commit(tag)
+    # async engines: the write continues in the background; durability is
+    # guaranteed at the next load()/commit() barrier (Nebula tier semantics)
 
     meta = {
         "client_state": client_state or {},
@@ -61,7 +72,8 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
-                    load_optimizer_states: bool = True) -> Tuple[Optional[str], Dict]:
+                    load_optimizer_states: bool = True,
+                    checkpoint_engine=None) -> Tuple[Optional[str], Dict]:
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)
         if not os.path.exists(latest):
@@ -86,8 +98,13 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding) if isinstance(x, jax.Array) else ocp.RestoreArgs(),
         target,
     )
-    with ocp.PyTreeCheckpointer() as ckptr:
-        restored = ckptr.restore(path, item=target, restore_args=restore_args)
+    if checkpoint_engine is None:
+        checkpoint_engine = getattr(engine, "checkpoint_engine", None)
+    if checkpoint_engine is None:
+        from deepspeed_tpu.checkpoint.engine import OrbaxCheckpointEngine
+
+        checkpoint_engine = OrbaxCheckpointEngine()
+    restored = checkpoint_engine.load(path, target=target, restore_args=restore_args)
 
     from deepspeed_tpu.runtime.engine import TrainState
     from deepspeed_tpu.runtime.precision import LossScaleState

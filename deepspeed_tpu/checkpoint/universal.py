@@ -1,0 +1,169 @@
+"""Universal (mesh-reshapeable) checkpoints + fp32 consolidation.
+
+TPU-native analog of the reference universal-checkpoint suite:
+  - ``checkpoint/ds_to_universal.py`` (:112 extract_zero_shards, :232
+    merge_tp_slices): offline conversion of a sharded checkpoint into
+    mesh-independent fp32 "atoms" reloadable under ANY parallel layout
+  - ``checkpoint/universal_checkpoint.py:16 load_hp_checkpoint_state``:
+    loading those atoms into a differently-sharded run
+  - ``utils/zero_to_fp32.py`` (:533,:598): consolidating a ZeRO checkpoint
+    into a single fp32 state dict offline
+
+On TPU the hard part disappears by construction: the training state is one
+global pytree (sharding is a placement property, not a storage property), so
+"extract shards + merge slices" reduces to device_get → one .npz of fp32
+arrays keyed by pytree path. Loading re-places every atom with the *target*
+engine's shardings — any mesh, any ZeRO stage, any tp/pp/dp split.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+UNIVERSAL_DIR = "universal"
+
+
+def _tag_step(tag: str) -> int:
+    """Numeric sort key for global_stepN tags (lexicographic misorders 9 vs 10)."""
+    digits = "".join(c for c in tag if c.isdigit())
+    return int(digits) if digits else -1
+
+
+def _flatten(tree: Any) -> Dict[str, Any]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        flat[jax.tree_util.keystr(path)] = leaf
+    return flat
+
+
+def state_to_atoms(state) -> Dict[str, np.ndarray]:
+    """TrainState -> {path: fp32/int numpy atom} (the merge_tp_slices analog)."""
+    atoms = {}
+    for key, leaf in _flatten(state._asdict()).items():
+        if leaf is None:
+            continue
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype in (np.dtype(jnp.bfloat16), np.float16):
+            arr = arr.astype(np.float32)
+        atoms[key] = arr
+    return atoms
+
+
+def save_universal(engine, save_dir: str, tag: Optional[str] = None) -> str:
+    """Write a mesh-independent checkpoint (ds_to_universal done online)."""
+    tag = tag or f"global_step{engine.global_steps}"
+    path = os.path.join(save_dir, UNIVERSAL_DIR, tag)
+    os.makedirs(path, exist_ok=True)
+    atoms = state_to_atoms(engine.state)
+    np.savez(os.path.join(path, "atoms.npz"), **atoms)
+    meta = {
+        "version": 1,
+        "step": int(jax.device_get(engine.state.step)),
+        "source_mesh": {k: int(v) for k, v in dict(engine.mesh.shape).items()},
+        "zero_stage": engine.zero_config.stage,
+        "n_atoms": len(atoms),
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    log_dist(f"saved universal checkpoint {path} ({len(atoms)} atoms)", ranks=[0])
+    return path
+
+
+def load_universal(engine, load_dir: str, tag: Optional[str] = None,
+                   strict: bool = True) -> str:
+    """Restore a universal checkpoint into an engine on ANY mesh/stage.
+
+    Every atom is device_put with the *current* engine's sharding for that
+    leaf (reference ``load_hp_checkpoint_state`` re-slices per rank; XLA does
+    the slicing here).
+    """
+    base = os.path.join(load_dir, UNIVERSAL_DIR)
+    if tag is None:
+        tags = sorted(os.listdir(base), key=_tag_step) if os.path.isdir(base) else []
+        if not tags:
+            raise FileNotFoundError(f"no universal checkpoints under {base}")
+        tag = tags[-1]
+    path = os.path.join(base, tag)
+    data = np.load(os.path.join(path, "atoms.npz"))
+
+    state_dict = engine.state._asdict()
+    flat_target = _flatten(state_dict)
+    missing = [k for k in flat_target if k not in data.files and flat_target[k] is not None]
+    extra = [k for k in data.files if k not in flat_target]
+    if (missing or extra) and strict:
+        raise ValueError(f"universal checkpoint mismatch: missing={missing[:5]} extra={extra[:5]}")
+
+    def _restore(path_keys, leaf):
+        key = jax.tree_util.keystr(path_keys)
+        if leaf is None or key not in data.files:
+            return leaf
+        atom = data[key]
+        if isinstance(leaf, jax.Array):
+            return jax.device_put(jnp.asarray(atom, dtype=leaf.dtype), leaf.sharding)
+        return type(leaf)(atom) if np.isscalar(leaf) else atom
+
+    restored = jax.tree_util.tree_map_with_path(_restore, state_dict)
+    engine.state = type(engine.state)(**restored)
+    log_dist(f"loaded universal checkpoint {path}", ranks=[0])
+    return path
+
+
+# ------------------------------------------------------------ zero_to_fp32
+def get_fp32_state_dict_from_checkpoint(ckpt_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Offline: consolidated fp32 params from a saved checkpoint directory
+    (reference ``zero_to_fp32.get_fp32_state_dict_from_zero_checkpoint``).
+
+    Works on both universal checkpoints and regular Orbax ones.
+    """
+    upath = os.path.join(ckpt_dir, UNIVERSAL_DIR)
+    if os.path.isdir(upath):
+        tags = sorted(os.listdir(upath), key=_tag_step)
+        tag = tag or (tags[-1] if tags else None)
+        if tag and os.path.isdir(os.path.join(upath, tag)):
+            data = np.load(os.path.join(upath, tag, "atoms.npz"))
+            prefix = "['params']"
+            return {k[len(prefix):]: data[k].astype(np.float32)
+                    for k in data.files if k.startswith(prefix)}
+    # regular checkpoint: restore params subtree via orbax
+    import orbax.checkpoint as ocp
+
+    if tag is None:
+        latest = os.path.join(ckpt_dir, "latest")
+        with open(latest) as f:
+            tag = f.read().strip()
+    with ocp.PyTreeCheckpointer() as ckptr:
+        restored = ckptr.restore(os.path.join(os.path.abspath(ckpt_dir), tag))
+    flat = _flatten(restored["params"])
+    return {k: np.asarray(v, np.float32) for k, v in flat.items()}
+
+
+def convert_to_fp32_file(ckpt_dir: str, output_file: str, tag: Optional[str] = None) -> str:
+    """CLI body (reference ``zero_to_fp32.py`` __main__): one .npz of fp32."""
+    sd = get_fp32_state_dict_from_checkpoint(ckpt_dir, tag)
+    np.savez(output_file, **sd)
+    total = sum(v.size for v in sd.values())
+    logger.info(f"wrote {len(sd)} tensors / {total/1e6:.1f}M params to {output_file}")
+    return output_file
+
+
+def main():  # pragma: no cover - CLI shim
+    import argparse
+
+    p = argparse.ArgumentParser(description="Consolidate a deepspeed_tpu checkpoint to fp32 (zero_to_fp32 analog)")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    a = p.parse_args()
+    convert_to_fp32_file(a.checkpoint_dir, a.output_file, a.tag)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -163,11 +163,18 @@ class InferenceEngineV2:
         behavior) rather than crashing mid-generation.
         """
         prompts = [np.asarray(p, np.int32) for p in prompts]
+        pool_tokens = self.config.num_kv_blocks * self.config.kv_block_size
         for i, p in enumerate(prompts):
             if len(p) + max_new_tokens > self.max_seq_len:
                 raise ValueError(
                     f"prompt {i} ({len(p)} tokens) + max_new_tokens={max_new_tokens} "
                     f"exceeds engine max_seq_len={self.max_seq_len}"
+                )
+            if len(p) + max_new_tokens > pool_tokens:
+                raise ValueError(
+                    f"prompt {i} ({len(p)} tokens) + max_new_tokens={max_new_tokens} "
+                    f"cannot ever fit the KV pool ({pool_tokens} slots); no amount of "
+                    f"preemption can complete it"
                 )
         queue: List[int] = list(range(len(prompts)))  # idx, FIFO
         gen: Dict[int, List[int]] = {i: [] for i in queue}

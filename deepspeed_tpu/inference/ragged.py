@@ -28,6 +28,7 @@ class BlockedAllocator:
         if num_blocks < 1:
             raise ValueError(f"need at least 1 block, got {num_blocks}")
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._free_set = set(self._free)  # O(1) double-free detection
         self.num_blocks = num_blocks
 
     @property
@@ -38,13 +39,15 @@ class BlockedAllocator:
         if n > len(self._free):
             raise RuntimeError(f"cannot allocate {n} blocks ({len(self._free)} free)")
         out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
         return out
 
     def free(self, blocks: Sequence[int]) -> None:
         for b in blocks:
-            if b < 0 or b >= self.num_blocks or b in self._free:
+            if b < 0 or b >= self.num_blocks or b in self._free_set:
                 raise ValueError(f"bad free of block {b}")
             self._free.append(b)
+            self._free_set.add(b)
 
 
 @dataclasses.dataclass

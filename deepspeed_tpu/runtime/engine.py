@@ -591,14 +591,38 @@ class DeepSpeedTPUEngine:
             seed=self.config.model.seed,
         )
 
+    @functools.cached_property
+    def checkpoint_engine(self):
+        """Engine selected by the config ``checkpoint.engine`` key
+        ('orbax' | 'async'/'nebula'; reference ``_configure_checkpointing``
+        engine.py:354)."""
+        from deepspeed_tpu.checkpoint.engine import get_checkpoint_engine
+
+        return get_checkpoint_engine(self.config.model.checkpoint.get("engine", "orbax"))
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None, client_state: Optional[Dict] = None,
                         save_latest: bool = True) -> None:
         from deepspeed_tpu.checkpoint.checkpointing import save_checkpoint as _save
 
-        _save(self, save_dir, tag=tag, client_state=client_state or {}, save_latest=save_latest)
+        _save(self, save_dir, tag=tag, client_state=client_state or {}, save_latest=save_latest,
+              checkpoint_engine=self.checkpoint_engine)
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
-                        load_optimizer_states: bool = True) -> Tuple[Optional[str], Dict]:
+                        load_optimizer_states: bool = True,
+                        load_universal: bool = False) -> Tuple[Optional[str], Dict]:
+        """Restore state. ``load_universal=True`` reads the mesh-independent
+        atom format instead (reference ``load_universal_checkpoint`` flag)."""
+        if load_universal:
+            from deepspeed_tpu.checkpoint.universal import load_universal as _loadu
+
+            return _loadu(self, load_dir, tag=tag), {}
         from deepspeed_tpu.checkpoint.checkpointing import load_checkpoint as _load
 
         return _load(self, load_dir, tag=tag, load_optimizer_states=load_optimizer_states)
+
+    def save_universal_checkpoint(self, save_dir: str, tag: Optional[str] = None) -> str:
+        """Write the mesh-independent atom checkpoint (reference
+        ``checkpoint/ds_to_universal.py`` done online — no offline pass)."""
+        from deepspeed_tpu.checkpoint.universal import save_universal as _saveu
+
+        return _saveu(self, save_dir, tag=tag)
