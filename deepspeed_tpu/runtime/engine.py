@@ -124,6 +124,10 @@ class DeepSpeedTPUEngine:
         )
         self.losses = None
         self.monitor = None  # wired by engine_builder when monitoring configured
+
+        from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+
+        self.flops_profiler = FlopsProfiler(engine=self)
         log_dist(
             f"engine ready: mesh={dict(self.mesh.shape)} zero_stage={self.zero_config.stage} "
             f"dtype={self.compute_dtype.__name__} batch={self.config.train_batch_size} "
@@ -402,6 +406,17 @@ class DeepSpeedTPUEngine:
             placed = self._shard_global_batch(batch)
         else:
             placed = self._stack_micro_batches(data_iter)
+        fp_cfg = self.config.model.flops_profiler
+        prof = self.flops_profiler
+        config_fire = (fp_cfg.enabled and prof.result is None
+                       and self.global_steps >= fp_cfg.profile_step)
+        if prof.armed or config_fire:
+            # profile this step's compiled program (reference FlopsProfiler
+            # hooks the fwd at profile_step; here it is XLA cost analysis).
+            # `result is None` guard: fires once even if global_steps stalls
+            # on fp16 overflow-skipped steps.
+            prof.profile_engine_step(placed)
+            prof.print_model_profile(top=fp_cfg.top_modules)
         self.throughput_timer.start()
         self.state, metrics = self._train_step(self.state, placed)
         self.throughput_timer.stop()

@@ -1,0 +1,78 @@
+"""Flops profiler tests (coverage model: reference tests/unit/profiling/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.profiling import FlopsProfiler, flops_by_op, get_model_profile
+from tests.unit.simple_model import random_batch, simple_model_spec
+
+
+def test_flops_by_op_matmul_exact():
+    a = jnp.zeros((8, 32)); b = jnp.zeros((32, 16))
+    counts = flops_by_op(lambda x, y: x @ y, a, b)
+    assert counts["dot_general"] == 2 * 8 * 32 * 16
+
+
+def test_flops_by_op_counts_scan_trips():
+    w = jnp.zeros((4, 16, 16)); x = jnp.zeros((2, 16))
+
+    def fn(w, x):
+        def body(c, wi):
+            return c @ wi, None
+        out, _ = jax.lax.scan(body, x, w)
+        return out
+
+    counts = flops_by_op(fn, w, x)
+    assert counts["dot_general"] == 4 * (2 * 2 * 16 * 16)
+
+
+def test_get_model_profile_end_to_end():
+    a = jnp.ones((16, 64)); b = jnp.ones((64, 64))
+    r = get_model_profile(lambda x, y: (x @ y).sum(), a, b, params={"w": b})
+    assert r.latency_s > 0
+    assert r.params == 64 * 64
+    # XLA cost analysis flops should be at least the matmul flops
+    assert r.flops_per_step >= 2 * 16 * 64 * 64 * 0.5  # tolerate backend accounting
+    d = r.as_dict()
+    assert set(d) >= {"flops_per_step", "latency_s", "mfu"}
+
+
+def test_engine_profiler_integration(devices):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "flops_profiler": {"enabled": True, "profile_step": 1, "top_modules": 3},
+        "steps_per_print": 1000,
+    }
+    e, *_ = deepspeed_tpu.initialize(model=simple_model_spec(), config=cfg, seed=0)
+    for i in range(2):
+        e.train_batch(random_batch(e.train_batch_size, seed=i))
+    prof = e.flops_profiler
+    assert prof.result is not None
+    assert prof.get_total_flops() > 0
+    assert prof.get_total_params() > 0
+    report = prof.print_model_profile()
+    assert "flops per step" in report and "dot_general" in report
+
+
+def test_profiler_fires_once_and_rearms(devices):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "flops_profiler": {"enabled": True, "profile_step": 1},
+        "steps_per_print": 1000,
+    }
+    e, *_ = deepspeed_tpu.initialize(model=simple_model_spec(), config=cfg, seed=0)
+    for i in range(3):
+        e.train_batch(random_batch(e.train_batch_size, seed=i))
+    first = e.flops_profiler.result
+    e.train_batch(random_batch(e.train_batch_size, seed=9))
+    assert e.flops_profiler.result is first  # config trigger fired exactly once
+    e.flops_profiler.start_profile()  # manual re-arm
+    e.train_batch(random_batch(e.train_batch_size, seed=10))
+    assert e.flops_profiler.result is not first
+    assert not e.flops_profiler.armed  # disarmed itself
