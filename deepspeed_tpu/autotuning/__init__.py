@@ -1,0 +1,3 @@
+"""deepspeed_tpu.autotuning (reference ``deepspeed/autotuning/``)."""
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner, estimate_state_memory

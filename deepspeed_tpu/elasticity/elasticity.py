@@ -1,0 +1,158 @@
+"""Elastic batch-size math.
+
+Reference: ``elasticity/elasticity.py`` — the capability re-implemented here:
+choose a global train batch ≤ max_acceptable that (a) is a multiple of some
+allowed micro-batch, and (b) is divisible by as many chip counts in
+[min_chips, max_chips] as possible, so ANY of those world sizes can run the
+job with an integral (micro_batch × grad_accum × world) decomposition.
+Version 2 semantics: world sizes are counted in units of the model-parallel
+degree (chips per replica).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ElasticityError(ValueError):
+    """Bad elasticity config or incompatible world size."""
+
+
+@dataclasses.dataclass
+class ElasticityConfig:
+    """Config section (reference ``elasticity/config.py``)."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: Sequence[int] = (2, 4, 6)
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    prefer_larger_batch: bool = True
+    version: float = 0.2
+    model_parallel_size: int = 1
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "ElasticityConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+# Highly composite numbers: maximally divisible batch-size building blocks.
+def _highly_composite(limit: int) -> List[int]:
+    out, best = [], 0
+
+    def n_divisors(n: int) -> int:
+        cnt, i = 0, 1
+        while i * i <= n:
+            if n % i == 0:
+                cnt += 2 if i * i != n else 1
+            i += 1
+        return cnt
+
+    n = 1
+    while n <= limit:
+        d = n_divisors(n)
+        if d > best:
+            best = d
+            out.append(n)
+        # jump: HCNs are sparse; stepping by 1 is fine below ~1e6
+        n += 1 if n < 10000 else (60 if n < 100000 else 840)
+    return out
+
+
+_HCN_CACHE: Dict[int, List[int]] = {}
+
+
+def _hcns_up_to(limit: int) -> List[int]:
+    if limit not in _HCN_CACHE:
+        _HCN_CACHE[limit] = _highly_composite(limit)
+    return _HCN_CACHE[limit]
+
+
+def elastic_batch_candidates(micro_batches: Sequence[int], max_batch: int) -> List[int]:
+    """Per micro-batch: the largest (HCN × micro_batch) ≤ max_batch (HCN
+    multiples are divisible by the most world sizes)."""
+    cands = set()
+    for mb in micro_batches:
+        if mb >= max_batch:
+            cands.add(mb)
+            continue
+        budget = max_batch // mb
+        hcns = _hcns_up_to(budget)
+        cands.add(hcns[-1] * mb)
+    return sorted(cands)
+
+
+def compatible_world_sizes(batch: int, micro_batches: Sequence[int],
+                           min_chips: int, max_chips: int) -> Dict[int, int]:
+    """{world_size: micro_batch} for every world size that divides ``batch``
+    through some allowed micro-batch (world × micro × gas == batch)."""
+    valid: Dict[int, int] = {}
+    for mb in sorted(micro_batches, reverse=True):
+        if batch % mb:
+            continue
+        slots = batch // mb  # world × gas
+        w = 1
+        while w * w <= slots:
+            if slots % w == 0:
+                for cand in (w, slots // w):
+                    if min_chips <= cand <= max_chips and cand not in valid:
+                        valid[cand] = mb
+            w += 1
+    return dict(sorted(valid.items()))
+
+
+def compute_elastic_config(
+    config: Dict | ElasticityConfig,
+    world_size: int = 0,
+) -> Tuple[int, List[int], Dict[int, int], Optional[int]]:
+    """Pick the elastic batch (reference ``compute_elastic_config``
+    elasticity.py:233).
+
+    Returns (final_batch_size, valid_world_sizes, {world: micro_batch},
+    micro_batch_for_current_world). ``world_size`` counts replicas-worth of
+    chips divided by model_parallel_size (v2 semantics).
+    """
+    ecfg = config if isinstance(config, ElasticityConfig) else ElasticityConfig.from_dict(config)
+    if not ecfg.micro_batch_sizes or min(ecfg.micro_batch_sizes) < 1:
+        raise ElasticityError(f"bad micro_batch_sizes {ecfg.micro_batch_sizes}")
+    if ecfg.max_train_batch_size < max(ecfg.micro_batch_sizes):
+        raise ElasticityError(
+            f"max_train_batch_size {ecfg.max_train_batch_size} < largest micro batch"
+        )
+    mp = max(ecfg.model_parallel_size, 1)
+    min_w = max(ecfg.min_gpus // mp, 1)
+    max_w = max(ecfg.max_gpus // mp, 1)
+
+    best: Tuple[int, Dict[int, int]] = (0, {})
+    for cand in elastic_batch_candidates(ecfg.micro_batch_sizes, ecfg.max_train_batch_size):
+        valid = compatible_world_sizes(cand, ecfg.micro_batch_sizes, min_w, max_w)
+        score = (len(valid), cand if ecfg.prefer_larger_batch else -cand)
+        cur = (len(best[1]), best[0] if ecfg.prefer_larger_batch else -best[0])
+        if score > cur:
+            best = (cand, valid)
+    final_batch, valid = best
+    if not valid:
+        raise ElasticityError(
+            f"no world size in [{ecfg.min_gpus},{ecfg.max_gpus}] compatible with "
+            f"micro_batches={list(ecfg.micro_batch_sizes)} max_batch={ecfg.max_train_batch_size}"
+        )
+
+    micro = None
+    if world_size:
+        replicas = world_size // mp
+        if replicas not in valid:
+            raise ElasticityError(
+                f"world_size {world_size} (= {replicas} replicas × mp {mp}) not in "
+                f"compatible set {sorted(valid)}"
+            )
+        micro = valid[replicas]
+    logger.info(
+        f"elasticity: batch={final_batch} valid_world_sizes={sorted(valid)}"
+        + (f" micro_batch={micro}" if micro else "")
+    )
+    return final_batch, sorted(valid), valid, micro

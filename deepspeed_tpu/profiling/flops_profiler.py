@@ -65,7 +65,10 @@ def _dot_flops(eqn) -> int:
 def _conv_flops(eqn) -> int:
     out = eqn.outvars[0].aval
     rhs = eqn.invars[1].aval
-    return 2 * int(np.prod(out.shape)) * int(np.prod(rhs.shape[1:]))
+    dn = eqn.params.get("dimension_numbers")
+    o_dim = dn.rhs_spec[0] if dn is not None else 0  # kernel's output-feature dim
+    per_output = int(np.prod(rhs.shape)) // int(rhs.shape[o_dim])
+    return 2 * int(np.prod(out.shape)) * per_output
 
 
 def flops_by_op(fn: Callable, *args, **kwargs) -> Dict[str, int]:
@@ -84,13 +87,18 @@ def flops_by_op(fn: Callable, *args, **kwargs) -> Dict[str, int]:
             else:
                 # scan bodies run `length` times; other sub-jaxprs once
                 sub_mult = mult * int(eqn.params.get("length", 1)) if name == "scan" else mult
-                for v in eqn.params.values():
+                def _sub(v):
                     if hasattr(v, "jaxpr"):  # ClosedJaxpr (pjit/scan/cond bodies)
-                        walk(v.jaxpr, sub_mult)
-                    elif isinstance(v, (list, tuple)):
-                        for u in v:
-                            if hasattr(u, "jaxpr"):
-                                walk(u.jaxpr, sub_mult)
+                        return v.jaxpr
+                    if hasattr(v, "eqns"):  # open core.Jaxpr (remat2/custom_jvp)
+                        return v
+                    return None
+
+                for v in eqn.params.values():
+                    for u in v if isinstance(v, (list, tuple)) else (v,):
+                        sub = _sub(u)
+                        if sub is not None:
+                            walk(sub, sub_mult)
         return counts
 
     return walk(jaxpr.jaxpr, 1)
@@ -142,9 +150,14 @@ class ProfileResult:
 
 def get_model_profile(fn: Callable, *args, warmup: int = 1, iters: int = 3,
                       params: Any = None, peak_tflops: Optional[float] = None,
-                      **kwargs) -> ProfileResult:
+                      n_devices: int = 1, **kwargs) -> ProfileResult:
     """Profile a jittable fn (reference ``get_model_profile``
-    flops_profiler/profiler.py — same deliverables: flops, params, latency)."""
+    flops_profiler/profiler.py — same deliverables: flops, params, latency).
+
+    ``n_devices``: how many devices the program is sharded over — XLA cost
+    analysis reports PER-DEVICE flops while the jaxpr walk counts GLOBAL
+    logical flops; the per-op table is divided by this so both agree.
+    """
     # ONE lower+compile serves both execution (AOT call) and cost analysis —
     # a second jit of the same fn would recompile the whole program.
     compiled = jax.jit(fn).lower(*args, **kwargs).compile()
@@ -182,6 +195,7 @@ def get_model_profile(fn: Callable, *args, warmup: int = 1, iters: int = 3,
     except Exception as e:  # noqa: BLE001 - breakdown is best-effort
         logger.debug(f"per-op flop breakdown unavailable: {e}")
         per_op = {}
+    per_op = {k: v // max(n_devices, 1) for k, v in per_op.items()}
     if flops <= 0 and per_op:
         # some backends (CPU) omit an aggregate 'flops' key — fall back to the
         # jaxpr-derived matmul/conv count (a lower bound on true flops)
@@ -209,6 +223,7 @@ class FlopsProfiler:
 
     def __init__(self, engine=None, config=None):
         self.engine = engine
+        # the single config the engine trigger reads (engine.train_batch)
         self.config = config or (engine.config.model.flops_profiler if engine else None)
         self.result: Optional[ProfileResult] = None
         self._armed = False
@@ -223,17 +238,49 @@ class FlopsProfiler:
     def armed(self) -> bool:
         return self._armed
 
-    def profile_engine_step(self, batch) -> ProfileResult:
-        """Profile the engine's compiled train step on ``batch``."""
+    def profile_engine_step(self, batch):
+        """Profile THE engine's compiled step on ``batch`` and execute it once.
+
+        Uses ``engine._train_step`` itself (donation + shardings intact, jit
+        cache shared — no second compilation, no un-donated state copy) and
+        returns ``(new_state, metrics)``: the caller applies this as the real
+        training step for the batch, so profiling never double-steps.
+        """
         e = self.engine
         state = e.state
+        compiled = e._train_step.lower(state, batch).compile()
+        costs = _costs_of(compiled)
+        flops = float(costs.get("flops", 0.0))
 
-        def step_fn(state, batch):
-            return e._train_step(state, batch)
+        import jax.numpy as jnp
 
-        self.result = get_model_profile(step_fn, state, batch, params=state.params)
+        t0 = time.perf_counter()
+        new_state, metrics = e._train_step(state, batch)
+        np.asarray(jnp.sum(metrics["loss"]))  # scalar-transfer execution barrier
+        latency = time.perf_counter() - t0
+
+        n_dev = max(e.mesh.size, 1)
+        try:
+            per_op = {k: v // n_dev for k, v in flops_by_op(e._train_step, state, batch).items()}
+        except Exception as ex:  # noqa: BLE001 - breakdown is best-effort
+            logger.debug(f"per-op flop breakdown unavailable: {ex}")
+            per_op = {}
+        if flops <= 0 and per_op:
+            flops = float(sum(per_op.values()))
+        n_params = int(sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(state.params)))
+        peak = PEAK_TFLOPS.get(_detect_chip(), 0.0)
+        achieved = flops / latency / 1e12 if latency > 0 else 0.0
+        self.result = ProfileResult(
+            flops_per_step=flops,
+            bytes_accessed=float(costs.get("bytes accessed", 0.0)),
+            params=n_params,
+            latency_s=latency,
+            achieved_tflops=achieved,
+            mfu=(achieved / peak if peak else 0.0),
+            per_op_flops=per_op,
+        )
         self._armed = False
-        return self.result
+        return new_state, metrics
 
     # ------------------------------------------------------------ reporting
     def get_total_flops(self) -> float:
@@ -258,10 +305,10 @@ class FlopsProfiler:
             f"achieved:           {r.achieved_tflops:.2f} TFLOPS (MFU {r.mfu*100:.1f}%)",
         ]
         if r.per_op_flops:
+            total = max(sum(r.per_op_flops.values()), 1)
             lines.append("top ops by flops:")
             for name, fl in sorted(r.per_op_flops.items(), key=lambda kv: -kv[1])[:top]:
-                share = fl / max(r.flops_per_step, 1)
-                lines.append(f"  {name:<24} {fl/1e9:>10.2f} GFLOPs  ({share*100:.0f}%)")
+                lines.append(f"  {name:<24} {fl/1e9:>10.2f} GFLOPs  ({fl/total*100:.0f}% of matmul/conv)")
         report = "\n".join(lines)
         log_dist(report, ranks=[0])
         return report

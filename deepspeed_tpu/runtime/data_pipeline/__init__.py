@@ -1,0 +1,11 @@
+"""deepspeed_tpu.runtime.data_pipeline: data-efficiency suite.
+
+Reference: ``deepspeed/runtime/data_pipeline/`` (~3.2k LoC) — curriculum
+learning (difficulty schedules + metric-filtered sampling), random layerwise
+token dropping (random-LTD), and variable-batch/LR packing.
+"""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import RandomLTDScheduler, random_ltd_gather, random_ltd_scatter
+from deepspeed_tpu.runtime.data_pipeline.variable_batch import batch_by_tokens, scale_lr_by_batch

@@ -1,0 +1,56 @@
+"""Curriculum difficulty schedules.
+
+Reference: ``runtime/data_pipeline/curriculum_scheduler.py`` —
+``CurriculumScheduler`` maps global step -> difficulty (e.g. max sequence
+length), with fixed_linear / fixed_root / fixed_discrete / custom schedules.
+Pure host-side math; the engine truncates/filters batches with the result.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, List, Optional
+
+
+class CurriculumScheduler:
+    """step -> difficulty (reference class of the same name)."""
+
+    def __init__(self, config: Dict):
+        self.schedule_type = config.get("curriculum_type", config.get("schedule_type", "fixed_linear"))
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        sc = config.get("schedule_config", {})
+        self.total_steps = int(sc.get("total_curriculum_step", sc.get("total_steps", 1000)))
+        self.difficulty_step = int(sc.get("difficulty_step", 1))
+        self.root_degree = int(sc.get("root_degree", 2))
+        self.difficulties: List[int] = list(sc.get("difficulty", []))
+        self.max_steps: List[int] = list(sc.get("max_step", []))
+        self._custom: Optional[Callable[[int], int]] = config.get("custom_fn")
+        if self.schedule_type == "fixed_discrete" and len(self.difficulties) != len(self.max_steps) + 1:
+            raise ValueError("fixed_discrete needs len(difficulty) == len(max_step) + 1")
+        self.current_difficulty = self.min_difficulty
+
+    def _clamp_quantize(self, d: float) -> int:
+        d = int(d // self.difficulty_step * self.difficulty_step)
+        return max(self.min_difficulty, min(self.max_difficulty, d))
+
+    def get_difficulty(self, global_step: int) -> int:
+        t = max(global_step, 0)
+        if self._custom is not None:
+            return int(self._custom(t))
+        if self.schedule_type == "fixed_discrete":
+            for d, until in zip(self.difficulties, self.max_steps):
+                if t < until:
+                    return d
+            return self.difficulties[-1]
+        frac = min(t / max(self.total_steps, 1), 1.0)
+        if self.schedule_type == "fixed_root":
+            frac = frac ** (1.0 / self.root_degree)
+        elif self.schedule_type != "fixed_linear":
+            raise ValueError(f"unknown curriculum_type {self.schedule_type!r}")
+        d = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        return self._clamp_quantize(d)
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty

@@ -1,0 +1,90 @@
+"""Curriculum-aware distributed sampler.
+
+Reference: ``data_sampling/data_sampler.py:36 DeepSpeedDataSampler`` — serves
+index batches restricted to samples whose difficulty metric is within the
+current curriculum difficulty, sharded across dp ranks. Here one host builds
+GLOBAL batches (SPMD: the engine shards the leading dim over dp), so the
+sampler yields global index batches; determinism comes from a seeded
+per-epoch permutation as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+    """Difficulty-filtered batch sampler (reference :36)."""
+
+    def __init__(
+        self,
+        num_samples: int,
+        batch_size: int,
+        difficulties: Optional[Sequence[float]] = None,
+        curriculum: Optional[CurriculumScheduler] = None,
+        seed: int = 1234,
+        drop_last: bool = True,
+    ):
+        self.num_samples = num_samples
+        self.batch_size = batch_size
+        self.difficulties = None if difficulties is None else np.asarray(difficulties)
+        if self.difficulties is not None and len(self.difficulties) != num_samples:
+            raise ValueError("difficulties must have one entry per sample")
+        self.curriculum = curriculum
+        self.seed = seed
+        self.drop_last = drop_last
+        self.global_step = 0
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def state_dict(self) -> Dict:
+        return {"global_step": self.global_step, "epoch": self.epoch}
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self.global_step = sd["global_step"]
+        self.epoch = sd["epoch"]
+
+    def _eligible(self) -> np.ndarray:
+        if self.curriculum is None or self.difficulties is None:
+            return np.arange(self.num_samples)
+        cap = self.curriculum.update_difficulty(self.global_step)
+        idx = np.nonzero(self.difficulties <= cap)[0]
+        # curriculum must never starve the loader (reference keeps at least
+        # one batch available by construction of min_difficulty)
+        if len(idx) < self.batch_size:
+            order = np.argsort(self.difficulties)
+            idx = order[: self.batch_size]
+        return idx
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        rng = np.random.RandomState(self.seed + self.epoch)
+        perm = rng.permutation(self.num_samples)
+        cursor = 0
+        while True:
+            eligible = set(self._eligible().tolist())
+            batch: List[int] = []
+            scanned = 0
+            while len(batch) < self.batch_size and scanned < self.num_samples:
+                i = perm[cursor % self.num_samples]
+                cursor += 1
+                scanned += 1
+                if i in eligible:
+                    batch.append(int(i))
+            if len(batch) < self.batch_size:
+                if self.drop_last or not batch:
+                    return
+                yield np.asarray(batch)
+                return
+            self.global_step += 1
+            yield np.asarray(batch)
+            if cursor >= self.num_samples * (self.epoch + 1):
+                return
+
+    def __len__(self) -> int:
+        return self.num_samples // self.batch_size

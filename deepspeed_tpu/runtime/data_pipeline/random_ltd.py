@@ -1,0 +1,84 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: ``data_pipeline/data_routing/basic_layer.py:14 RandomLayerTokenDrop``
++ ``scheduler.py`` + CUDA gather/scatter kernels (``csrc/random_ltd/``). The
+middle layers of a transformer see only a random subset of tokens; the subset
+is gathered before and scattered back after, and the kept-token count anneals
+from ``initial_seq_len`` up to the full length.
+
+TPU design: gather/scatter are ``jnp.take_along_axis`` (XLA compiles these to
+efficient dynamic-gather — the CUDA kernels aren't needed), and the random
+subset is SORTED so position encodings stay monotone (reference keeps order
+too). The kept count must be static per compiled step: the scheduler
+quantizes it to ``step_granularity`` so recompiles are bounded.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference ``scheduler.py`` RandomLTDScheduler)."""
+
+    def __init__(self, initial_seq_len: int, total_seq_len: int,
+                 schedule_steps: int, step_granularity: int = 16):
+        self.initial = initial_seq_len
+        self.total = total_seq_len
+        self.steps = max(schedule_steps, 1)
+        self.gran = max(step_granularity, 1)
+        self.current_seq_len = initial_seq_len
+
+    def get_seq_len(self, global_step: int) -> int:
+        frac = min(max(global_step, 0) / self.steps, 1.0)
+        n = self.initial + frac * (self.total - self.initial)
+        n = int(n // self.gran * self.gran)
+        return max(self.initial, min(self.total, n))
+
+    def update(self, global_step: int) -> int:
+        self.current_seq_len = self.get_seq_len(global_step)
+        return self.current_seq_len
+
+    def state_dict(self) -> Dict:
+        return {"current_seq_len": self.current_seq_len}
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self.current_seq_len = sd["current_seq_len"]
+
+
+def sample_token_indices(rng: jax.Array, batch: int, seq_len: int, keep: int) -> jax.Array:
+    """[B, keep] sorted random token indices (one independent draw per row)."""
+    noise = jax.random.uniform(rng, (batch, seq_len))
+    _, idx = jax.lax.top_k(-noise, keep)  # random subset without replacement
+    return jnp.sort(idx, axis=-1)
+
+
+def random_ltd_gather(x: jax.Array, indices: jax.Array) -> jax.Array:
+    """[B, S, ...] -> [B, keep, ...] (reference gather kernel
+    csrc/random_ltd/token_sort.cu — here one XLA gather)."""
+    idx = indices.reshape(indices.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(x, idx.astype(jnp.int32), axis=1)
+
+
+def random_ltd_scatter(sub: jax.Array, indices: jax.Array, full: jax.Array) -> jax.Array:
+    """Scatter [B, keep, ...] back into a copy of [B, S, ...]: dropped tokens
+    keep their pre-layer activations (the reference's pass-through,
+    csrc/random_ltd/token_scatter kernels — here one XLA scatter)."""
+    b = jnp.arange(full.shape[0])[:, None]
+    return full.at[b, indices].set(sub)
+
+
+def apply_random_ltd(layer_fn, x: jax.Array, rng: jax.Array, keep: int):
+    """Run ``layer_fn`` on a random token subset; others bypass the layer
+    (reference ``RandomLayerTokenDrop.forward``). keep must be static."""
+    B, S = x.shape[:2]
+    if keep >= S:
+        return layer_fn(x)
+    idx = sample_token_indices(rng, B, S, keep)
+    sub = random_ltd_gather(x, idx)
+    sub = layer_fn(sub)
+    b = jnp.arange(B)[:, None]
+    return x.at[b, idx].set(sub)

@@ -1,0 +1,62 @@
+"""Variable batch size + LR scaling for length-grouped batching.
+
+Reference: ``data_pipeline/data_sampling/variable_batch_size_and_lr.py:226``
+— pack samples of varying sequence length into batches with roughly equal
+TOKEN counts (so step compute is uniform), then scale LR per batch for the
+changed effective batch size. On TPU, batches are additionally bucketed to a
+few shapes so XLA compiles a handful of programs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+def batch_by_tokens(
+    seq_lens: Sequence[int],
+    max_tokens_per_batch: int,
+    shuffle_seed: int = None,
+    len_bucket: int = 64,
+    min_batch_size: int = 1,
+) -> List[np.ndarray]:
+    """Greedy equal-token packing (reference ``batch_by_size``).
+
+    Samples are grouped by padded-length bucket so each batch pads to one
+    shape; within a bucket, batch_size = max_tokens // padded_len.
+    """
+    lens = np.asarray(seq_lens)
+    order = np.argsort(lens, kind="stable")
+    batches: List[np.ndarray] = []
+    i = 0
+    while i < len(order):
+        batch: List[int] = []
+        padded = 0
+        while i < len(order):
+            L = int(lens[order[i]])
+            pl = -(-max(L, 1) // len_bucket) * len_bucket
+            grown = max(padded, pl)
+            if batch and (len(batch) + 1) * grown > max_tokens_per_batch and len(batch) >= min_batch_size:
+                break
+            batch.append(int(order[i]))
+            padded = grown
+            i += 1
+        batches.append(np.asarray(batch))
+    if shuffle_seed is not None:
+        np.random.RandomState(shuffle_seed).shuffle(batches)
+    return batches
+
+
+def scale_lr_by_batch(base_lr: float, batch_size: int, base_batch_size: int,
+                      method: str = "linear") -> float:
+    """LR adjustment per variable batch (reference ``scale_lr``): linear or
+    sqrt scaling with effective batch size."""
+    ratio = batch_size / max(base_batch_size, 1)
+    if method == "linear":
+        return base_lr * ratio
+    if method == "sqrt":
+        return base_lr * ratio ** 0.5
+    if method in ("none", None):
+        return base_lr
+    raise ValueError(f"unknown lr scaling method {method!r}")

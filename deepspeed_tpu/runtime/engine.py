@@ -406,19 +406,21 @@ class DeepSpeedTPUEngine:
             placed = self._shard_global_batch(batch)
         else:
             placed = self._stack_micro_batches(data_iter)
-        fp_cfg = self.config.model.flops_profiler
         prof = self.flops_profiler
+        fp_cfg = prof.config
         config_fire = (fp_cfg.enabled and prof.result is None
                        and self.global_steps >= fp_cfg.profile_step)
+        self.throughput_timer.start()
         if prof.armed or config_fire:
             # profile this step's compiled program (reference FlopsProfiler
             # hooks the fwd at profile_step; here it is XLA cost analysis).
             # `result is None` guard: fires once even if global_steps stalls
-            # on fp16 overflow-skipped steps.
-            prof.profile_engine_step(placed)
+            # on fp16 overflow-skipped steps. The profiled execution IS the
+            # training step for this batch (no double-step, no state copy).
+            self.state, metrics = prof.profile_engine_step(placed)
             prof.print_model_profile(top=fp_cfg.top_modules)
-        self.throughput_timer.start()
-        self.state, metrics = self._train_step(self.state, placed)
+        else:
+            self.state, metrics = self._train_step(self.state, placed)
         self.throughput_timer.stop()
         metrics = {k: np.asarray(v) for k, v in metrics.items()}
         self.losses = metrics["loss"]

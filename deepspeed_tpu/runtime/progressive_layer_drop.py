@@ -1,0 +1,49 @@
+"""Progressive Layer Drop (PLD).
+
+Reference: ``runtime/progressive_layer_drop.py:10 ProgressiveLayerDrop`` —
+theta(t) schedule that anneals the keep-probability of transformer layers
+from 1.0 down toward ``theta`` so early training skips layers stochastically.
+The schedule math is identical; the *application* is TPU-idiomatic: the keep
+decision enters the compiled step as a per-layer Bernoulli mask consumed by
+``models.transformer`` (scaled residual branches), not Python control flow.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    """theta(t) = (1 - theta) * exp(-gamma * t) + theta (reference :10)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = (1.0 - self.theta) * math.exp(-self.gamma * global_step) + self.theta
+        return self.current_theta
+
+    def layer_keep_probs(self, num_layers: int, theta: float = None) -> jnp.ndarray:
+        """Per-layer keep probability: deeper layers drop more (reference
+        applies i/L scaling inside the model)."""
+        th = self.current_theta if theta is None else theta
+        depth_scale = jnp.arange(1, num_layers + 1, dtype=jnp.float32) / num_layers
+        return 1.0 - depth_scale * (1.0 - th)
+
+    def sample_keep_mask(self, rng: jax.Array, num_layers: int, theta: float = None) -> jnp.ndarray:
+        """[L] float mask: 1/p when kept (inverted-dropout scaling), 0 when
+        dropped — multiply each layer's residual branch by mask[i]."""
+        probs = self.layer_keep_probs(num_layers, theta)
+        keep = jax.random.bernoulli(rng, probs)
+        return jnp.where(keep, 1.0 / jnp.maximum(probs, 1e-6), 0.0)

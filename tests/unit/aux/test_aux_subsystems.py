@@ -1,0 +1,225 @@
+"""Aux subsystems: elasticity, eigenvalue, PLD, data pipeline, compression,
+autotuner (coverage model: reference tests/unit/{elasticity,autotuning,
+compression,runtime/data_efficiency}/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.unit.simple_model import random_batch, simple_model_spec
+
+
+# ----------------------------------------------------------------- elasticity
+class TestElasticity:
+    def test_compute_elastic_config(self):
+        from deepspeed_tpu.elasticity import compute_elastic_config
+
+        batch, worlds, table, micro = compute_elastic_config(
+            {"max_train_batch_size": 2000, "micro_batch_sizes": [2, 4, 6],
+             "min_gpus": 1, "max_gpus": 100}
+        )
+        assert batch <= 2000 and len(worlds) > 20
+        # every advertised world size must decompose exactly
+        for w, mb in table.items():
+            assert batch % (w * mb) == 0
+
+    def test_world_size_resolution_and_mp(self):
+        from deepspeed_tpu.elasticity import compute_elastic_config, ElasticityError
+
+        batch, worlds, table, micro = compute_elastic_config(
+            {"max_train_batch_size": 512, "micro_batch_sizes": [2, 4],
+             "min_gpus": 1, "max_gpus": 64, "model_parallel_size": 2},
+            world_size=16,  # 8 replicas
+        )
+        assert micro in (2, 4) and 8 in worlds
+        # an incompatible world size must raise
+        bad = max(worlds) * 2 + 1
+        with pytest.raises(ElasticityError):
+            compute_elastic_config(
+                {"max_train_batch_size": 512, "micro_batch_sizes": [2, 4],
+                 "min_gpus": 1, "max_gpus": 64, "model_parallel_size": 2},
+                world_size=bad * 2,
+            )
+
+    def test_bad_config_raises(self):
+        from deepspeed_tpu.elasticity import compute_elastic_config, ElasticityError
+
+        with pytest.raises(ElasticityError):
+            compute_elastic_config({"max_train_batch_size": 1, "micro_batch_sizes": [4]})
+
+
+# ----------------------------------------------------------------- eigenvalue
+def test_dominant_eigenvalue_quadratic():
+    """H of 0.5*x^T diag(d) x is diag(d): power iteration must find max d."""
+    from deepspeed_tpu.runtime.eigenvalue import dominant_eigenvalue
+
+    d = jnp.array([1.0, 5.0, 3.0])
+    loss = lambda p: 0.5 * jnp.sum(d * p["x"] ** 2)
+    eig, vec = dominant_eigenvalue(loss, {"x": jnp.ones(3)}, iters=50)
+    assert abs(eig - 5.0) < 1e-3
+    v = np.asarray(vec["x"])
+    assert abs(abs(v[1]) - 1.0) < 1e-2  # eigenvector concentrated on dim 1
+
+
+def test_eigenvalue_per_block():
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    loss = lambda p: 0.5 * (2.0 * jnp.sum(p["a"] ** 2) + 4.0 * jnp.sum(p["b"] ** 2))
+    out = Eigenvalue(max_iter=50).compute_eigenvalue(loss, {"a": jnp.ones(2), "b": jnp.ones(2)})
+    assert abs(out["a"] - 2.0) < 1e-2 and abs(out["b"] - 4.0) < 1e-2
+
+
+# ----------------------------------------------------------------- PLD
+def test_progressive_layer_drop_schedule():
+    from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    early = pld.update_state(0)
+    late = pld.update_state(10000)
+    assert early == pytest.approx(1.0) and late == pytest.approx(0.5, abs=1e-3)
+    probs = np.asarray(pld.layer_keep_probs(4))
+    assert (np.diff(probs) < 0).all()  # deeper layers drop more
+    mask = np.asarray(pld.sample_keep_mask(jax.random.PRNGKey(0), 4))
+    assert ((mask == 0) | (mask >= 1.0)).all()
+
+
+# ----------------------------------------------------------------- curriculum
+def test_curriculum_schedules():
+    from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+    lin = CurriculumScheduler({"curriculum_type": "fixed_linear", "min_difficulty": 8,
+                               "max_difficulty": 64,
+                               "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+    assert lin.get_difficulty(0) == 8
+    assert lin.get_difficulty(100) == 64
+    assert lin.get_difficulty(50) == 32
+    root = CurriculumScheduler({"curriculum_type": "fixed_root", "min_difficulty": 8,
+                                "max_difficulty": 64,
+                                "schedule_config": {"total_curriculum_step": 100, "root_degree": 2}})
+    assert root.get_difficulty(25) > lin.get_difficulty(25)  # root ramps faster early
+    disc = CurriculumScheduler({"curriculum_type": "fixed_discrete", "min_difficulty": 1,
+                                "max_difficulty": 3,
+                                "schedule_config": {"difficulty": [1, 2, 3], "max_step": [10, 20]}})
+    assert disc.get_difficulty(5) == 1 and disc.get_difficulty(15) == 2 and disc.get_difficulty(99) == 3
+
+
+def test_data_sampler_curriculum_filters():
+    from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler, DeepSpeedDataSampler
+
+    n = 64
+    difficulties = np.arange(n) % 32  # 0..31
+    cur = CurriculumScheduler({"curriculum_type": "fixed_linear", "min_difficulty": 4,
+                               "max_difficulty": 32,
+                               "schedule_config": {"total_curriculum_step": 8, "difficulty_step": 1}})
+    s = DeepSpeedDataSampler(n, batch_size=8, difficulties=difficulties, curriculum=cur, seed=0)
+    first = next(iter(s))
+    assert (difficulties[first] <= 4).all()  # early batches only easy samples
+    # reproducibility
+    s2 = DeepSpeedDataSampler(n, batch_size=8, difficulties=difficulties,
+                              curriculum=CurriculumScheduler({"curriculum_type": "fixed_linear",
+                                                              "min_difficulty": 4, "max_difficulty": 32,
+                                                              "schedule_config": {"total_curriculum_step": 8}}),
+                              seed=0)
+    np.testing.assert_array_equal(first, next(iter(s2)))
+
+
+# ----------------------------------------------------------------- random-LTD
+def test_random_ltd_schedule_and_layer():
+    from deepspeed_tpu.runtime.data_pipeline import RandomLTDScheduler
+    from deepspeed_tpu.runtime.data_pipeline.random_ltd import apply_random_ltd
+
+    sch = RandomLTDScheduler(initial_seq_len=32, total_seq_len=128,
+                             schedule_steps=100, step_granularity=16)
+    assert sch.get_seq_len(0) == 32 and sch.get_seq_len(100) == 128
+    assert sch.get_seq_len(50) % 16 == 0
+
+    x = jnp.arange(2 * 16 * 4, dtype=jnp.float32).reshape(2, 16, 4)
+    out = apply_random_ltd(lambda t: t + 100.0, x, jax.random.PRNGKey(0), keep=8)
+    changed = np.asarray((out != x).any(-1).sum(axis=1))
+    np.testing.assert_array_equal(changed, [8, 8])  # exactly `keep` tokens touched
+    # keep >= S: whole batch goes through
+    out_full = apply_random_ltd(lambda t: t + 100.0, x, jax.random.PRNGKey(0), keep=16)
+    np.testing.assert_allclose(np.asarray(out_full), np.asarray(x) + 100.0)
+
+
+def test_variable_batch_packing():
+    from deepspeed_tpu.runtime.data_pipeline import batch_by_tokens, scale_lr_by_batch
+
+    lens = [10, 100, 12, 90, 500, 8]
+    batches = batch_by_tokens(lens, max_tokens_per_batch=1024, len_bucket=64)
+    assert sorted(i for b in batches for i in b) == list(range(6))
+    for b in batches:
+        padded = -(-max(lens[i] for i in b) // 64) * 64
+        assert len(b) * padded <= 1024 or len(b) == 1
+    assert scale_lr_by_batch(1e-3, 32, 16, "linear") == pytest.approx(2e-3)
+    assert scale_lr_by_batch(1e-3, 64, 16, "sqrt") == pytest.approx(2e-3)
+
+
+# ----------------------------------------------------------------- compression
+class TestCompression:
+    def test_fake_quantize_ste(self):
+        from deepspeed_tpu.compression import fake_quantize
+
+        w = jnp.linspace(-1, 1, 64).reshape(8, 8)
+        q8 = fake_quantize(w, bits=8)
+        q2 = fake_quantize(w, bits=2)
+        assert float(jnp.abs(q8 - w).max()) < float(jnp.abs(q2 - w).max())
+        # STE: gradient passes through unchanged
+        g = jax.grad(lambda w: jnp.sum(fake_quantize(w, bits=4) * 2.0))(w)
+        np.testing.assert_allclose(np.asarray(g), 2.0)
+
+    def test_prune_masks(self):
+        from deepspeed_tpu.compression import head_prune_mask, magnitude_prune_mask, row_prune_mask
+
+        w = jnp.arange(1.0, 17.0).reshape(4, 4)
+        m = magnitude_prune_mask(w, sparsity=0.5)
+        assert float(m.sum()) == 8
+        rm = row_prune_mask(w, sparsity=0.5, axis=0)
+        assert float(rm.sum()) == 8 and set(np.asarray(rm.sum(axis=1)).tolist()) == {0.0, 4.0}
+        hw = jnp.arange(1.0, 25.0).reshape(2, 3, 4)  # [emb, heads, hd]
+        hm = head_prune_mask(hw, sparsity=1 / 3, num_heads=3, head_axis=1)
+        assert set(np.asarray(hm.sum(axis=(0, 2))).tolist()) == {0.0, 8.0}
+
+    def test_apply_compression_schedule_and_layer_reduction(self):
+        from deepspeed_tpu.compression import apply_compression
+
+        params = {"layers": {"w": jnp.ones((4, 8, 8))}, "head": {"kernel": jnp.ones((8, 8))}}
+        cfg = {
+            "weight_quantization": {"shared_parameters": {"schedule_offset": 100,
+                                                          "target_bits": 4}},
+            "layer_reduction": {"enabled": True, "keep_number_layer": 2},
+        }
+        early = apply_compression(params, cfg, step=0)
+        assert early["layers"]["w"].shape[0] == 2  # reduction is schedule-free
+        late = apply_compression(params, cfg, step=200)
+        assert late["head"]["kernel"].shape == (8, 8)
+
+    def test_init_compression_wraps_loss(self):
+        from deepspeed_tpu.compression import init_compression
+
+        sched, compress = init_compression(
+            {"weight_quantization": {"shared_parameters": {"schedule_offset": 5, "target_bits": 8}}}
+        )
+        assert not sched.is_active("weight_quantization", 0)
+        assert sched.is_active("weight_quantization", 5)
+        p = {"k": jnp.ones((4, 4)) * 0.3}
+        before = compress(p, step=0)["k"]
+        np.testing.assert_allclose(np.asarray(before), 0.3)
+
+
+# ----------------------------------------------------------------- autotuner
+def test_autotuner_picks_viable_config(devices):
+    from deepspeed_tpu.autotuning import Autotuner, estimate_state_memory
+
+    # memory model sanity: sharding reduces footprint monotonically
+    est = [estimate_state_memory(int(1e6), s, dp_world=8) for s in range(4)]
+    assert est[0] > est[1] > est[2] > est[3]
+
+    base = {"optimizer": {"type": "Adam", "params": {"lr": 1e-2}}, "steps_per_print": 1000}
+    tuner = Autotuner(simple_model_spec(), base,
+                      micro_batch_candidates=(2,), stage_candidates=(0, 1))
+    best, results = tuner.tune(steps=2, batch_fn=lambda s: random_batch(16, seed=s))
+    assert best["zero_optimization"]["stage"] in (0, 1)
+    assert all(r.ok for r in results) and len(results) == 2

@@ -76,3 +76,17 @@ def test_profiler_fires_once_and_rearms(devices):
     e.train_batch(random_batch(e.train_batch_size, seed=10))
     assert e.flops_profiler.result is not first
     assert not e.flops_profiler.armed  # disarmed itself
+
+
+def test_flops_by_op_counts_remat_bodies():
+    """jax.checkpoint (remat2) bodies must be walked: grad of a remat'd
+    matmul re-runs the forward plus two backward dots."""
+    w = jnp.ones((16, 16)); x = jnp.ones((4, 16))
+
+    def fn(w, x):
+        f = jax.checkpoint(lambda w, x: (x @ w).sum())
+        return jax.grad(f)(w, x)
+
+    counts = flops_by_op(fn, w, x)
+    base = 2 * 4 * 16 * 16
+    assert counts["dot_general"] >= 2 * base  # fwd recompute + bwd dots
