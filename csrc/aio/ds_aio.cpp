@@ -1,0 +1,196 @@
+// Async file I/O thread pool for deepspeed_tpu.
+//
+// TPU-native analog of the reference DeepNVMe/AIO native layer
+// (csrc/aio/common/* + py_lib/py_ds_aio.cpp: aio_read/aio_write handles with
+// a pthread worker pool over pread/pwrite). Rationale is identical: Python
+// threads serialize on the GIL and synchronous IO stalls the training loop;
+// a C++ pool drives NVMe queues from outside the interpreter while JAX's
+// async dispatch keeps the TPU busy. Plain pread/pwrite on worker threads
+// (the reference's aio_handle also supports this mode); io_uring/libaio can
+// slot behind the same interface later.
+//
+// C ABI (ctypes-friendly, no pybind11 in this image):
+//   pool  = ds_aio_pool_create(num_threads)
+//   req   = ds_aio_submit(pool, path, buf, nbytes, file_offset, is_write)
+//   ok    = ds_aio_wait(pool, req)        // 0 on success, -errno on failure
+//   n     = ds_aio_wait_all(pool)         // number of failed requests
+//           ds_aio_pool_destroy(pool)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+#include <unistd.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+
+namespace {
+
+struct Request {
+  std::string path;
+  char* buf = nullptr;
+  long nbytes = 0;
+  long offset = 0;
+  bool is_write = false;
+  bool claimed = false;        // guarded by Pool::mu — one waiter owns a request
+  std::atomic<int> status{1};  // 1 = pending, 0 = ok, <0 = -errno
+};
+
+struct Pool {
+  std::vector<std::thread> workers;
+  std::deque<long> queue;
+  std::unordered_map<long, Request*> requests;
+  std::mutex mu;
+  std::condition_variable cv_submit;   // workers wait for work
+  std::condition_variable cv_done;     // waiters wait for completions
+  long next_id = 1;
+  bool stopping = false;
+
+  explicit Pool(int num_threads) {
+    for (int i = 0; i < num_threads; ++i) {
+      workers.emplace_back([this] { run(); });
+    }
+  }
+
+  ~Pool() {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      stopping = true;
+    }
+    cv_submit.notify_all();
+    for (auto& t : workers) t.join();
+    for (auto& kv : requests) delete kv.second;
+  }
+
+  static int do_io(Request* r) {
+    const int flags = r->is_write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+    int fd = ::open(r->path.c_str(), flags, 0644);
+    if (fd < 0) return -errno;
+    long done = 0;
+    int rc = 0;
+    while (done < r->nbytes) {
+      ssize_t n = r->is_write
+                      ? ::pwrite(fd, r->buf + done, r->nbytes - done, r->offset + done)
+                      : ::pread(fd, r->buf + done, r->nbytes - done, r->offset + done);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        rc = -errno;
+        break;
+      }
+      if (n == 0) {  // short read: file smaller than requested
+        rc = -1;
+        break;
+      }
+      done += n;
+    }
+    ::close(fd);
+    return rc;
+  }
+
+  void run() {
+    for (;;) {
+      Request* r = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_submit.wait(lk, [this] { return stopping || !queue.empty(); });
+        if (stopping && queue.empty()) return;
+        long id = queue.front();
+        queue.pop_front();
+        r = requests[id];
+      }
+      int rc = do_io(r);
+      {
+        // store + notify under the mutex: a waiter that checked the predicate
+        // and is about to block must not miss this wakeup
+        std::lock_guard<std::mutex> g(mu);
+        r->status.store(rc);
+      }
+      cv_done.notify_all();
+    }
+  }
+
+  long submit(const char* path, void* buf, long nbytes, long offset, int is_write) {
+    auto* r = new Request();
+    r->path = path;
+    r->buf = static_cast<char*>(buf);
+    r->nbytes = nbytes;
+    r->offset = offset;
+    r->is_write = is_write != 0;
+    long id;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      id = next_id++;
+      requests[id] = r;
+      queue.push_back(id);
+    }
+    cv_submit.notify_one();
+    return id;
+  }
+
+  int wait(long id) {
+    Request* r;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      auto it = requests.find(id);
+      if (it == requests.end()) return -2;  // unknown id (double wait)
+      r = it->second;
+      if (r->claimed) return -2;  // another waiter owns it (concurrent wait)
+      r->claimed = true;
+    }
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_done.wait(lk, [r] { return r->status.load() != 1; });
+    }
+    int rc = r->status.load();
+    {
+      std::lock_guard<std::mutex> g(mu);
+      requests.erase(id);
+    }
+    delete r;
+    return rc;
+  }
+
+  int wait_all() {
+    std::vector<long> ids;
+    {
+      std::lock_guard<std::mutex> g(mu);
+      ids.reserve(requests.size());
+      for (auto& kv : requests) ids.push_back(kv.first);
+    }
+    int failures = 0;
+    for (long id : ids) {
+      int rc = wait(id);
+      if (rc != 0 && rc != -2) ++failures;  // -2: claimed by a concurrent waiter
+    }
+    return failures;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ds_aio_pool_create(int num_threads) {
+  return new Pool(num_threads > 0 ? num_threads : 4);
+}
+
+void ds_aio_pool_destroy(void* pool) { delete static_cast<Pool*>(pool); }
+
+long ds_aio_submit(void* pool, const char* path, void* buf, long nbytes,
+                   long offset, int is_write) {
+  return static_cast<Pool*>(pool)->submit(path, buf, nbytes, offset, is_write);
+}
+
+int ds_aio_wait(void* pool, long req_id) {
+  return static_cast<Pool*>(pool)->wait(req_id);
+}
+
+int ds_aio_wait_all(void* pool) { return static_cast<Pool*>(pool)->wait_all(); }
+
+}  // extern "C"

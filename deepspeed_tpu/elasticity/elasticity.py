@@ -59,8 +59,14 @@ def _highly_composite(limit: int) -> List[int]:
         if d > best:
             best = d
             out.append(n)
-        # jump: HCNs are sparse; stepping by 1 is fine below ~1e6
-        n += 1 if n < 10000 else (60 if n < 100000 else 840)
+        # jump: HCNs are sparse above 10k and all are multiples of 60 (of
+        # 840 above 100k) — step to the NEXT multiple so none is skipped
+        if n < 10000:
+            n += 1
+        elif n < 100000:
+            n += 60 - (n % 60) if n % 60 else 60
+        else:
+            n += 840 - (n % 840) if n % 840 else 840
     return out
 
 

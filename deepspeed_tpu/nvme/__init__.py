@@ -1,0 +1,4 @@
+"""deepspeed_tpu.nvme: IO performance tooling (reference ``deepspeed/nvme/``
++ ``bin/ds_io``/``ds_nvme_tune``)."""
+
+from deepspeed_tpu.nvme.perf import run_io_benchmark, sweep_io_config

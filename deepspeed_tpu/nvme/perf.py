@@ -1,0 +1,83 @@
+"""NVMe IO benchmark + tuner (reference ``bin/ds_io`` / ``bin/ds_nvme_tune``
+→ ``deepspeed/nvme/perf_run_sweep.py``): measure read/write GB/s through the
+native AIO pool and sweep thread counts for the best config.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.aio import AioHandle
+from deepspeed_tpu.utils.logging import logger
+
+
+def run_io_benchmark(folder: str, size_mb: int = 64, num_threads: int = 4,
+                     chunks: int = 8, keep: bool = False) -> Dict[str, float]:
+    """Write + read ``size_mb`` in ``chunks`` parallel requests -> GB/s."""
+    os.makedirs(folder, exist_ok=True)
+    handle = AioHandle(num_threads=num_threads)
+    n = size_mb * (1 << 20) // chunks
+    bufs = [np.random.randint(0, 255, n, np.uint8) for _ in range(chunks)]
+    paths = [os.path.join(folder, f"ds_io_{i}.bin") for i in range(chunks)]
+    try:
+        t0 = time.perf_counter()
+        for b, p in zip(bufs, paths):
+            handle.async_pwrite(b, p)
+        handle.wait_all()
+        wt = time.perf_counter() - t0
+
+        reads = [np.empty(n, np.uint8) for _ in range(chunks)]
+        t0 = time.perf_counter()
+        for b, p in zip(reads, paths):
+            handle.async_pread(b, p)
+        handle.wait_all()
+        rt = time.perf_counter() - t0
+        for a, b in zip(bufs, reads):
+            if not np.array_equal(a, b):
+                raise RuntimeError("ds_io: readback mismatch")
+        total = size_mb / 1024
+        return {"write_gbps": total / wt, "read_gbps": total / rt,
+                "size_mb": size_mb, "num_threads": num_threads}
+    finally:
+        handle.close()
+        if not keep:
+            for p in paths:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+
+def sweep_io_config(folder: str, size_mb: int = 64,
+                    thread_counts: Optional[List[int]] = None) -> Dict:
+    """ds_nvme_tune analog: pick the thread count with best read bandwidth."""
+    results = []
+    for t in thread_counts or [1, 2, 4, 8]:
+        r = run_io_benchmark(folder, size_mb=size_mb, num_threads=t)
+        logger.info(f"ds_io sweep: threads={t} write={r['write_gbps']:.2f} read={r['read_gbps']:.2f} GB/s")
+        results.append(r)
+    best = max(results, key=lambda r: r["read_gbps"])
+    return {"best": best, "results": results}
+
+
+def main():  # pragma: no cover - CLI shim (bin/ds_io)
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(description="deepspeed_tpu IO benchmark (ds_io analog)")
+    p.add_argument("folder")
+    p.add_argument("--size-mb", type=int, default=256)
+    p.add_argument("--threads", type=int, default=0, help="0 = sweep")
+    a = p.parse_args()
+    if a.threads:
+        print(json.dumps(run_io_benchmark(a.folder, a.size_mb, a.threads)))
+    else:
+        print(json.dumps(sweep_io_config(a.folder, a.size_mb)))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -241,10 +241,11 @@ class FlopsProfiler:
     def profile_engine_step(self, batch):
         """Profile THE engine's compiled step on ``batch`` and execute it once.
 
-        Uses ``engine._train_step`` itself (donation + shardings intact, jit
-        cache shared — no second compilation, no un-donated state copy) and
-        returns ``(new_state, metrics)``: the caller applies this as the real
-        training step for the batch, so profiling never double-steps.
+        Lowers+compiles the engine's step once (AOT — donation and shardings
+        preserved from the jit wrapper) and EXECUTES that same AOT object for
+        the timed step, returning ``(new_state, metrics)``: the caller applies
+        this as the real training step for the batch, so profiling never
+        double-steps and the timed program is exactly the profiled one.
         """
         e = self.engine
         state = e.state
@@ -255,7 +256,7 @@ class FlopsProfiler:
         import jax.numpy as jnp
 
         t0 = time.perf_counter()
-        new_state, metrics = e._train_step(state, batch)
+        new_state, metrics = compiled(state, batch)
         np.asarray(jnp.sum(metrics["loss"]))  # scalar-transfer execution barrier
         latency = time.perf_counter() - t0
 

@@ -83,7 +83,7 @@ class DeepSpeedDataSampler:
                 return
             self.global_step += 1
             yield np.asarray(batch)
-            if cursor >= self.num_samples * (self.epoch + 1):
+            if cursor >= self.num_samples:  # one pass over the data per epoch
                 return
 
     def __len__(self) -> int:

@@ -56,8 +56,7 @@ def dominant_eigenvalue(
     def run(params, v0, *args):
         v0, _ = _normalize(v0)
 
-        def body(carry, _):
-            v, _ = carry
+        def step(v):
             hv = hvp(loss_fn, params, v, *args)
             v_next, norm = _normalize(hv)
             # Rayleigh quotient == norm when converged; sign from alignment
@@ -65,10 +64,21 @@ def dominant_eigenvalue(
                 jnp.sum(a.astype(jnp.float32) * b.astype(jnp.float32))
                 for a, b in zip(jax.tree_util.tree_leaves(v), jax.tree_util.tree_leaves(v_next))
             )
-            eig = norm * jnp.sign(align)
-            return (v_next, eig), eig
+            return v_next, norm * jnp.sign(align)
 
-        (v, eig), _ = jax.lax.scan(body, (v0, jnp.float32(0)), None, length=iters)
+        def cond(carry):
+            _, eig, prev, i = carry
+            unconverged = jnp.abs(eig - prev) > tol * jnp.maximum(jnp.abs(eig), 1e-12)
+            return (i < iters) & ((i < 2) | unconverged)
+
+        def body(carry):
+            v, eig, _, i = carry
+            v_next, eig_next = step(v)
+            return (v_next, eig_next, eig, i + 1)
+
+        v, eig, _, _ = jax.lax.while_loop(
+            cond, body, (v0, jnp.float32(0), jnp.float32(jnp.inf), jnp.int32(0))
+        )
         return eig, v
 
     eig, v = run(params, v0, *batch_args)

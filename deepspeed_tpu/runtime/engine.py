@@ -410,18 +410,20 @@ class DeepSpeedTPUEngine:
         fp_cfg = prof.config
         config_fire = (fp_cfg.enabled and prof.result is None
                        and self.global_steps >= fp_cfg.profile_step)
-        self.throughput_timer.start()
         if prof.armed or config_fire:
             # profile this step's compiled program (reference FlopsProfiler
             # hooks the fwd at profile_step; here it is XLA cost analysis).
             # `result is None` guard: fires once even if global_steps stalls
             # on fp16 overflow-skipped steps. The profiled execution IS the
-            # training step for this batch (no double-step, no state copy).
+            # training step for this batch (no double-step, no state copy);
+            # the throughput timer skips it — compile/analysis time would
+            # poison the samples/sec history.
             self.state, metrics = prof.profile_engine_step(placed)
             prof.print_model_profile(top=fp_cfg.top_modules)
         else:
+            self.throughput_timer.start()
             self.state, metrics = self._train_step(self.state, placed)
-        self.throughput_timer.stop()
+            self.throughput_timer.stop()
         metrics = {k: np.asarray(v) for k, v in metrics.items()}
         self.losses = metrics["loss"]
         if self.monitor is not None:

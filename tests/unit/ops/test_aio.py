@@ -1,0 +1,121 @@
+"""Native AIO + swap layer tests (coverage model: reference
+tests/unit/ops/aio/test_aio.py + runtime/test_ds_initialize offload paths)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.aio import AioHandle, aio_available
+from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+
+pytestmark = pytest.mark.skipif(not aio_available(), reason="no C++ toolchain")
+
+
+def test_builder_compiles_and_caches():
+    b = AsyncIOBuilder()
+    so1 = b.build()
+    so2 = b.build()
+    assert so1 == so2 and os.path.exists(so1)
+
+
+def test_async_write_read_roundtrip(tmp_path):
+    h = AioHandle(num_threads=2)
+    data = np.random.randint(0, 255, 1 << 20, np.uint8)
+    f = str(tmp_path / "a.bin")
+    req = h.async_pwrite(data, f)
+    h.wait(req)
+    assert os.path.getsize(f) == data.nbytes
+    out = np.empty_like(data)
+    h.pread(out, f)
+    np.testing.assert_array_equal(out, data)
+    h.close()
+
+
+def test_many_overlapping_requests(tmp_path):
+    h = AioHandle(num_threads=4)
+    bufs = [np.full(4096, i, np.uint8) for i in range(32)]
+    for i, b in enumerate(bufs):
+        h.async_pwrite(b, str(tmp_path / f"f{i}.bin"))
+    h.wait_all()
+    outs = [np.empty(4096, np.uint8) for _ in range(32)]
+    reqs = [h.async_pread(o, str(tmp_path / f"f{i}.bin")) for i, o in enumerate(outs)]
+    for r in reqs:
+        h.wait(r)
+    for i, o in enumerate(outs):
+        assert (o == i).all()
+    h.close()
+
+
+def test_offsets_and_errors(tmp_path):
+    h = AioHandle(num_threads=1)
+    f = str(tmp_path / "off.bin")
+    h.pwrite(np.arange(16, dtype=np.uint8), f)
+    h.pwrite(np.arange(100, 104, dtype=np.uint8), f, offset=16)
+    out = np.empty(20, np.uint8)
+    h.pread(out, f)
+    assert out[16] == 100 and out[3] == 3
+    # reading a missing file surfaces an OSError
+    with pytest.raises(OSError):
+        h.pread(np.empty(4, np.uint8), str(tmp_path / "missing.bin"))
+    # short read (file smaller than buffer) is an error, not silence
+    with pytest.raises(OSError):
+        h.pread(np.empty(1 << 20, np.uint8), f)
+    h.close()
+
+
+def test_tensor_swapper_roundtrip(tmp_path, devices):
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.swap_tensor import AsyncTensorSwapper
+
+    sw = AsyncTensorSwapper(str(tmp_path), num_threads=2)
+    tree = {"a": jnp.arange(1024, dtype=jnp.float32), "b": {"c": jnp.ones((8, 8), jnp.bfloat16)}}
+    sw.swap_out("t0", tree)  # async
+    got = sw.swap_in("t0", like=tree)
+    np.testing.assert_array_equal(np.asarray(got["a"]), np.asarray(tree["a"]))
+    assert got["b"]["c"].dtype == jnp.bfloat16
+    sw.release("t0")
+    assert not os.path.exists(os.path.join(str(tmp_path), "t0"))
+    sw.close()
+
+
+def test_optimizer_state_swapper_with_engine(tmp_path, devices):
+    """NVMe optimizer offload around real engine steps: state swapped to disk
+    between steps must reproduce the in-memory trajectory exactly."""
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.swap_tensor import OptimizerStateSwapper
+    from tests.unit.simple_model import random_batch, simple_model_spec
+
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 1000}
+    # baseline: 4 uninterrupted steps
+    e0, *_ = deepspeed_tpu.initialize(model=simple_model_spec(), config=cfg, seed=5)
+    for i in range(4):
+        e0.train_batch(random_batch(e0.train_batch_size, seed=i))
+    baseline = jax.device_get(e0.state.params)
+
+    # swapped run: state goes to disk and back between every step
+    e1, *_ = deepspeed_tpu.initialize(model=simple_model_spec(), config=cfg, seed=5)
+    sw = OptimizerStateSwapper(str(tmp_path / "opt"))
+    for i in range(4):
+        if i > 0:
+            shapes = e1.state.opt_state
+            restored = sw.swap_in_opt_state(like=shapes)
+            e1.state = e1.state._replace(opt_state=restored)
+        e1.train_batch(random_batch(e1.train_batch_size, seed=i))
+        sw.swap_out_opt_state(e1.state.opt_state, wait=False)
+    swapped = jax.device_get(e1.state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(baseline), jax.tree_util.tree_leaves(swapped)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    sw.close()
+
+
+def test_io_benchmark(tmp_path):
+    from deepspeed_tpu.nvme import run_io_benchmark
+
+    r = run_io_benchmark(str(tmp_path), size_mb=8, num_threads=2)
+    assert r["write_gbps"] > 0 and r["read_gbps"] > 0
+    assert not any(f.startswith("ds_io") for f in os.listdir(tmp_path))
