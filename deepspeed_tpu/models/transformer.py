@@ -50,6 +50,7 @@ class TransformerConfig:
     remat: bool = False
     scan_layers: bool = True
     attn_impl: str = "auto"  # auto | xla | flash
+    sp_impl: str = "ulysses"  # ulysses (all-to-all) | ring (ppermute) over sp
     dtype: Any = jnp.float32  # activation dtype inside the module
     # MoE (0 experts => dense MLP). Mirrors reference moe/layer.py knobs.
     num_experts: int = 0
@@ -152,12 +153,20 @@ class Attention(nn.Module):
             k = apply_rope(k, cos, sin, positions)
 
         from deepspeed_tpu.ops import causal_attention
-        from deepspeed_tpu.parallel.ulysses import ulysses_shard, ulysses_unshard
+        from deepspeed_tpu.parallel.ulysses import sp_active, ulysses_shard, ulysses_unshard
 
-        # Ulysses SP: seq-shard -> head-shard all-to-all around exact attention
-        q, k, v = ulysses_shard(q), ulysses_shard(k), ulysses_shard(v)
-        out = causal_attention(q, k, v, mask=mask, impl=cfg.attn_impl)  # [B,S,H,hd]
-        out = ulysses_unshard(out)
+        if cfg.sp_impl == "ring" and sp_active() and mask is None:
+            # ring attention: K/V rotate over the sp ring (ppermute), queries
+            # stay seq-sharded — O(S/P) memory, neighbor-link comm
+            from deepspeed_tpu.parallel.ring_attention import ring_attention
+            from deepspeed_tpu.topology.mesh import get_mesh
+
+            out = ring_attention(q, k, v, mesh=get_mesh(), axis="sp")
+        else:
+            # Ulysses SP: seq-shard -> head-shard all-to-all around exact attention
+            q, k, v = ulysses_shard(q), ulysses_shard(k), ulysses_shard(v)
+            out = causal_attention(q, k, v, mask=mask, impl=cfg.attn_impl)  # [B,S,H,hd]
+            out = ulysses_unshard(out)
         out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), use_bias=cfg.norm == "layernorm",
                               dtype=cfg.dtype, name="wo")(out)
         if cfg.dropout > 0:

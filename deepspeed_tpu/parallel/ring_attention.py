@@ -1,0 +1,135 @@
+"""Ring attention over the ``sp`` mesh axis.
+
+The reference has NO ring attention (SURVEY §2.3: Ulysses all-to-all + FPDT
+chunking is its long-context answer) — this is a TPU-native addition: K/V
+blocks rotate around the sp ring via ``ppermute`` while each device keeps its
+query shard resident, giving exact attention with O(S/P) memory and comm that
+rides neighbor ICI links (vs Ulysses' all-to-all). Comm volume per device is
+O(S) vs Ulysses' O(S/P); use ring when heads < sp or when per-hop overlap
+with the block compute wins (long S), Ulysses otherwise — both compose with
+the same mesh.
+
+Math: classic online-softmax (flash) accumulation per incoming block:
+  m' = max(m, rowmax(s));  l' = l*e^(m-m') + rowsum(e^(s-m'))
+  o' = o*e^(m-m') + e^(s-m') v
+Causality across blocks is decided by the SOURCE block's global position:
+blocks from later positions are masked entirely, the diagonal block gets the
+intra-block triangular mask.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.topology.mesh import get_mesh
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, m, l, o, q_start, k_start, causal: bool):
+    """Online-softmax accumulate one K/V block into (m, l, o).
+
+    q: [B, Sq, Hkv, G, D] (pre-scaled); k/v: [B, Sk, Hkv, D];
+    m/l: [B, Hkv, G, Sq]; o: [B, Sq, Hkv, G, D]. Positions are global.
+    """
+    # HIGHEST: TPU einsum otherwise accumulates in bf16 and near-ties in the
+    # softmax flip attention weights (catastrophic for long sequences)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", q, k.astype(jnp.float32),
+                   precision=jax.lax.Precision.HIGHEST)
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        qpos = q_start + jnp.arange(Sq)
+        kpos = k_start + jnp.arange(Sk)
+        keep = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(keep[None, None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows: e^(m - m_new) with m = -inf stays 0
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha.transpose(0, 3, 1, 2)[..., None] + jnp.einsum(
+        "bhgqk,bkhd->bqhgd", p, v.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jax.Array,  # [B, S, H, D] sequence-sharded over sp
+    k: jax.Array,  # [B, S, Hkv, D]
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    axis: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention with K/V rotating around the ``axis`` ring.
+
+    Inputs/outputs are GLOBAL arrays; sharding over (batch, seq) is applied
+    via shard_map specs — S must divide by the axis size.
+    """
+    mesh = mesh or get_mesh()
+    P_ring = mesh.shape[axis]
+    if P_ring == 1:
+        if causal:
+            from deepspeed_tpu.ops.attention import causal_attention
+
+            return causal_attention(q, k, v)
+        from deepspeed_tpu.sequence.fpdt import chunked_attention
+
+        return chunked_attention(q, k, v, chunk_size=k.shape[1], causal=False)
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    if S % P_ring:
+        raise ValueError(f"seq {S} not divisible by ring size {P_ring}")
+    G = H // Hkv
+    S_loc = S // P_ring
+
+    def local(qb, kb, vb):
+        # qb: [B_loc, S_loc, H, D]; kb/vb: [B_loc, S_loc, Hkv, D] — batch is
+        # dp-sharded too, so take every dim from the LOCAL shard
+        B_loc = qb.shape[0]
+        idx = jax.lax.axis_index(axis)
+        qg = (qb.reshape(B_loc, S_loc, Hkv, G, D).astype(jnp.float32)) * (D ** -0.5)
+        # derive accumulators from qg so they carry the same varying-axis type
+        # as the rotating kb/vb (shard_map's typed-replication rules)
+        o = jnp.zeros_like(qg)
+        m = o[..., 0].transpose(0, 2, 3, 1) + _NEG_INF  # [B, Hkv, G, S_loc]
+        l = o[..., 0].transpose(0, 2, 3, 1)
+        q_start = idx * S_loc
+
+        perm = [(i, (i + 1) % P_ring) for i in range(P_ring)]
+
+        # hop 0: attend the resident block (no comm), then P_ring-1
+        # permute-then-attend rounds — exactly P_ring-1 rotations total
+        m, l, o = _block_attend(qg, kb, vb, m, l, o, q_start, idx * S_loc, causal)
+
+        def body(carry, hop):
+            kb, vb, m, l, o = carry
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            # after `hop` rotations we hold the block born on device idx - hop
+            src = (idx - hop) % P_ring
+            m, l, o = _block_attend(qg, kb, vb, m, l, o, q_start, src * S_loc, causal)
+            return (kb, vb, m, l, o), None
+
+        (kb, vb, m, l, o), _ = jax.lax.scan(
+            body, (kb, vb, m, l, o), jnp.arange(1, P_ring)
+        )
+        out = o / jnp.maximum(l.transpose(0, 3, 1, 2)[..., None], 1e-30)
+        return out.reshape(B_loc, S_loc, H, D).astype(q.dtype)
+
+    from deepspeed_tpu.parallel.ulysses import _live_batch_axes
+
+    batch_axes = _live_batch_axes(mesh)
+    spec_q = P(batch_axes, axis, None, None)
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(spec_q, spec_q, spec_q),
+        out_specs=spec_q,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,9 @@
+"""deepspeed_tpu.sequence: long-context attention machinery.
+
+Reference: ``deepspeed/sequence/`` — Ulysses (``layer.py``, implemented in
+``deepspeed_tpu.parallel.ulysses``) and FPDT/Ulysses-Offload
+(``fpdt_layer.py``, implemented here in ``fpdt.py``); ring attention
+(``deepspeed_tpu.parallel.ring_attention``) is a TPU-native addition.
+"""
+
+from deepspeed_tpu.sequence.fpdt import FPDTAttention, chunked_attention

@@ -1,0 +1,122 @@
+"""FPDT: fully pipelined chunked attention with host offload.
+
+Reference: ``sequence/fpdt_layer.py`` — ``FPDT_Attention`` (:971),
+``_FPDTGPUOffloadingAttentionImpl_`` (:510), ``SequenceChunk`` (:462):
+process a sequence too long for HBM by chunking queries, streaming K/V
+chunks from host memory with double buffering, and merging per-chunk
+attention with online softmax (16× longer sequences at ~55% MFU on the
+reference's hardware).
+
+TPU design:
+  - ``chunked_attention``: on-device ``lax.scan`` over K/V chunks with
+    flash-style (m, l, o) accumulation — peak memory O(S·chunk) instead of
+    O(S²); this is the compute core and also serves as a standalone
+    memory-efficient attention.
+  - ``FPDTAttention``: host-resident K/V (numpy), query chunks processed in
+    sequence; the NEXT K/V chunk's host→device transfer is issued before
+    computing the current one, so JAX's async dispatch overlaps DMA with
+    compute (the reference's double-buffered CUDA streams).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.parallel.ring_attention import _NEG_INF, _block_attend
+
+
+def chunked_attention(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, Hkv, D]
+    v: jax.Array,
+    chunk_size: int = 1024,
+    causal: bool = True,
+    q_offset: int = 0,
+) -> jax.Array:
+    """Exact attention via online-softmax over K/V chunks (one compiled scan).
+
+    ``q_offset``: global position of q[0] relative to k[0] (FPDT query-chunk
+    processing passes the chunk's start; 0 for self-attention).
+    """
+    B, Sq, H, D = q.shape
+    Sk, Hkv = k.shape[1], k.shape[2]
+    G = H // Hkv
+    C = min(chunk_size, Sk)
+    if Sk % C:
+        raise ValueError(f"kv length {Sk} not divisible by chunk {C}")
+    n_chunks = Sk // C
+
+    qg = q.reshape(B, Sq, Hkv, G, D).astype(jnp.float32) * (D ** -0.5)
+    kc = k.reshape(B, n_chunks, C, Hkv, D).transpose(1, 0, 2, 3, 4)
+    vc = v.reshape(B, n_chunks, C, Hkv, D).transpose(1, 0, 2, 3, 4)
+
+    m0 = jnp.full((B, Hkv, G, Sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, G, Sq), jnp.float32)
+    o0 = jnp.zeros((B, Sq, Hkv, G, D), jnp.float32)
+
+    def body(carry, xs):
+        m, l, o = carry
+        i, kb, vb = xs
+        m, l, o = _block_attend(qg, kb, vb, m, l, o, q_offset, i * C, causal)
+        return (m, l, o), None
+
+    (m, l, o), _ = jax.lax.scan(body, (m0, l0, o0), (jnp.arange(n_chunks), kc, vc))
+    out = o / jnp.maximum(l.transpose(0, 3, 1, 2)[..., None], 1e-30)
+    return out.reshape(B, Sq, H, D).astype(q.dtype)
+
+
+class FPDTAttention:
+    """Host-offloaded double-buffered chunked attention (reference
+    ``_FPDTGPUOffloadingAttentionImpl_`` fpdt_layer.py:510).
+
+    K/V live on host; each (query-chunk, kv-chunk) tile runs on device with
+    the next kv chunk's transfer in flight. Handles sequences far beyond HBM.
+    """
+
+    def __init__(self, q_chunk: int = 2048, kv_chunk: int = 2048, causal: bool = True):
+        self.q_chunk = q_chunk
+        self.kv_chunk = kv_chunk
+        self.causal = causal
+        self._tile = jax.jit(self._tile_fn, static_argnames=("causal",))
+
+    @staticmethod
+    def _tile_fn(qg, kb, vb, m, l, o, q_start, k_start, causal):
+        return _block_attend(qg, kb, vb, m, l, o, q_start, k_start, causal)
+
+    def __call__(self, q: np.ndarray, k: np.ndarray, v: np.ndarray) -> np.ndarray:
+        B, S, H, D = q.shape
+        Hkv = k.shape[2]
+        G = H // Hkv
+        Cq, Ck = min(self.q_chunk, S), min(self.kv_chunk, S)
+        if S % Cq or S % Ck:
+            raise ValueError(f"seq {S} must divide by q_chunk {Cq} and kv_chunk {Ck}")
+        out = np.empty_like(q)
+        n_kv = S // Ck
+
+        for qi in range(S // Cq):
+            q_start = qi * Cq
+            qg = jnp.asarray(
+                q[:, q_start: q_start + Cq].reshape(B, Cq, Hkv, G, D).astype(np.float32)
+            ) * (D ** -0.5)
+            m = jnp.full((B, Hkv, G, Cq), _NEG_INF, jnp.float32)
+            l = jnp.zeros((B, Hkv, G, Cq), jnp.float32)
+            o = jnp.zeros((B, Cq, Hkv, G, D), jnp.float32)
+            # causal: kv chunks beyond this query chunk contribute nothing
+            last_kv = n_kv if not self.causal else (q_start + Cq + Ck - 1) // Ck
+            # prime the pipeline: first chunk's H2D in flight
+            nxt = (jnp.asarray(k[:, 0:Ck]), jnp.asarray(v[:, 0:Ck]))
+            for ki in range(last_kv):
+                kb, vb = nxt
+                if ki + 1 < last_kv:
+                    s = (ki + 1) * Ck
+                    # issue the NEXT transfer before computing — async dispatch
+                    # overlaps DMA with the tile compute (double buffering)
+                    nxt = (jnp.asarray(k[:, s: s + Ck]), jnp.asarray(v[:, s: s + Ck]))
+                m, l, o = self._tile(qg, kb, vb, m, l, o, q_start, ki * Ck, causal=self.causal)
+            res = o / jnp.maximum(l.transpose(0, 3, 1, 2)[..., None], 1e-30)
+            out[:, q_start: q_start + Cq] = np.asarray(
+                res.reshape(B, Cq, Hkv * G, D), dtype=q.dtype
+            )
+        return out
