@@ -35,3 +35,11 @@ def init_inference(*args, **kwargs):
     from deepspeed_tpu.inference.engine import init_inference as _init_inference
 
     return _init_inference(*args, **kwargs)
+
+
+def tp_model_init(*args, **kwargs):
+    """Shard an HF-style param pytree over tp (reference ``deepspeed.tp_model_init``
+    __init__.py:369; AutoTP rule inference in ``parallel/autotp.py``)."""
+    from deepspeed_tpu.parallel.autotp import tp_model_init as _tp_model_init
+
+    return _tp_model_init(*args, **kwargs)

@@ -50,3 +50,114 @@ def place_parameters(params: Any, mesh: Mesh, rules: Rules, dtype: Any = None) -
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(_place, params)
+
+
+# ---------------------------------------------------------------------------
+# Automatic rule inference (the tp_parser analog)
+# ---------------------------------------------------------------------------
+# Name patterns across HF model families (reference ``auto_tp.py`` carries the
+# same knowledge as per-arch policy lists + tp_parser graph analysis; flax
+# kernels are [in, out], so column-parallel = shard LAST dim, row-parallel =
+# shard FIRST weight dim).
+_COLUMN_PATTERNS = (
+    "q_proj", "k_proj", "v_proj", "query", "key", "value", "c_attn", "qkv",
+    "qkv_proj", "query_key_value", "gate_proj", "up_proj", "fc1", "fc_in",
+    "wi", "wi_0", "wi_1", "w1", "w3", "c_fc", "intermediate", "wq", "wk",
+    "wv", "w_gate", "w_up", "lin1",
+)
+_ROW_PATTERNS = (
+    "o_proj", "out_proj", "dense_4h_to_h", "down_proj", "fc2", "fc_out", "wo",
+    "w2", "c_proj", "attention_output", "w_down", "lin2",
+)
+_EMBED_PATTERNS = ("wte", "embed_tokens", "word_embeddings", "shared")
+_HEAD_PATTERNS = ("lm_head", "embed_out", "score", "classifier")
+# attention-output projections that share a name with generic 'dense' need
+# position context: '...attention...dense' is row-parallel (BERT-style)
+_ROW_IF_ATTN = ("dense",)
+
+
+def _has(path_lower: str, token: str) -> bool:
+    """Whole-quoted-name match against a keystr path — substring matching
+    misclassifies (e.g. 'shared_expert' is not 'shared'); same idiom as
+    ``causal_lm_partition_rules.has`` in models/transformer.py."""
+    return f"'{token}'" in path_lower
+
+
+def infer_tp_spec(path: str, shape: tuple) -> Optional[P]:
+    """Infer the tensor-parallel PartitionSpec for one parameter.
+
+    Reference analog: ``AutoTP.tp_parser`` + the per-arch policy classes
+    (module_inject/auto_tp.py:193, containers/*) collapsed into one
+    name-pattern classifier over pytree paths. Handles both pytree layouts:
+    flax (leaf 'kernel', [in, out]) and torch state dicts (leaf 'weight',
+    [out, in]) — the output dim is LAST for flax, FIRST for torch.
+    """
+    p = path.lower()
+    is_flax_kernel = _has(p, "kernel")
+    is_torch_weight = _has(p, "weight")
+    is_kernel = is_flax_kernel or is_torch_weight
+    is_bias = _has(p, "bias")
+    ndim = len(shape)
+    out_dim_last = not is_torch_weight  # torch Linear.weight is [out, in]
+
+    def col_spec():
+        if ndim == 1:
+            return P("tp") if is_bias else None
+        if is_bias:
+            # DenseGeneral-style [heads, head_dim] bias: shard the heads axis
+            return P(*(["tp"] + [None] * (ndim - 1)))
+        if ndim >= 3:
+            # flax DenseGeneral kernel [in, heads, head_dim]
+            return P(*([None] * (ndim - 2) + ["tp", None]))
+        return P(None, "tp") if out_dim_last else P("tp", None)
+
+    def row_spec():
+        if ndim == 1:
+            return None  # row-parallel bias is replicated (added after reduce)
+        if ndim >= 3:
+            return P(*([None] * (ndim - 3) + ["tp", None, None]))
+        return P("tp", None) if out_dim_last else P(None, "tp")
+
+    for tok in _HEAD_PATTERNS:
+        if _has(p, tok):
+            if is_kernel:
+                return col_spec()
+            return P("tp") if is_bias and ndim == 1 else None
+    for tok in _EMBED_PATTERNS:
+        if _has(p, tok) and ndim == 2:
+            return P("tp", None)  # vocab dim (same layout flax & torch)
+    for tok in _ROW_PATTERNS:
+        if _has(p, tok):
+            return row_spec() if is_kernel else None
+    for tok in _COLUMN_PATTERNS:
+        if _has(p, tok):
+            return col_spec() if (is_kernel or is_bias) else None
+    for tok in _ROW_IF_ATTN:
+        if _has(p, tok) and ("attention" in p or "attn" in p):
+            return row_spec() if is_kernel else None
+    return None
+
+
+def tp_model_init(params: Any, mesh: Optional[Mesh] = None, dtype: Any = None,
+                  extra_rules: Optional[Rules] = None) -> Any:
+    """Shard ANY HF-style param pytree over the mesh's ``tp`` axis
+    (reference ``deepspeed.tp_model_init`` __init__.py:369 +
+    ``TpTrainingManager`` runtime/tensor_parallel/tp_manager.py:12).
+
+    ``extra_rules`` runs first for model-specific overrides; unknown params
+    replicate. XLA inserts the row-parallel all-reduces the reference
+    implements as ``LinearAllreduce`` modules.
+    """
+    if mesh is None:
+        from deepspeed_tpu.topology.mesh import get_mesh
+
+        mesh = get_mesh()
+
+    def rules(path: str, shape: tuple) -> Optional[P]:
+        if extra_rules is not None:
+            spec = extra_rules(path, shape)
+            if spec is not None:
+                return spec
+        return infer_tp_spec(path, shape)
+
+    return place_parameters(params, mesh, rules, dtype=dtype)
