@@ -1,0 +1,78 @@
+"""Environment report (``ds_report`` CLI).
+
+Reference: ``deepspeed/env_report.py:183 main`` — versions, device info, and
+the native-op compatibility matrix.
+"""
+
+from __future__ import annotations
+
+import importlib
+import platform
+import sys
+from typing import Dict, List, Tuple
+
+
+def collect_versions() -> Dict[str, str]:
+    out = {"python": platform.python_version()}
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy", "transformers"):
+        try:
+            m = importlib.import_module(mod)
+            out[mod] = getattr(m, "__version__", "?")
+        except Exception:  # noqa: BLE001
+            out[mod] = "not installed"
+    import deepspeed_tpu
+
+    out["deepspeed_tpu"] = deepspeed_tpu.__version__
+    return out
+
+
+def collect_devices() -> List[str]:
+    try:
+        import jax
+
+        return [f"{d.platform}:{d.device_kind} (id {d.id})" for d in jax.devices()]
+    except Exception as e:  # noqa: BLE001
+        return [f"<device query failed: {e}>"]
+
+
+def op_compatibility() -> List[Tuple[str, bool, str]]:
+    """Native/kernels matrix (reference op-compatibility table)."""
+    rows: List[Tuple[str, bool, str]] = []
+    try:
+        from deepspeed_tpu.ops.op_builder import AsyncIOBuilder
+
+        b = AsyncIOBuilder()
+        rows.append(("async_io (C++)", b.is_compatible(), "g++ JIT build"))
+    except Exception as e:  # noqa: BLE001
+        rows.append(("async_io (C++)", False, str(e)))
+    try:
+        from deepspeed_tpu.ops.registry import op_report
+
+        for name, impls in sorted(op_report().items()):
+            rows.append((f"op:{name}", bool(impls), ",".join(impls)))
+    except Exception as e:  # noqa: BLE001
+        rows.append(("ops registry", False, str(e)))
+    return rows
+
+
+def report() -> str:
+    lines = ["-" * 60, "deepspeed_tpu environment report (ds_report)", "-" * 60]
+    lines.append("versions:")
+    for k, v in collect_versions().items():
+        lines.append(f"  {k:<18} {v}")
+    lines.append("devices:")
+    for d in collect_devices():
+        lines.append(f"  {d}")
+    lines.append("op compatibility:")
+    for name, ok, note in op_compatibility():
+        lines.append(f"  {'[OKAY]' if ok else '[FAIL]'} {name:<24} {note}")
+    return "\n".join(lines)
+
+
+def main() -> int:  # pragma: no cover - CLI shim
+    print(report())
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

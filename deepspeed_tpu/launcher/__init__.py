@@ -1,0 +1,4 @@
+"""deepspeed_tpu.launcher (reference ``deepspeed/launcher/``): the ``dstpu``
+multi-host CLI (``runner.py``) and per-host bootstrap (``launch.py``)."""
+
+from deepspeed_tpu.launcher.runner import build_launch_commands, filter_hosts, parse_hostfile

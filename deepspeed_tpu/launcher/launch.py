@@ -1,0 +1,53 @@
+"""Per-host launcher: wire jax.distributed, exec the training script.
+
+Reference: ``deepspeed/launcher/launch.py:133 main`` — the per-node process
+that sets rank env vars and spawns local workers. Under SPMD one process per
+host drives all local chips, so this just initializes the JAX distributed
+runtime (coordinator rendezvous over DCN) and runs the script in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import runpy
+import sys
+from typing import List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(prog="deepspeed_tpu.launcher.launch")
+    p.add_argument("--coordinator", required=True, help="ip:port of process 0")
+    p.add_argument("--num-processes", type=int, required=True)
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("rest", nargs=argparse.REMAINDER, help="-- script [args...]")
+    args = p.parse_args(argv)
+
+    rest = args.rest
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    if not rest:
+        p.error("no training script given")
+    script, script_args = rest[0], rest[1:]
+
+    if args.num_processes > 1:
+        import jax
+
+        logger.info(
+            f"jax.distributed.initialize({args.coordinator}, "
+            f"num={args.num_processes}, id={args.process_id})"
+        )
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+
+    sys.argv = [script, *script_args]
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,199 @@
+"""Multi-host launcher CLI.
+
+Reference: ``deepspeed/launcher/runner.py:419 main`` (the ``deepspeed`` CLI)
++ ``multinode_runner.py`` (PDSH/MPI/Slurm runners). TPU-native differences:
+rendezvous is ``jax.distributed.initialize`` (coordinator ip:port +
+process_id/num_processes) instead of torch.distributed; one PROCESS per host
+drives all local chips (SPMD), so "slots" in the hostfile count chips for
+world-size math but do not multiply processes.
+
+Hostfile format parity (reference ``parse_resource_filter``):
+    worker-1 slots=4
+    worker-2 slots=4
+with ``--include``/``--exclude`` filters (``worker-1@worker-2:0,1`` syntax
+reduces to host granularity here — chips aren't individually addressable
+under SPMD).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_COORD_PORT = 29500
+
+
+def parse_hostfile(path_or_text: str, from_text: bool = False) -> Dict[str, int]:
+    """'host slots=N' lines -> {host: slots} (reference runner.py
+    ``_parse_hostfile``). Comments (#) and blank lines skipped."""
+    if from_text:
+        lines = path_or_text.splitlines()
+    else:
+        with open(path_or_text) as f:
+            lines = f.readlines()
+    hosts: Dict[str, int] = {}
+    for ln in lines:
+        ln = ln.split("#", 1)[0].strip()
+        if not ln:
+            continue
+        parts = ln.split()
+        host = parts[0]
+        slots = 1
+        for p in parts[1:]:
+            if p.startswith("slots="):
+                slots = int(p.split("=", 1)[1])
+        if host in hosts:
+            raise ValueError(f"duplicate host {host!r} in hostfile")
+        hosts[host] = slots
+    if not hosts:
+        raise ValueError("hostfile contains no hosts")
+    return hosts
+
+
+def filter_hosts(hosts: Dict[str, int], include: str = "", exclude: str = "") -> Dict[str, int]:
+    """Apply --include/--exclude host filters (reference ``parse_inclusion_exclusion``)."""
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    sel = dict(hosts)
+    if include:
+        names = [h.split(":")[0] for h in include.split("@")]
+        missing = [n for n in names if n not in hosts]
+        if missing:
+            raise ValueError(f"--include names unknown hosts {missing}")
+        sel = {n: hosts[n] for n in names}
+    if exclude:
+        for h in exclude.split("@"):
+            sel.pop(h.split(":")[0], None)
+        if not sel:
+            raise ValueError("--exclude removed every host")
+    return sel
+
+
+_LOCAL_NAMES = ("localhost", "127.0.0.1", "::1")
+
+
+def _is_local(host: str) -> bool:
+    return host in _LOCAL_NAMES or host == socket.gethostname()
+
+
+def build_launch_commands(
+    hosts: Dict[str, int],
+    script: str,
+    script_args: List[str],
+    coordinator: Optional[str] = None,
+    port: int = DEFAULT_COORD_PORT,
+    ssh_port: Optional[int] = None,
+    env_passthrough: Optional[List[str]] = None,
+) -> List[Tuple[str, List[str]]]:
+    """Per-host (host, argv) pairs invoking ``launcher.launch`` over ssh
+    (reference ``PDSHRunner.get_cmd`` multinode_runner.py:55 — here plain ssh
+    per host; pdsh adds fanout, not semantics). Remote commands cd into the
+    invoking working directory (relative script/data paths must resolve) and
+    get a pty (-tt) so Ctrl-C reaches the remote process tree."""
+    host_list = list(hosts)
+    coordinator = coordinator or host_list[0]
+    n = len(host_list)
+    cwd = os.path.abspath(os.getcwd())
+    cmds = []
+    for rank, host in enumerate(host_list):
+        inner = [
+            sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+            "--coordinator", f"{coordinator}:{port}",
+            "--num-processes", str(n),
+            "--process-id", str(rank),
+            "--", script, *script_args,
+        ]
+        if _is_local(host):
+            cmds.append((host, inner))
+            continue
+        envs = []
+        for var in env_passthrough or []:
+            if var in os.environ:
+                envs.append(f"{var}={shlex.quote(os.environ[var])}")
+        ssh = ["ssh", "-tt", "-o", "StrictHostKeyChecking=no"]
+        if ssh_port:
+            ssh += ["-p", str(ssh_port)]
+        remote = f"cd {shlex.quote(cwd)} && " + " ".join(["env", *envs, *map(shlex.quote, inner)])
+        ssh += [host, remote]
+        cmds.append((host, ssh))
+    return cmds
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """The ``dstpu`` CLI entry (reference ``deepspeed`` bin + runner main)."""
+    p = argparse.ArgumentParser(
+        prog="dstpu", description="deepspeed_tpu multi-host launcher"
+    )
+    p.add_argument("--hostfile", default=None, help="'host slots=N' lines; absent = single host")
+    p.add_argument("--include", default="", help="host[@host...] to include")
+    p.add_argument("--exclude", default="", help="host[@host...] to exclude")
+    p.add_argument("--master_addr", default=None, help="coordinator address (default: first host)")
+    p.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT)
+    p.add_argument("--ssh_port", type=int, default=None)
+    p.add_argument("--env", action="append", default=[], help="env vars to pass through ssh")
+    p.add_argument("--dry_run", action="store_true", help="print commands, do not launch")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    if args.hostfile:
+        hosts = filter_hosts(parse_hostfile(args.hostfile), args.include, args.exclude)
+    else:
+        hosts = {"localhost": 1}
+    cmds = build_launch_commands(
+        hosts, args.script, args.script_args,
+        coordinator=args.master_addr, port=args.master_port,
+        ssh_port=args.ssh_port, env_passthrough=args.env,
+    )
+    if args.dry_run:
+        for host, argv_ in cmds:
+            print(f"[{host}] {' '.join(argv_)}")
+        return 0
+
+    procs = [subprocess.Popen(argv_) for _, argv_ in cmds]
+
+    def _kill_all():
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.time() + 10
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    proc.wait(timeout=max(deadline - time.time(), 0.1))
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+    rc = 0
+    try:
+        # poll: first nonzero exit kills the peers — otherwise survivors hang
+        # forever in jax.distributed rendezvous/collectives
+        live = dict(enumerate(procs))
+        while live:
+            for i in list(live):
+                code = live[i].poll()
+                if code is None:
+                    continue
+                del live[i]
+                if code != 0:
+                    logger.error(f"host {cmds[i][0]} exited with {code}; terminating peers")
+                    rc = rc or code
+                    _kill_all()
+                    live.clear()
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        _kill_all()
+        rc = 130
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -92,7 +92,9 @@ def infer_tp_spec(path: str, shape: tuple) -> Optional[P]:
     flax (leaf 'kernel', [in, out]) and torch state dicts (leaf 'weight',
     [out, in]) — the output dim is LAST for flax, FIRST for torch.
     """
-    p = path.lower()
+    # normalize flat torch state-dict keys ('self_attn.q_proj.weight' as one
+    # component) into quoted components so whole-name matching applies
+    p = path.lower().replace(".", "']['")
     is_flax_kernel = _has(p, "kernel")
     is_torch_weight = _has(p, "weight")
     is_kernel = is_flax_kernel or is_torch_weight
