@@ -68,6 +68,21 @@ class InferenceEngine:
         self._forward = jax.jit(lambda p, batch: self.module.apply({"params": p}, batch, train=False))
 
     # ------------------------------------------------------------------
+    def refresh_params(self, params: Any) -> None:
+        """Swap in new parameter VALUES keeping placements and compiled
+        functions (the hybrid-engine fast path: same shapes/shardings, so the
+        jit caches stay valid — no retrace, no recompile)."""
+        dtype = self.config.jax_dtype
+
+        def _replace(old, new):
+            arr = jnp.asarray(new)
+            if jnp.issubdtype(arr.dtype, jnp.floating):
+                arr = arr.astype(dtype)
+            return jax.device_put(arr, old.sharding)
+
+        self.params = jax.tree_util.tree_map(_replace, self.params, params)
+
+    # ------------------------------------------------------------------
     def forward(self, batch) -> jax.Array:
         """Full-sequence forward -> logits (teacher-forcing / scoring path)."""
         if not isinstance(batch, dict):

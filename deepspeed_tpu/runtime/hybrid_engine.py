@@ -1,0 +1,95 @@
+"""Hybrid engine: one model flipping between training and generation (RLHF).
+
+Reference: ``runtime/hybrid_engine.py:30 DeepSpeedHybridEngine`` — for the
+DeepSpeed-Chat actor model, wraps a ZeRO-3 training engine so ``generate()``
+(:168) runs through inference containers reusing the training parameters
+(``_zero3_forward`` :362 gathers them), with LoRA fuse/unfuse (:135) around
+the generate phase.
+
+TPU design: the training state's master params ARE the model — ``generate``
+re-places them with the inference partition rules (device-to-device reshard,
+no host round-trip) and runs the v1 KV-cache generation path; ``train_batch``
+delegates to the wrapped engine untouched. LoRA merge happens functionally on
+the reshard (the original params are never mutated, so there is no "unfuse"
+step to get wrong).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.inference.config import InferenceConfig
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class DeepSpeedTPUHybridEngine:
+    """Train + generate over shared parameters (reference ``DeepSpeedHybridEngine``)."""
+
+    def __init__(
+        self,
+        engine,  # DeepSpeedTPUEngine
+        model_config,  # TransformerConfig of the wrapped CausalLM
+        inference_config: Optional[Dict] = None,
+        lora_scaling: Optional[float] = None,
+    ):
+        self.engine = engine
+        self.model_config = model_config
+        self.lora_scaling = lora_scaling
+        cfg = dict(inference_config or {})
+        cfg.setdefault("dtype", "bf16")
+        self.inference_config = InferenceConfig(**cfg)
+        self._infer: Optional[InferenceEngine] = None
+        self._infer_step = -1  # train step the cached view was built from
+        self.total_generate_calls = 0
+
+    # -------------------------------------------------------------- training
+    def train_batch(self, *args, **kwargs):
+        out = self.engine.train_batch(*args, **kwargs)
+        return out
+
+    def backward(self, *args, **kwargs):
+        return self.engine.backward(*args, **kwargs)
+
+    def step(self, *args, **kwargs):
+        return self.engine.step(*args, **kwargs)
+
+    @property
+    def state(self):
+        return self.engine.state
+
+    # -------------------------------------------------------------- generate
+    def _refresh_inference_view(self) -> InferenceEngine:
+        """Sync the inference view to the CURRENT training params (reference:
+        hybrid engine reuses training tensors in inference containers). The
+        engine is built ONCE; later refreshes only re-place parameter values
+        into the existing shardings so compiled generate functions stay
+        cached (no retrace per RLHF iteration)."""
+        params = self.engine.state.params
+        if self.lora_scaling is not None:
+            from deepspeed_tpu.linear.optimized_linear import lora_merge
+
+            params = lora_merge(params, self.lora_scaling)
+        if self._infer is None:
+            self._infer = InferenceEngine(
+                self.model_config, params, self.inference_config, mesh=self.engine.mesh
+            )
+        else:
+            self._infer.refresh_params(params)
+        self._infer_step = self.engine.global_steps
+        return self._infer
+
+    def generate(self, input_ids, **kwargs) -> np.ndarray:
+        """Generate with the newest weights (reference ``generate`` :168)."""
+        if self._infer is None or self._infer_step != self.engine.global_steps:
+            self._refresh_inference_view()
+        self.total_generate_calls += 1
+        return self._infer.generate(input_ids, **kwargs)
+
+    def eval(self):  # torch-API parity no-ops (reference flips module modes)
+        return self
+
+    def train(self):
+        return self
