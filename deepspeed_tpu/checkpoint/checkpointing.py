@@ -51,8 +51,6 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         checkpoint_engine = OrbaxCheckpointEngine()
     checkpoint_engine.create(tag)
     checkpoint_engine.save(payload, path)
-    if not getattr(checkpoint_engine, "async_save", False):
-        checkpoint_engine.commit(tag)
     # async engines: the write continues in the background; durability is
     # guaranteed at the next load()/commit() barrier (Nebula tier semantics)
 
@@ -62,11 +60,25 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "zero_stage": engine.zero_config.stage,
         "version": 1,
     }
-    with open(os.path.join(save_dir, f"{tag}.meta.json"), "w") as f:
-        json.dump(meta, f)
-    if save_latest:
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-            f.write(tag)
+
+    def _publish():
+        # Runs only once the payload is durable (inline for sync engines,
+        # behind the queued write for async): 'latest' / meta never point at
+        # a missing or partial checkpoint.
+        with open(os.path.join(save_dir, f"{tag}.meta.json"), "w") as f:
+            json.dump(meta, f)
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(tag)
+
+    if not getattr(checkpoint_engine, "async_save", False):
+        # sync engines: finalize the transaction FIRST, then publish —
+        # 'latest' must never precede durability
+        checkpoint_engine.commit(tag)
+        checkpoint_engine.after_saved(_publish)
+    else:
+        # async engines: publish is queued behind the payload write
+        checkpoint_engine.after_saved(_publish)
     log_dist(f"saved checkpoint {path}", ranks=[0])
     return path
 
@@ -74,6 +86,10 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
                     checkpoint_engine=None) -> Tuple[Optional[str], Dict]:
+    if checkpoint_engine is None:
+        checkpoint_engine = getattr(engine, "checkpoint_engine", None)
+    if checkpoint_engine is not None and getattr(checkpoint_engine, "async_save", False):
+        checkpoint_engine.commit("")  # durability barrier before reading 'latest'
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)
         if not os.path.exists(latest):
@@ -86,6 +102,10 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         logger.warning(f"checkpoint {path} not found; nothing loaded")
         return None, {}
 
+    if checkpoint_engine is None:
+        from deepspeed_tpu.checkpoint.engine import OrbaxCheckpointEngine
+
+        checkpoint_engine = OrbaxCheckpointEngine()
     state = engine.state
     target = {
         "step": state.step,
@@ -98,12 +118,6 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding) if isinstance(x, jax.Array) else ocp.RestoreArgs(),
         target,
     )
-    if checkpoint_engine is None:
-        checkpoint_engine = getattr(engine, "checkpoint_engine", None)
-    if checkpoint_engine is None:
-        from deepspeed_tpu.checkpoint.engine import OrbaxCheckpointEngine
-
-        checkpoint_engine = OrbaxCheckpointEngine()
     restored = checkpoint_engine.load(path, target=target, restore_args=restore_args)
 
     from deepspeed_tpu.runtime.engine import TrainState

@@ -38,6 +38,15 @@ class CheckpointEngine:
     def commit(self, tag: str) -> bool:  # transaction end; True when durable
         return True
 
+    def after_saved(self, fn) -> None:
+        """Run ``fn`` once every save issued so far is durable.
+
+        Synchronous engines call it inline. Async engines defer it behind the
+        pending writes so publish actions (meta.json, the 'latest' pointer)
+        never point at a checkpoint that is not yet on disk (the reference
+        Nebula engine likewise only publishes the tag once persisted)."""
+        fn()
+
 
 class OrbaxCheckpointEngine(CheckpointEngine):
     """Blocking Orbax PyTree write/read (TorchCheckpointEngine analog)."""
@@ -75,9 +84,14 @@ class AsyncCheckpointEngine(CheckpointEngine):
             item = self._queue.get()
             if item is None:
                 return
-            payload, path = item
+            kind, a, b = item
             try:
-                self._inner.save(payload, path)
+                if kind == "save":
+                    self._inner.save(a, b)
+                elif kind == "call" and not self._errors:
+                    # publish actions are skipped when a prior write failed —
+                    # never advertise a checkpoint that is not durable
+                    a()
             except Exception as e:  # noqa: BLE001 - surfaced at commit()
                 self._errors.append(e)
             finally:
@@ -87,7 +101,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
         host = jax.tree_util.tree_map(
             lambda x: jax.device_get(x) if isinstance(x, jax.Array) else x, payload
         )
-        self._queue.put((host, path))
+        self._queue.put(("save", host, path))
 
     def load(self, path: str, target: Any = None, restore_args: Any = None) -> Any:
         self.commit("")  # drain pending saves before reading
@@ -99,6 +113,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
             err, self._errors = self._errors[:], []
             raise RuntimeError(f"async checkpoint save failed: {err[0]}") from err[0]
         return True
+
+    def after_saved(self, fn) -> None:
+        self._queue.put(("call", fn, None))
 
     def shutdown(self):
         self._queue.put(None)

@@ -511,4 +511,5 @@ def causal_lm_spec(
         apply_fn=apply_fn,
         name=f"CausalLM({config.hidden_size}x{config.num_layers})",
         partition_rules=pipeline_partition_rules if pipeline_microbatches > 1 else causal_lm_partition_rules,
+        model_config=config,
     )

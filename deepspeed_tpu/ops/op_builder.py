@@ -47,12 +47,20 @@ class NativeOpBuilder:
         return os.environ.get("CXX", "g++")
 
     def _so_path(self) -> Path:
-        # content-hash the sources so edits trigger rebuilds (the reference
-        # keys on build flags + versions)
+        # content-hash sources + flags + platform/arch/compiler so edits
+        # trigger rebuilds and a .so built on another OS/arch/toolchain never
+        # satisfies the cache (a foreign binary would dlopen-fail with a
+        # confusing 'invalid ELF header' instead of rebuilding)
+        import platform
+        import sys
+        from shutil import which
+
         h = hashlib.sha256()
         for p in self.absolute_sources():
             h.update(p.read_bytes())
         h.update(" ".join(self.EXTRA_FLAGS).encode())
+        h.update(f"{sys.platform}-{platform.machine()}".encode())
+        h.update((which(self._cxx()) or self._cxx()).encode())
         return self.build_dir / f"lib_{self.NAME}_{h.hexdigest()[:12]}.so"
 
     def build(self) -> Path:

@@ -51,6 +51,11 @@ def _block_attend(q, k, v, m, l, o, q_start, k_start, causal: bool):
     # guard fully-masked rows: e^(m - m_new) with m = -inf stays 0
     alpha = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new[..., None])
+    # A fully-masked FIRST block has m == m_new == _NEG_INF, making
+    # exp(s - m_new) == 1 for every masked entry — zero masked probabilities
+    # explicitly so accumulation is correct for any caller's block order
+    # (this helper is shared with sequence/fpdt.py).
+    p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
     l_new = l * alpha + p.sum(axis=-1)
     o_new = o * alpha.transpose(0, 3, 1, 2)[..., None] + jnp.einsum(
         "bhgqk,bkhd->bqhgd", p, v.astype(jnp.float32),

@@ -16,7 +16,15 @@ class CurriculumScheduler:
     """step -> difficulty (reference class of the same name)."""
 
     def __init__(self, config: Dict):
-        self.schedule_type = config.get("curriculum_type", config.get("schedule_type", "fixed_linear"))
+        # Reference schema: 'curriculum_type' names the difficulty METRIC
+        # ('seqlen'); 'schedule_type' names the schedule. Accept a schedule
+        # name accidentally passed via curriculum_type for compatibility.
+        sched = config.get("schedule_type")
+        ctype = config.get("curriculum_type")
+        if sched is None and ctype in ("fixed_linear", "fixed_root", "fixed_discrete"):
+            sched = ctype
+        self.metric = ctype if ctype not in (None, "fixed_linear", "fixed_root", "fixed_discrete") else "seqlen"
+        self.schedule_type = sched or "fixed_linear"
         self.min_difficulty = int(config["min_difficulty"])
         self.max_difficulty = int(config["max_difficulty"])
         sc = config.get("schedule_config", {})

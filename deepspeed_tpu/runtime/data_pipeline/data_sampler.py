@@ -63,17 +63,18 @@ class DeepSpeedDataSampler:
         return idx
 
     def __iter__(self) -> Iterator[np.ndarray]:
+        """One pass over this epoch's permutation: ineligible indices are
+        skipped (never re-served) and the epoch ends when the permutation is
+        exhausted, so no sample appears twice within an epoch."""
         rng = np.random.RandomState(self.seed + self.epoch)
         perm = rng.permutation(self.num_samples)
         cursor = 0
-        while True:
+        while cursor < self.num_samples:
             eligible = set(self._eligible().tolist())
             batch: List[int] = []
-            scanned = 0
-            while len(batch) < self.batch_size and scanned < self.num_samples:
-                i = perm[cursor % self.num_samples]
+            while len(batch) < self.batch_size and cursor < self.num_samples:
+                i = perm[cursor]
                 cursor += 1
-                scanned += 1
                 if i in eligible:
                     batch.append(int(i))
             if len(batch) < self.batch_size:
@@ -83,8 +84,8 @@ class DeepSpeedDataSampler:
                 return
             self.global_step += 1
             yield np.asarray(batch)
-            if cursor >= self.num_samples:  # one pass over the data per epoch
-                return
 
     def __len__(self) -> int:
+        """UPPER BOUND on batches per epoch: under curriculum filtering some
+        permutation entries are skipped, so fewer batches may be served."""
         return self.num_samples // self.batch_size

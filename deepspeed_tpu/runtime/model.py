@@ -35,6 +35,15 @@ class ModelSpec:
     # parameter path string + shape to a PartitionSpec carrying e.g. 'tp'
     # entries, or None for default placement. ZeRO sharding composes on top.
     partition_rules: Optional[Callable[[str, tuple], Optional[Any]]] = None
+    # Optional architecture config (e.g. TransformerConfig) so downstream
+    # consumers (init_inference's training-engine path, the hybrid engine)
+    # can rebuild an inference view without the caller re-passing it.
+    model_config: Optional[Any] = None
+
+    @property
+    def transformer_config(self) -> Optional[Any]:
+        """Alias read by ``init_inference`` when handed a training engine."""
+        return self.model_config
 
     @classmethod
     def from_flax(
