@@ -10,6 +10,7 @@ ctypes. Sources live under ``csrc/`` exactly like the reference.
 from __future__ import annotations
 
 import ctypes
+import functools
 import hashlib
 import os
 import subprocess
@@ -20,6 +21,22 @@ from deepspeed_tpu.utils.logging import logger
 
 _REPO_ROOT = Path(__file__).resolve().parents[2]
 _DEFAULT_BUILD_DIR = _REPO_ROOT / "build" / "ops"
+
+
+@functools.lru_cache(None)
+def _compiler_fingerprint(cxx: str) -> str:
+    """Path + version of the compiler, so an in-place toolchain upgrade
+    invalidates cached .so files (path alone would not)."""
+    from shutil import which
+
+    path = which(cxx) or cxx
+    try:
+        ver = subprocess.run(
+            [path, "--version"], capture_output=True, text=True, timeout=10
+        ).stdout.splitlines()[0]
+    except Exception:
+        ver = "unknown"
+    return f"{path}::{ver}"
 
 
 class NativeOpBuilder:
@@ -60,7 +77,7 @@ class NativeOpBuilder:
             h.update(p.read_bytes())
         h.update(" ".join(self.EXTRA_FLAGS).encode())
         h.update(f"{sys.platform}-{platform.machine()}".encode())
-        h.update((which(self._cxx()) or self._cxx()).encode())
+        h.update(_compiler_fingerprint(self._cxx()).encode())
         return self.build_dir / f"lib_{self.NAME}_{h.hexdigest()[:12]}.so"
 
     def build(self) -> Path:

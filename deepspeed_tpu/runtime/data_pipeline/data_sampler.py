@@ -80,6 +80,7 @@ class DeepSpeedDataSampler:
             if len(batch) < self.batch_size:
                 if self.drop_last or not batch:
                     return
+                self.global_step += 1  # partial tail batch still trains
                 yield np.asarray(batch)
                 return
             self.global_step += 1

@@ -127,10 +127,12 @@ class OptimizerStateSwapper:
         self.swapper.swap_out(self.TAG, opt_state, wait=wait)
         self._has_state = True
 
-    def swap_in_opt_state(self, like: Any = None) -> Any:
+    def swap_in_opt_state(self, like: Any = None, device_put: bool = True) -> Any:
+        """``device_put=False`` returns host (numpy) leaves — what a
+        host-committed optimizer update wants (ZeRO-Offload CPU step)."""
         if not self._has_state:
             raise RuntimeError("no optimizer state swapped out yet")
-        return self.swapper.swap_in(self.TAG, like=like)
+        return self.swapper.swap_in(self.TAG, like=like, device_put=device_put)
 
     def close(self) -> None:
         self.swapper.close()

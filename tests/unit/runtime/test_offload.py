@@ -1,0 +1,140 @@
+"""ZeRO-Offload / ZeRO-Infinity wiring tests.
+
+The reference integrates optimizer offload into the step
+(``runtime/zero/stage3.py:2082`` + ``swap_tensor/partitioned_optimizer_swapper.py:29``);
+here the engine reads ``zero_optimization.offload_optimizer`` and splits the
+step into a device grad program + a host-committed compiled update. These
+tests pin (a) state placement off the mesh, (b) trajectory match vs the fused
+non-offload step, (c) the NVMe round-trip keeping state on disk between steps.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+
+def _cfg(extra_zero=None, stage=1):
+    zero = {"stage": stage, **(extra_zero or {})}
+    return {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "zero_optimization": zero,
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10_000,
+    }
+
+
+def _model():
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=2, max_seq_len=32,
+    )
+    return causal_lm_spec(cfg, example_seq_len=16)
+
+
+def _run_steps(engine, n=3, seed=0):
+    rng = np.random.default_rng(seed)
+    losses = []
+    for _ in range(n):
+        batch = {"input_ids": rng.integers(0, 64, (engine.train_batch_size, 16), dtype=np.int32)}
+        m = engine.train_batch(batch)
+        losses.append(float(m["loss"]))
+    return losses
+
+
+def test_offload_optimizer_cpu_trajectory_matches_fused():
+    base, *_ = deepspeed_tpu.initialize(model=_model(), config=_cfg())
+    off, *_ = deepspeed_tpu.initialize(
+        model=_model(), config=_cfg({"offload_optimizer": {"device": "cpu"}})
+    )
+    assert off.offload_mode in ("host-jit", "memories")
+    l0 = _run_steps(base, 3)
+    l1 = _run_steps(off, 3)
+    np.testing.assert_allclose(l0, l1, rtol=2e-4)
+    p0 = jax.device_get(base.state.params)
+    p1 = jax.device_get(off.state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(p0), jax.tree_util.tree_leaves(p1)):
+        np.testing.assert_allclose(a, b, rtol=3e-4, atol=3e-5)
+
+
+def test_offload_state_not_on_mesh():
+    off, *_ = deepspeed_tpu.initialize(
+        model=_model(), config=_cfg({"offload_optimizer": {"device": "cpu"}})
+    )
+    if off.offload_mode != "host-jit":
+        pytest.skip("host-jit offload unavailable on this backend")
+    _run_steps(off, 1)
+    # master params + moments are committed to ONE host device, not spread
+    # over the mesh (the device-memory drop on a real accelerator)
+    for leaf in jax.tree_util.tree_leaves(off.state.params):
+        assert len(leaf.sharding.device_set) == 1
+    for leaf in jax.tree_util.tree_leaves(off.state.opt_state):
+        if isinstance(leaf, jax.Array):
+            assert len(leaf.sharding.device_set) == 1
+    # the device-side view is only the bf16/compute-dtype params
+    assert off._compute_dev is not None
+
+
+def test_offload_nvme_roundtrip(tmp_path):
+    off, *_ = deepspeed_tpu.initialize(
+        model=_model(),
+        config=_cfg({"offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path)}}),
+    )
+    assert off.offload_mode == "nvme"
+    base, *_ = deepspeed_tpu.initialize(model=_model(), config=_cfg())
+    l0 = _run_steps(base, 3)
+    l1 = _run_steps(off, 3)
+    np.testing.assert_allclose(l0, l1, rtol=2e-4)
+    # between steps the moments live on disk, not in the state
+    assert off._opt_on_nvme and off.state.opt_state is None
+    assert any((tmp_path / "opt_state").rglob("*.bin"))
+    # checkpoint materializes them back
+    off.materialize_state()
+    assert off.state.opt_state is not None
+
+
+def test_offload_zero3_with_param_offload():
+    off, *_ = deepspeed_tpu.initialize(
+        model=_model(),
+        config=_cfg(
+            {"offload_optimizer": {"device": "cpu"}, "offload_param": {"device": "cpu"}},
+            stage=3,
+        ),
+    )
+    base, *_ = deepspeed_tpu.initialize(model=_model(), config=_cfg(stage=3))
+    l0 = _run_steps(base, 2)
+    l1 = _run_steps(off, 2)
+    np.testing.assert_allclose(l0, l1, rtol=2e-4)
+    # param offload: no persistent device-side weights between steps
+    assert off._compute_dev is None
+
+
+def test_param_only_offload_is_not_a_silent_noop():
+    """offload_param without offload_optimizer must still offload (the
+    reference supports standalone param offload; a parsed-but-dead knob is
+    worse than an error)."""
+    off, *_ = deepspeed_tpu.initialize(
+        model=_model(), config=_cfg({"offload_param": {"device": "cpu"}}, stage=3)
+    )
+    assert off.offload_mode is not None
+    _run_steps(off, 1)
+    assert off._compute_dev is None  # nothing persists device-side
+
+
+def test_offload_checkpoint_roundtrip(tmp_path):
+    off, *_ = deepspeed_tpu.initialize(
+        model=_model(), config=_cfg({"offload_optimizer": {"device": "cpu"}})
+    )
+    _run_steps(off, 2)
+    step_before = off.global_steps
+    off.save_checkpoint(str(tmp_path))
+    _run_steps(off, 1)
+    path, _ = off.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert off.global_steps == step_before
+    _run_steps(off, 1)  # still trains after reload
