@@ -102,6 +102,17 @@ class DeepSpeedTPUEngine:
                 )
             self.tx, _ = get_optimizer(opt_cfg.type, opt_cfg.params, learning_rate=self.lr_scheduler_fn)
 
+        # ZeRO++ knobs validate at construction (dead/lying knobs are worse
+        # than errors); quantized collectives do not compose with the
+        # split-backend offload step.
+        self._zpp = self._zpp_config()
+        if self._zpp and self.offload_mode in ("host-jit", "nvme"):
+            raise NotImplementedError(
+                "ZeRO++ quantized collectives (zero_quantized_weights/gradients) "
+                "are not supported together with optimizer offload's split-"
+                "backend step; drop one of the two"
+            )
+
         # ---- state init + placement --------------------------------------
         self._init_state(model_parameters, seed)
 
@@ -193,7 +204,11 @@ class DeepSpeedTPUEngine:
         if dev == "nvme":
             if self._host_device is None:
                 raise ValueError("offload_optimizer device='nvme' needs a host CPU backend for the update step")
-            folder = (self._offload_cfg.nvme_path or "/tmp/ds_tpu_swap") if self._offload_cfg else "/tmp/ds_tpu_swap"
+            folder = self._offload_cfg.nvme_path if self._offload_cfg else None
+            if not folder:
+                # the reference requires nvme_path too; a shared default would
+                # let concurrent jobs clobber each other's swapped moments
+                raise ValueError("offload_optimizer device='nvme' requires 'nvme_path' in the config")
             from deepspeed_tpu.runtime.swap_tensor import OptimizerStateSwapper
 
             self._opt_swapper = OptimizerStateSwapper(os.path.join(folder, "opt_state"))
@@ -380,6 +395,95 @@ class DeepSpeedTPUEngine:
             compute = jax.lax.with_sharding_constraint(compute, self._base_shardings)
         return compute
 
+    def _zpp_config(self):
+        """(live_axes, qw, qg) when ZeRO++ collectives should be active."""
+        from deepspeed_tpu.topology.mesh import BATCH_AXES
+
+        zc = self.zero_config
+        if zc.zero_hpz_partition_size > 1:
+            raise NotImplementedError(
+                "zero_hpz_partition_size > 1 (hpZ secondary partition) is not "
+                "implemented: on TPU the hierarchical hop is expressed by "
+                "splitting the fsdp axis into (ici, dcn) sub-axes in the mesh; "
+                "use a mesh with that split instead of the hpZ knob"
+            )
+        qw, qg = zc.zero_quantized_weights, zc.zero_quantized_gradients
+        if not (qw or qg):
+            return None
+        if qg and zc.stage < 2:
+            raise ValueError("zero_quantized_gradients requires ZeRO stage >= 2 (sharded gradients)")
+        live = tuple(a for a in BATCH_AXES if self.mesh.shape[a] > 1)
+        if not live:
+            logger.warning("ZeRO++ quantized collectives requested but no data-parallel axis > 1; ignored")
+            return None
+        return live, qw, qg
+
+    def _build_zpp_micro_fn(self, live, qw: bool, qg: bool) -> Callable:
+        """Micro-batch gradient fn with addressable (quantized) collectives.
+
+        Runs the loss inside a partial-manual shard_map (data axes manual,
+        model axes auto): weights enter as their master-layout shards, are
+        gathered through ``sharded_weight_gather`` (int8 when qwZ), and its
+        custom VJP reduce-scatters the gradients back (int8 all-to-all when
+        qgZ). Reference: coalesced_collectives.py:31, partition_parameters.py:1200.
+        """
+        from deepspeed_tpu.parallel import zeropp
+
+        mesh = self.mesh
+
+        def _manual_only(spec: PartitionSpec) -> PartitionSpec:
+            entries = []
+            for e in spec:
+                if e is None:
+                    entries.append(None)
+                    continue
+                names = e if isinstance(e, tuple) else (e,)
+                keep = tuple(a for a in names if a in live)
+                entries.append(keep if len(keep) > 1 else (keep[0] if keep else None))
+            return PartitionSpec(*entries)
+
+        master_specs = jax.tree_util.tree_map(lambda sh: sh.spec, self.param_sharding)
+        param_in_specs = jax.tree_util.tree_map(_manual_only, master_specs)
+        plans = jax.tree_util.tree_map(lambda s: zeropp.leaf_comm_plan(s, live), param_in_specs)
+        grad_out_specs = jax.tree_util.tree_map(
+            lambda p: PartitionSpec(*[
+                (p.axes if len(p.axes) > 1 else p.axes[0]) if d == p.dim else None
+                for d in range(p.dim + 1)
+            ]) if p.sharded else PartitionSpec(),
+            plans,
+        )
+        batch_spec = PartitionSpec(live if len(live) > 1 else live[0])
+
+        def local_fn(param_shards, micro, scale, step_rng):
+            # de-correlate dropout across data ranks
+            r = jax.random.fold_in(
+                jax.random.wrap_key_data(step_rng), jax.lax.axis_index(live)
+            )
+
+            def scaled_loss(shards, b, rr):
+                full = zeropp.gather_params_for_compute(shards, plans, qw, qg, live_axes=live)
+                loss, _aux = self._loss_and_aux(full, b, rr)
+                return (loss.astype(jnp.float32) * scale).astype(self.compute_dtype if self.fp16 else jnp.float32), loss
+
+            (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(param_shards, micro, r)
+            grads = cast_floating(grads, jnp.float32)
+            # leaves replicated over the data axes: exact mean (tiny tensors)
+            grads = jax.tree_util.tree_map(
+                lambda g, p: g if p.sharded else jax.lax.pmean(g, live), grads, plans
+            )
+            return grads, jax.lax.pmean(loss, live)
+
+        from jax import shard_map
+
+        return shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(param_in_specs, batch_spec, PartitionSpec(), PartitionSpec()),
+            out_specs=(grad_out_specs, PartitionSpec()),
+            axis_names=set(live),
+            check_vma=False,
+        )
+
     def _build_train_step(self) -> Callable:
         gas = self.config.gradient_accumulation_steps
         clip = self.config.gradient_clipping
@@ -387,12 +491,21 @@ class DeepSpeedTPUEngine:
         dynamic = self.fp16 and fp16_cfg.dynamic
         grad_pspecs = self.grad_sharding  # NamedShardings: usable without a context mesh
 
+        zpp_fn = self._build_zpp_micro_fn(*self._zpp) if self._zpp else None
+
         def train_step(state: TrainState, batch):
             rng = jax.random.wrap_key_data(state.rng)
             rng, step_rng = jax.random.split(rng)
             scale = state.loss_scale.loss_scale
 
-            compute_params = self._compute_params(state.params)
+            if zpp_fn is not None:
+                # ZeRO++ path: compute params stay in master layout; the
+                # (quantized) gather happens inside the micro fn's shard_map.
+                compute_params = jax.lax.with_sharding_constraint(
+                    cast_floating(state.params, self.compute_dtype), self._device_param_sharding
+                )
+            else:
+                compute_params = self._compute_params(state.params)
 
             def scaled_loss(p, micro, r):
                 loss, _aux = self._loss_and_aux(p, micro, r)
@@ -402,8 +515,13 @@ class DeepSpeedTPUEngine:
 
             def micro_step(carry, micro_batch):
                 acc, i = carry
-                (_, loss), grads = grad_fn(compute_params, micro_batch, jax.random.fold_in(step_rng, i))
-                grads = cast_floating(grads, jnp.float32)
+                if zpp_fn is not None:
+                    grads, loss = zpp_fn(
+                        compute_params, micro_batch, scale, jax.random.key_data(jax.random.fold_in(step_rng, i))
+                    )
+                else:
+                    (_, loss), grads = grad_fn(compute_params, micro_batch, jax.random.fold_in(step_rng, i))
+                    grads = cast_floating(grads, jnp.float32)
                 acc = jax.tree_util.tree_map(lambda a, g: a + g, acc, grads)
                 # shard the accumulator (stage>=2 => reduce-scatter per micro-batch)
                 acc = jax.lax.with_sharding_constraint(acc, grad_pspecs)
@@ -420,48 +538,8 @@ class DeepSpeedTPUEngine:
             else:
                 (grads, _), losses = jax.lax.scan(micro_step, (zero_grads, 0), batch)
 
-            inv = 1.0 / (gas * scale)
-            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
-
-            finite = all_finite(grads) if self.fp16 else jnp.asarray(True)
-            gnorm = global_norm(grads)
-            if clip and clip > 0:
-                grads, gnorm = clip_by_global_norm(grads, clip, norm=gnorm)
-
-            updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-
-            # overflow => skip the update (reference FP16_Optimizer.step overflow path)
-            def sel(new, old):
-                return jax.tree_util.tree_map(lambda n, o: jnp.where(finite, n, o), new, old)
-
-            new_params = sel(new_params, state.params)
-            new_opt = sel(new_opt, state.opt_state)
-
-            new_ls = update_loss_scale(
-                state.loss_scale,
-                finite,
-                dynamic=dynamic,
-                scale_window=fp16_cfg.loss_scale_window,
-                min_scale=fp16_cfg.min_loss_scale,
-                init_hysteresis=fp16_cfg.hysteresis,
-                consecutive_hysteresis=fp16_cfg.consecutive_hysteresis,
-            ) if self.fp16 else state.loss_scale
-
-            new_state = TrainState(
-                step=state.step + jnp.where(finite, 1, 0).astype(jnp.int32),
-                params=new_params,
-                opt_state=new_opt,
-                loss_scale=new_ls,
-                rng=jax.random.key_data(rng),
-            )
-            metrics = {
-                "loss": jnp.mean(losses.astype(jnp.float32)),
-                "grad_norm": gnorm,
-                "lr": jnp.asarray(self.lr_scheduler_fn(state.step), jnp.float32),
-                "loss_scale": state.loss_scale.loss_scale,
-                "overflow": ~finite,
-            }
+            new_state, metrics = self._update_math(state, grads, jax.random.key_data(rng))
+            metrics["loss"] = jnp.mean(losses.astype(jnp.float32))
             return new_state, metrics
 
         return jax.jit(
@@ -470,6 +548,57 @@ class DeepSpeedTPUEngine:
             out_shardings=(self.state_sharding, None),
             donate_argnums=(0,),
         )
+
+    def _update_math(self, state: TrainState, grads, new_rng_data) -> Tuple[TrainState, Dict[str, Any]]:
+        """Scale / clip / optimizer update / overflow-skip / loss-scale step.
+
+        The ONE copy of the update semantics, traced into the fused step, the
+        forward/backward/step apply program, and the offload host program —
+        so the three paths cannot drift (reference ``FP16_Optimizer.step``)."""
+        gas = self.config.gradient_accumulation_steps
+        clip = self.config.gradient_clipping
+        fp16_cfg = self.config.model.fp16
+        dynamic = self.fp16 and fp16_cfg.dynamic
+        scale = state.loss_scale.loss_scale
+
+        inv = 1.0 / (gas * scale)
+        grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        finite = all_finite(grads) if self.fp16 else jnp.asarray(True)
+        gnorm = global_norm(grads)
+        if clip and clip > 0:
+            grads, gnorm = clip_by_global_norm(grads, clip, norm=gnorm)
+
+        updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        # overflow => skip the update (reference FP16_Optimizer.step overflow path)
+        def sel(new, old):
+            return jax.tree_util.tree_map(lambda n, o: jnp.where(finite, n, o), new, old)
+
+        new_ls = update_loss_scale(
+            state.loss_scale,
+            finite,
+            dynamic=dynamic,
+            scale_window=fp16_cfg.loss_scale_window,
+            min_scale=fp16_cfg.min_loss_scale,
+            init_hysteresis=fp16_cfg.hysteresis,
+            consecutive_hysteresis=fp16_cfg.consecutive_hysteresis,
+        ) if self.fp16 else state.loss_scale
+
+        new_state = TrainState(
+            step=state.step + jnp.where(finite, 1, 0).astype(jnp.int32),
+            params=sel(new_params, state.params),
+            opt_state=sel(new_opt, state.opt_state),
+            loss_scale=new_ls,
+            rng=new_rng_data,
+        )
+        metrics = {
+            "grad_norm": gnorm,
+            "lr": jnp.asarray(self.lr_scheduler_fn(state.step), jnp.float32),
+            "loss_scale": state.loss_scale.loss_scale,
+            "overflow": ~finite,
+        }
+        return new_state, metrics
 
     # ----------------------------------------------------- offload split path
     def _build_offload_grad_step(self) -> Callable:
@@ -517,45 +646,11 @@ class DeepSpeedTPUEngine:
         Emits the next step's bf16 compute params so only 2 bytes/param
         return to the accelerator (the reference ships fp16 params back from
         the CPU optimizer the same way)."""
-        gas = self.config.gradient_accumulation_steps
-        clip = self.config.gradient_clipping
-        fp16_cfg = self.config.model.fp16
-        dynamic = self.fp16 and fp16_cfg.dynamic
-
         def update(state: TrainState, grads):
             rng = jax.random.wrap_key_data(state.rng)
             rng, _ = jax.random.split(rng)  # same key advance as the fused step
-            scale = state.loss_scale.loss_scale
-            inv = 1.0 / (gas * scale)
-            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
-            finite = all_finite(grads) if self.fp16 else jnp.asarray(True)
-            gnorm = global_norm(grads)
-            if clip and clip > 0:
-                grads, gnorm = clip_by_global_norm(grads, clip, norm=gnorm)
-            updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            sel = lambda new, old: jax.tree_util.tree_map(lambda n, o: jnp.where(finite, n, o), new, old)
-            new_params = sel(new_params, state.params)
-            new_ls = update_loss_scale(
-                state.loss_scale, finite, dynamic=dynamic,
-                scale_window=fp16_cfg.loss_scale_window, min_scale=fp16_cfg.min_loss_scale,
-                init_hysteresis=fp16_cfg.hysteresis,
-                consecutive_hysteresis=fp16_cfg.consecutive_hysteresis,
-            ) if self.fp16 else state.loss_scale
-            new_state = TrainState(
-                step=state.step + jnp.where(finite, 1, 0).astype(jnp.int32),
-                params=new_params,
-                opt_state=sel(new_opt, state.opt_state),
-                loss_scale=new_ls,
-                rng=jax.random.key_data(rng),
-            )
-            compute_16 = cast_floating(new_params, self.compute_dtype)
-            metrics = {
-                "grad_norm": gnorm,
-                "lr": jnp.asarray(self.lr_scheduler_fn(state.step), jnp.float32),
-                "loss_scale": state.loss_scale.loss_scale,
-                "overflow": ~finite,
-            }
+            new_state, metrics = self._update_math(state, grads, jax.random.key_data(rng))
+            compute_16 = cast_floating(new_state.params, self.compute_dtype)
             return new_state, compute_16, metrics
 
         return jax.jit(update)  # inputs committed to the host device => runs on the cpu backend
@@ -775,15 +870,26 @@ class DeepSpeedTPUEngine:
         if self._grad_step is None:
             grad_pspecs = self.grad_sharding
 
-            def micro_grads(params, scale, micro, rng):
-                def scaled(p, b, r):
-                    p = p if offload_split else self._compute_params(p)
-                    loss, _ = self._loss_and_aux(p, b, r)
-                    return loss.astype(jnp.float32) * scale, loss
+            if self._zpp:
+                zpp_fn = self._build_zpp_micro_fn(*self._zpp)
 
-                (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params, micro, rng)
-                grads = jax.lax.with_sharding_constraint(cast_floating(grads, jnp.float32), grad_pspecs)
-                return loss, grads
+                def micro_grads(params, scale, micro, rng):
+                    compute = jax.lax.with_sharding_constraint(
+                        cast_floating(params, self.compute_dtype), self._device_param_sharding
+                    )
+                    grads, loss = zpp_fn(compute, micro, scale, jax.random.key_data(rng))
+                    grads = jax.lax.with_sharding_constraint(grads, grad_pspecs)
+                    return loss, grads
+            else:
+                def micro_grads(params, scale, micro, rng):
+                    def scaled(p, b, r):
+                        p = p if offload_split else self._compute_params(p)
+                        loss, _ = self._loss_and_aux(p, b, r)
+                        return loss.astype(jnp.float32) * scale, loss
+
+                    (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params, micro, rng)
+                    grads = jax.lax.with_sharding_constraint(cast_floating(grads, jnp.float32), grad_pspecs)
+                    return loss, grads
 
             if offload_split:
                 self._grad_step = jax.jit(micro_grads)
@@ -828,39 +934,10 @@ class DeepSpeedTPUEngine:
         return metrics
 
     def _build_apply_step(self) -> Callable:
-        gas = self.config.gradient_accumulation_steps
-        clip = self.config.gradient_clipping
-        fp16_cfg = self.config.model.fp16
-        dynamic = self.fp16 and fp16_cfg.dynamic
-
         def apply_step(state: TrainState, grads):
             # advance the key so the next accumulation cycle gets fresh dropout
             new_rng = jax.random.key_data(jax.random.split(jax.random.wrap_key_data(state.rng))[0])
-            scale = state.loss_scale.loss_scale
-            inv = 1.0 / (gas * scale)
-            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
-            finite = all_finite(grads) if self.fp16 else jnp.asarray(True)
-            gnorm = global_norm(grads)
-            if clip and clip > 0:
-                grads, gnorm = clip_by_global_norm(grads, clip, norm=gnorm)
-            updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            sel = lambda new, old: jax.tree_util.tree_map(lambda n, o: jnp.where(finite, n, o), new, old)
-            new_ls = update_loss_scale(
-                state.loss_scale, finite, dynamic=dynamic,
-                scale_window=fp16_cfg.loss_scale_window, min_scale=fp16_cfg.min_loss_scale,
-                init_hysteresis=fp16_cfg.hysteresis,
-                consecutive_hysteresis=fp16_cfg.consecutive_hysteresis,
-            ) if self.fp16 else state.loss_scale
-            new_state = TrainState(
-                step=state.step + jnp.where(finite, 1, 0).astype(jnp.int32),
-                params=sel(new_params, state.params),
-                opt_state=sel(new_opt, state.opt_state),
-                loss_scale=new_ls,
-                rng=new_rng,
-            )
-            return new_state, {"grad_norm": gnorm, "overflow": ~finite,
-                               "lr": jnp.asarray(self.lr_scheduler_fn(state.step), jnp.float32)}
+            return self._update_math(state, grads, new_rng)
 
         return jax.jit(
             apply_step,
@@ -962,4 +1039,5 @@ class DeepSpeedTPUEngine:
         ``checkpoint/ds_to_universal.py`` done online — no offline pass)."""
         from deepspeed_tpu.checkpoint.universal import save_universal as _saveu
 
+        self.materialize_state()  # NVMe-swapped moments must be in the state
         return _saveu(self, save_dir, tag=tag)
