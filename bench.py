@@ -47,8 +47,10 @@ def main() -> None:
         micro, seq, steps, warmup = 2, 128, 3, 1
         peak_flops = 1e12  # nominal; CPU numbers are smoke-test only
 
+    gas = 4 if on_tpu else 1
     config = {
         "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
         "zero_optimization": {"stage": 1},
         "bf16": {"enabled": True},
@@ -64,14 +66,17 @@ def main() -> None:
         "input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)
     }
 
+    # NOTE: sync via an explicit scalar fetch — jax.block_until_ready is a
+    # no-op on some experimental platforms (observed on the axon TPU relay),
+    # which silently turns a timing loop into a dispatch-latency measurement.
     for _ in range(warmup):
-        engine.train_batch(batch)
-    jax.block_until_ready(engine.state.params)
+        m = engine.train_batch(batch)
+    np.asarray(m["loss"])
 
     t0 = time.perf_counter()
     for _ in range(steps):
         m = engine.train_batch(batch)
-    jax.block_until_ready(engine.state.params)
+    np.asarray(m["loss"])
     dt = time.perf_counter() - t0
 
     tokens = engine.train_batch_size * seq * steps

@@ -77,6 +77,8 @@ class PipelineModule:
         partition_method: str = "uniform",
         activation_checkpoint_interval: int = 0,
         seed_layers: bool = False,
+        example_input: Any = None,
+        num_microbatches: Optional[int] = None,
     ):
         self.layer_specs = [l if isinstance(l, LayerSpec) else LayerSpec(lambda l=l: l) for l in layers]
         self.num_stages = num_stages
@@ -84,6 +86,13 @@ class PipelineModule:
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
         self.seed_layers = seed_layers
+        # example_input: activation fed to the first layer at init time (the
+        # reference infers shapes lazily from the first batch; the compiled
+        # SPMD engine needs them at construction). Required when any layer
+        # has parameters.
+        self.example_input = example_input
+        # pipeline microbatches per engine micro-batch (default: pp world).
+        self.num_microbatches = num_microbatches
 
     def __len__(self) -> int:
         return len(self.layer_specs)

@@ -1,76 +1,312 @@
-"""Pipeline-parallel training engine.
+"""Pipeline-parallel training engine for the LayerSpec API.
 
 TPU-native analog of ``deepspeed/runtime/pipe/engine.py`` (``PipelineEngine``
-:61). The reference interprets a 1F1B instruction schedule with torch p2p
-sends; on TPU the plan is a compiled microbatch loop over the ``pp`` mesh axis
-(collective_permute between stage neighbors inside one jitted program).
+:61) + ``runtime/pipe/module.py`` (``PipelineModule.forward`` :340). The
+reference interprets a 1F1B instruction schedule with torch p2p sends per
+microbatch; here the WHOLE pipeline is one jitted SPMD program: the repeated
+layer stack's parameters are stacked ``[L, ...]`` and sharded over the ``pp``
+mesh axis, and ``parallel/pipeline_spmd.spmd_pipeline`` runs the fill-and-
+drain microbatch loop with ``lax.ppermute`` between stage neighbors.
 
-Current state: with ``pp == 1`` the PipelineModule executes as a plain layer
-chain through the standard engine (sequential composition + loss_fn), which is
-the reference's degenerate single-stage path. The multi-stage 1F1B schedule is
-implemented in ``parallel/pipe_schedule.py`` (see TrainSchedule) and wired here
-as it lands.
+Layer conventions (``LayerSpec.build()`` result):
+  - ``(init, apply)`` pair: ``init(rng, x) -> params``, ``apply(params, x)``
+    (or ``apply(params, x, rng)``) ``-> y``
+  - a Flax linen module: ``module.init(rng, x)`` / ``module.apply``
+  - a plain callable ``x -> y`` (no parameters)
+
+Stage mapping: the longest contiguous run of layers with identical parameter
+structure (the repeated transformer blocks in every real pipeline model) is
+stacked and pipelined over ``pp``; the layers before/after it (embedding,
+norm, LM head — a few % of FLOPs) run replicated on every pp rank. This
+differs from the reference's contiguous layer partition (``_partition_layers``
+pipe/module.py:393) but computes the same function: replicating the cheap
+boundary layers costs far less than the ppermute hops they would otherwise
+need, and XLA DCEs the copies' gradients into one psum.
+
+``TiedLayerSpec`` layers share one parameter subtree keyed by ``key``
+(reference tied-weight groups, ``pipe/module.py:454``): reuse falls out of
+autodiff instead of a ReduceTiedGrads instruction.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import inspect
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 
 from deepspeed_tpu.runtime.engine import DeepSpeedTPUEngine
 from deepspeed_tpu.runtime.model import ModelSpec
-from deepspeed_tpu.parallel.pipeline import PipelineModule
+from deepspeed_tpu.parallel.pipeline import PipelineModule, TiedLayerSpec
+from jax.sharding import PartitionSpec as P
 
 
-def _spec_from_pipeline_module(module: PipelineModule) -> ModelSpec:
-    """Sequentially compose layer specs into one ModelSpec (pp=1 path)."""
-    layers = [spec.build() for spec in module.layer_specs]
+class _Layer:
+    """Uniform adapter over the three layer forms."""
+
+    def __init__(self, spec, built):
+        self.spec = spec
+        self.tied_key = spec.key if isinstance(spec, TiedLayerSpec) else None
+        self.typename = getattr(spec.typename, "__name__", str(spec.typename))
+        if isinstance(built, (tuple, list)) and len(built) == 2 and all(callable(f) for f in built):
+            self._init, self._apply = built
+            self.has_params = True
+            self._wants_rng = len(inspect.signature(self._apply).parameters) >= 3
+        elif hasattr(built, "init") and hasattr(built, "apply"):  # flax module
+            module = built
+
+            def finit(rng, x):
+                return module.init({"params": rng}, x)["params"]
+
+            def fapply(params, x, rng=None):
+                rngs = {"dropout": rng} if rng is not None else None
+                return module.apply({"params": params}, x, rngs=rngs)
+
+            self._init, self._apply = finit, fapply
+            self.has_params = True
+            self._wants_rng = True
+        elif callable(built):
+            fn = built
+            self._init = None
+            self._apply = lambda params, x, rng=None: fn(x)
+            self.has_params = False
+            self._wants_rng = False
+        else:
+            raise TypeError(
+                f"LayerSpec built {type(built)}; expected (init, apply) pair, "
+                f"flax module, or callable"
+            )
+        # TiedLayerSpec.forward_fn: alternate forward over the shared params
+        # (reference pipe/module.py:77 — e.g. the LM head reusing the
+        # embedding matrix).
+        if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None:
+            fwd = spec.forward_fn
+            self._apply = lambda params, x, rng=None: fwd(params, x)
+            self._wants_rng = False
+
+    def init(self, rng, x):
+        return self._init(rng, x) if self.has_params else None
+
+    def apply(self, params, x, rng):
+        if self._wants_rng:
+            return self._apply(params, x, rng)
+        return self._apply(params, x)
+
+
+def _adapt_layers(module: PipelineModule) -> List[_Layer]:
+    return [_Layer(spec, spec.build()) for spec in module.layer_specs]
+
+
+def _discover(layers: List[_Layer], example_input, seed: int):
+    """Abstract-init every layer to learn param/activation structure.
+
+    Returns (param_shapes per layer [abstract], activation shapes) without
+    running any real compute (jax.eval_shape end-to-end).
+    """
+    rng = jax.random.PRNGKey(seed)
+
+    def chain(rng, x):
+        tied: dict = {}
+        per_layer = []
+        for i, layer in enumerate(layers):
+            lrng = jax.random.fold_in(rng, i)
+            if not layer.has_params:
+                per_layer.append(None)
+                x = layer.apply(None, x, lrng)
+                continue
+            if layer.tied_key is not None:
+                # tied layers never join the stacked run; record None
+                if layer.tied_key not in tied:
+                    tied[layer.tied_key] = layer.init(lrng, x)
+                p = tied[layer.tied_key]
+                per_layer.append(None)
+            else:
+                p = layer.init(lrng, x)
+                per_layer.append(p)
+            x = layer.apply(p, x, lrng)
+        return per_layer, tied
+
+    shapes, tied_shapes = jax.eval_shape(chain, rng, example_input)
+    return shapes, tied_shapes
+
+
+def _stackable_run(layers: List[_Layer], shapes) -> Tuple[int, int]:
+    """Longest contiguous run of same-structure, untied, param'd layers."""
+
+    def sig(i):
+        layer, shp = layers[i], shapes[i]
+        if not layer.has_params or layer.tied_key is not None:
+            return None
+        leaves, treedef = jax.tree_util.tree_flatten(shp)
+        return (layer.typename, str(treedef), tuple((l.shape, str(l.dtype)) for l in leaves))
+
+    best = (0, 0)
+    i = 0
+    n = len(layers)
+    while i < n:
+        s = sig(i)
+        if s is None:
+            i += 1
+            continue
+        j = i
+        while j < n and sig(j) == s:
+            j += 1
+        if j - i > best[1] - best[0]:
+            best = (i, j)
+        i = j
+    return best
+
+
+def spec_from_pipeline_module(module: PipelineModule, pp: int, seed: int = 0) -> ModelSpec:
+    """ModelSpec executing the PipelineModule, pipelined over ``pp`` stages."""
+    layers = _adapt_layers(module)
     loss_fn = module.loss_fn
+    any_params = any(l.has_params for l in layers)
+    if any_params and module.example_input is None:
+        raise ValueError(
+            "PipelineModule has parameterized layers: pass example_input= "
+            "(the activation pytree fed to the first layer) so shapes can be "
+            "inferred at construction"
+        )
+
+    shapes = tied_shapes = None
+    lo = hi = 0
+    if any_params:
+        shapes, tied_shapes = _discover(layers, module.example_input, seed)
+        lo, hi = _stackable_run(layers, shapes)
+    if pp > 1:
+        if hi - lo < pp:
+            raise ValueError(
+                f"pipeline over pp={pp} needs a contiguous run of >= pp layers "
+                f"with identical parameter structure (found {hi - lo}); the "
+                f"repeated block stack is what gets partitioned over stages"
+            )
+        # Trim the run so it divides evenly; leftover layers join the epilogue.
+        usable = ((hi - lo) // pp) * pp
+        hi = lo + usable
 
     def init_fn(rng):
-        params = []
-        carry_shape = None
+        x = module.example_input
+        tied: dict = {}
+        pre: dict = {}
+        stack: list = []
+        post: dict = {}
         for i, layer in enumerate(layers):
-            layer_rng = jax.random.fold_in(rng, i)
-            if hasattr(layer, "init"):
-                raise ValueError(
-                    "Flax modules inside PipelineModule need explicit example "
-                    "activations; use LayerSpec with pure (init, apply) pairs "
-                    "or pass model_parameters to initialize()"
-                )
-            params.append(None)
+            lrng = jax.random.fold_in(rng, i)
+            p = None
+            if layer.has_params:
+                if layer.tied_key is not None:
+                    if layer.tied_key not in tied:
+                        tied[layer.tied_key] = layer.init(lrng, x)
+                    p = tied[layer.tied_key]
+                else:
+                    p = layer.init(lrng, x)
+                    if lo <= i < hi and pp > 1:
+                        stack.append(p)
+                    elif i < hi:
+                        pre[str(i)] = p
+                    else:
+                        post[str(i)] = p
+            x = layer.apply(p, x, lrng)
+        params = {"tied": tied, "pre": pre, "post": post}
+        if stack:
+            params["stack"] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stack)
         return params
 
-    def loss(params, batch, rng):
+    def _layer_params(params, i):
+        layer = layers[i]
+        if not layer.has_params:
+            return None
+        if layer.tied_key is not None:
+            return params["tied"][layer.tied_key]
+        key = str(i)
+        if key in params["pre"]:
+            return params["pre"][key]
+        if key in params["post"]:
+            return params["post"][key]
+        # stacked run member (sequential fallback on a stack-layout tree)
+        return jax.tree_util.tree_map(lambda v: v[i - lo], params["stack"])
+
+    def _finish(h, batch):
+        if loss_fn is None:
+            return h
+        if isinstance(batch, dict) and "labels" in batch:
+            return loss_fn(h, batch["labels"])
+        return loss_fn(h, batch)
+
+    def sequential_loss(params, batch, rng):
         h = batch
         for i, layer in enumerate(layers):
-            h = layer(h) if params[i] is None else layer(params[i], h)
-        if loss_fn is not None:
-            if isinstance(batch, dict) and "labels" in batch:
-                return loss_fn(h, batch["labels"])
-            return loss_fn(h, batch)
-        return h
+            h = layer.apply(_layer_params(params, i), h, jax.random.fold_in(rng, i))
+        return _finish(h, batch)
 
-    return ModelSpec(init_fn=init_fn, loss_fn=loss, name="pipeline")
+    def pipelined_loss(params, batch, rng):
+        from deepspeed_tpu.topology.mesh import get_mesh, has_mesh
+
+        mesh = get_mesh() if has_mesh() else None
+        if mesh is None or "stack" not in params:
+            return sequential_loss(params, batch, rng)
+        # pp == 1 still flows through spmd_pipeline's degenerate scan branch so
+        # the stacked-params layout stays valid on any mesh.
+        M = module.num_microbatches or max(mesh.shape["pp"], 1)
+
+        h = batch
+        for i in range(lo):
+            h = layers[i].apply(_layer_params(params, i), h, jax.random.fold_in(rng, i))
+
+        leaves = jax.tree_util.tree_leaves(h)
+        B = leaves[0].shape[0]
+        if B % M:
+            raise ValueError(f"micro-batch dim {B} not divisible by pipeline microbatches {M}")
+        split = lambda v: v.reshape((M, B // M) + v.shape[1:])
+        stream = jax.tree_util.tree_map(split, h)
+
+        apply_mid = layers[lo].apply  # all stack layers share one apply
+        remat = module.activation_checkpoint_interval > 0
+
+        def stage_fn(stage_stack, carry, srng):
+            n_local = jax.tree_util.tree_leaves(stage_stack)[0].shape[0]
+            rngs = jax.random.split(srng, n_local)
+
+            def body(c, xs):
+                lp, r = xs
+                return apply_mid(lp, c, r), None
+
+            if remat:
+                body = jax.checkpoint(body, prevent_cse=False)
+            out, _ = jax.lax.scan(body, carry, (stage_stack, rngs))
+            return out
+
+        from deepspeed_tpu.parallel.pipeline_spmd import spmd_pipeline
+
+        h = spmd_pipeline(stage_fn, params["stack"], stream, mesh=mesh, rng=rng)
+        h = jax.tree_util.tree_map(lambda v: v.reshape((B,) + v.shape[2:]), h)
+
+        for i in range(hi, len(layers)):
+            h = layers[i].apply(_layer_params(params, i), h, jax.random.fold_in(rng, i))
+        return _finish(h, batch)
+
+    def partition_rules(path: str, shape: tuple):
+        if "'stack'" in path:
+            return P(*(["pp"] + [None] * (len(shape) - 1)))
+        return None
+
+    return ModelSpec(
+        init_fn=init_fn,
+        loss_fn=pipelined_loss if pp > 1 else sequential_loss,
+        name="pipeline",
+        partition_rules=partition_rules if pp > 1 else None,
+    )
 
 
 class PipelineEngine(DeepSpeedTPUEngine):
     """Engine for PipelineModule models (reference ``pipe/engine.py:61``)."""
 
     def __init__(self, module: PipelineModule, config, mesh=None, **kwargs):
-        import deepspeed_tpu.topology.mesh as mesh_mod
-
         self.pipeline_module = module
         pp = mesh.shape["pp"] if mesh is not None else getattr(config.mesh_config, "pp", 1)
-        if pp > 1:
-            raise NotImplementedError(
-                "multi-stage pipeline execution (pp > 1) is under construction: "
-                "the 1F1B schedule lives in parallel/pipe_schedule.py and is not "
-                "yet wired into a compiled stage loop. Use pp=1 (layer chaining) "
-                "or shard via dp/fsdp/tp/sp for now."
-            )
-        spec = _spec_from_pipeline_module(module)
+        spec = spec_from_pipeline_module(module, pp)
         super().__init__(model=spec, config=config, mesh=mesh, **kwargs)
 
     def train_batch(self, batch: Any = None, data_iter: Optional[Any] = None):

@@ -21,6 +21,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: the suite is dominated by XLA compiles of
+# near-identical tiny programs (round-2 verdict: 186 tests no longer fit one
+# 550 s run). Cache survives across pytest invocations in the repo tree.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 try:
     from jax._src import xla_bridge
 

@@ -1,0 +1,119 @@
+"""PipelineModule/LayerSpec API at pp>1 (reference runtime/pipe/module.py:86 +
+engine.py:61: the user-facing pipeline API must execute multi-stage).
+
+Correctness bar (round-2 verdict item 2): the SAME PipelineModule trained on a
+pp=2 and a pp=4 mesh matches the pp=1 trajectory.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.pipeline import LayerSpec, PipelineModule, TiedLayerSpec
+
+V, D, B, S = 64, 16, 4, 8
+
+
+def _embed_layer():
+    def init(rng, batch):
+        return {"w": jax.random.normal(rng, (V, D)) * 0.02}
+
+    def apply(p, batch):
+        return p["w"][batch["input_ids"]].astype(jnp.float32)
+
+    return init, apply
+
+
+def _block_layer():
+    def init(rng, x):
+        d = x.shape[-1]
+        return {"w": jax.random.normal(rng, (d, d)) * (0.5 / np.sqrt(d))}
+
+    def apply(p, x):
+        return x + jnp.tanh(x @ p["w"])
+
+    return init, apply
+
+
+def _head_forward(p, x):
+    return x @ p["w"].T
+
+
+def _ce_loss(logits, labels):
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def _module(n_blocks=4):
+    return PipelineModule(
+        layers=[
+            TiedLayerSpec("embed", _embed_layer),
+            *[LayerSpec(_block_layer) for _ in range(n_blocks)],
+            TiedLayerSpec("embed", _embed_layer, forward_fn=_head_forward),
+        ],
+        loss_fn=_ce_loss,
+        example_input={"input_ids": jnp.zeros((2, S), jnp.int32)},
+    )
+
+
+def _config(pp):
+    # Fixed global batch (32) across meshes so pp=1/2/4 trajectories are
+    # comparable; the triad resolves micro = 32 / dp_world.
+    return {
+        "train_batch_size": 32,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "mesh": {"pp": pp, "dp": 8 // pp},
+        "steps_per_print": 1000,
+    }
+
+
+def _run(pp, steps=4):
+    engine, *_ = deepspeed_tpu.initialize(model=_module(), config=_config(pp))
+    rng = np.random.default_rng(0)
+    losses = []
+    for i in range(steps):
+        ids = rng.integers(0, V, (engine.train_batch_size, S), dtype=np.int64)
+        labels = rng.integers(0, V, (engine.train_batch_size, S), dtype=np.int64)
+        m = engine.train_batch({"input_ids": ids, "labels": labels})
+        losses.append(float(m["loss"]))
+    return losses
+
+
+def test_pipeline_module_pp1_baseline(devices):
+    engine, *_ = deepspeed_tpu.initialize(model=_module(), config=_config(1))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, V, (engine.train_batch_size, S), dtype=np.int64)
+    labels = rng.integers(0, V, (engine.train_batch_size, S), dtype=np.int64)
+    batch = {"input_ids": ids, "labels": labels}
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"no learning: {losses}"
+
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_pipeline_module_matches_pp1(devices, pp):
+    base = _run(pp=1)
+    piped = _run(pp=pp)
+    np.testing.assert_allclose(piped, base, rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_module_needs_example_input(devices):
+    mod = PipelineModule(layers=[LayerSpec(_block_layer)], loss_fn=_ce_loss)
+    with pytest.raises(ValueError, match="example_input"):
+        deepspeed_tpu.initialize(model=mod, config=_config(2))
+
+
+def test_pipeline_module_too_few_blocks(devices):
+    mod = PipelineModule(
+        layers=[TiedLayerSpec("embed", _embed_layer),
+                LayerSpec(_block_layer),
+                TiedLayerSpec("embed", _embed_layer, forward_fn=_head_forward)],
+        loss_fn=_ce_loss,
+        example_input={"input_ids": jnp.zeros((2, S), jnp.int32)},
+    )
+    with pytest.raises(ValueError, match="contiguous run"):
+        deepspeed_tpu.initialize(model=mod, config=_config(2))

@@ -1,0 +1,131 @@
+"""Profile the bench train step on the real chip: where does the time go?
+
+Breakdown measured:
+  1. pure jitted step latency (device program, steady-state, async dispatch)
+  2. engine.train_batch latency (adds batch placement + metrics sync)
+  3. XLA cost analysis flops of the compiled step vs model flops estimate
+  4. dispatch-only latency (tiny no-op jit) to bound per-call RPC overhead
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+
+def timeit(fn, n=10, warmup=3, block=lambda r: jax.block_until_ready(r)):
+    for _ in range(warmup):
+        r = fn()
+    block(r)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    block(r)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    print(f"backend={backend}")
+
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=50304, hidden_size=768, intermediate_size=3072,
+            num_layers=12, num_heads=12, max_seq_len=1024,
+            norm="layernorm", activation="gelu", position="learned",
+            tie_embeddings=True, dtype=jnp.bfloat16,
+        )
+        micro, seq = 8, 1024
+        peak_flops = 197e12
+    else:
+        cfg = TransformerConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
+                                num_layers=2, num_heads=4, max_seq_len=256)
+        micro, seq = 2, 128
+        peak_flops = 1e12
+
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10_000,
+    }
+    engine, *_ = deepspeed_tpu.initialize(model=causal_lm_spec(cfg, example_seq_len=seq), config=config)
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+
+    # 4. dispatch floor: trivial jit call round-trip
+    f_nop = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.float32)
+    t_nop_async = timeit(lambda: f_nop(x), n=50, warmup=5, block=lambda r: None)
+    t_nop_sync = timeit(lambda: jax.block_until_ready(f_nop(x)), n=50, warmup=5)
+    print(f"dispatch nop: async={t_nop_async*1e3:.2f} ms, sync-roundtrip={t_nop_sync*1e3:.2f} ms")
+
+    # 1. pure jitted step
+    placed = engine._shard_global_batch(batch)
+    state = engine.state
+    step_fn = engine._train_step
+
+    def pure():
+        nonlocal state
+        state, m = step_fn(state, placed)
+        return m["loss"]
+
+    t_pure = timeit(pure, n=10, warmup=3)
+    print(f"pure jitted step: {t_pure*1e3:.1f} ms")
+    engine.state = state
+
+    # 1b. pure step without re-placing batch, async chain of 10 then block
+    def chain10():
+        nonlocal state
+        for _ in range(10):
+            state, m = step_fn(state, placed)
+        return m["loss"]
+    t_chain = timeit(chain10, n=3, warmup=1) / 10
+    engine.state = state
+    print(f"chained x10 step (amortized dispatch): {t_chain*1e3:.1f} ms")
+
+    # 2. engine.train_batch (includes _shard_global_batch + metrics np.asarray sync)
+    t_engine = timeit(lambda: engine.train_batch(batch)["loss"], n=10, warmup=3,
+                      block=lambda r: None)
+    print(f"engine.train_batch: {t_engine*1e3:.1f} ms")
+
+    # batch placement cost alone
+    t_place = timeit(lambda: engine._shard_global_batch(batch), n=10, warmup=3,
+                     block=lambda r: jax.block_until_ready(r))
+    print(f"batch placement: {t_place*1e3:.1f} ms")
+
+    # 3. cost analysis
+    lowered = step_fn.lower(engine.state, placed)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    xla_flops = ca.get("flops", float("nan"))
+    tokens = engine.train_batch_size * seq
+    model_flops = cfg.flops_per_token(seq) * tokens
+    print(f"xla flops/step: {xla_flops:.3e}; model flops/step (6ND-style): {model_flops:.3e}")
+
+    best = min(t_pure, t_chain)
+    mfu_pure = model_flops / best / peak_flops
+    mfu_engine = model_flops / t_engine / peak_flops
+    print(json.dumps({
+        "t_pure_ms": t_pure * 1e3, "t_chain_ms": t_chain * 1e3,
+        "t_engine_ms": t_engine * 1e3, "t_place_ms": t_place * 1e3,
+        "nop_async_ms": t_nop_async * 1e3, "nop_sync_ms": t_nop_sync * 1e3,
+        "mfu_pure": mfu_pure, "mfu_engine": mfu_engine,
+        "xla_flops": xla_flops, "model_flops": model_flops,
+    }))
+
+
+if __name__ == "__main__":
+    main()

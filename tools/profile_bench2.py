@@ -1,0 +1,113 @@
+"""Second-stage profiling: is block_until_ready broken on axon, and what is
+the true device-time of the step vs its parts?"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, causal_lm_spec, CausalLM
+from deepspeed_tpu.topology.mesh import set_mesh
+
+
+def main():
+    cfg = TransformerConfig(
+        vocab_size=50304, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, max_seq_len=1024,
+        norm="layernorm", activation="gelu", position="learned",
+        tie_embeddings=True, dtype=jnp.bfloat16,
+    )
+    micro, seq = 8, 1024
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10_000,
+    }
+    engine, *_ = deepspeed_tpu.initialize(model=causal_lm_spec(cfg, example_seq_len=seq), config=config)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    placed = engine._shard_global_batch(batch)
+    state = engine.state
+    step_fn = engine._train_step
+
+    # warmup/compile
+    for _ in range(2):
+        state, m = step_fn(state, placed)
+    _ = np.asarray(m["loss"])
+
+    # A: is block_until_ready honest? chain 5 steps, block, then fetch.
+    t0 = time.perf_counter()
+    for _ in range(5):
+        state, m = step_fn(state, placed)
+    t_dispatch = time.perf_counter() - t0
+    jax.block_until_ready(m["loss"])
+    t_block = time.perf_counter() - t0
+    _ = np.asarray(m["loss"])
+    t_fetch = time.perf_counter() - t0
+    print(f"5 steps: dispatch={t_dispatch*1e3:.1f}ms block={t_block*1e3:.1f}ms fetch={t_fetch*1e3:.1f}ms")
+    print(f"=> true per-step: {(t_fetch)*1e3/5:.1f} ms")
+
+    # B: forward-only loss
+    module = CausalLM(cfg)
+    set_mesh(engine.mesh)
+    params16 = jax.jit(lambda p: jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x, p))(state.params)
+    micro_b = {"input_ids": jnp.asarray(batch["input_ids"])}
+
+    @jax.jit
+    def fwd(p, b):
+        loss, _ = module.apply({"params": p}, b, train=False)
+        return loss
+
+    _ = np.asarray(fwd(params16, micro_b))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        l = fwd(params16, micro_b)
+    _ = np.asarray(l)
+    t_fwd = (time.perf_counter() - t0) / 5
+    print(f"fwd-only: {t_fwd*1e3:.1f} ms")
+
+    # C: fwd+bwd grads only (no optimizer)
+    @jax.jit
+    def fwdbwd(p, b):
+        def loss_fn(pp):
+            loss, _ = module.apply({"params": pp}, b, train=False)
+            return loss
+        return jax.value_and_grad(loss_fn)(p)[0]
+
+    _ = np.asarray(fwdbwd(params16, micro_b))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        l = fwdbwd(params16, micro_b)
+    _ = np.asarray(l)
+    t_fb = (time.perf_counter() - t0) / 5
+    print(f"fwd+bwd: {t_fb*1e3:.1f} ms")
+
+    # D: big matmul sanity — what matmul TFLOPs does this chip actually hit?
+    a = jnp.zeros((8192, 8192), jnp.bfloat16)
+    b = jnp.zeros((8192, 8192), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a, b):
+        return a @ b
+
+    _ = np.asarray(mm(a, b)[0, 0])
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        c = mm(a, b)
+    _ = np.asarray(c[0, 0])
+    t_mm = (time.perf_counter() - t0) / n
+    fl = 2 * 8192**3
+    print(f"8k matmul: {t_mm*1e3:.2f} ms => {fl/t_mm/1e12:.1f} TFLOP/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Third-stage: decompose the step — honest fwd+bwd, optimizer-only, device
+matmul rate inside one program, bigger micro-batch scaling."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, causal_lm_spec, CausalLM
+from deepspeed_tpu.topology.mesh import set_mesh
+
+
+def fetch_time(fn, out_leaf, n=5, warmup=2):
+    for _ in range(warmup):
+        r = fn()
+    _ = np.asarray(out_leaf(r))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    _ = np.asarray(out_leaf(r))
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    cfg = TransformerConfig(
+        vocab_size=50304, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, max_seq_len=1024,
+        norm="layernorm", activation="gelu", position="learned",
+        tie_embeddings=True, dtype=jnp.bfloat16,
+    )
+    seq = 1024
+    module = CausalLM(cfg)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+        },
+    )
+    set_mesh(engine.mesh)
+    state = engine.state
+    params16 = jax.jit(lambda p: jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x, p))(state.params)
+
+    rng = np.random.default_rng(0)
+
+    # 0. true device matmul rate: 50 matmuls inside one program
+    a = jnp.zeros((8192, 8192), jnp.bfloat16)
+
+    @jax.jit
+    def mm50(a):
+        def body(i, acc):
+            return acc + a @ a * (1.0 / (i + 1))
+        return jax.lax.fori_loop(0, 50, body, jnp.zeros_like(a))[0, 0]
+
+    t = fetch_time(lambda: mm50(a), lambda r: r, n=2, warmup=1)
+    print(f"50x 8k matmul in-program: {t*1e3:.1f} ms => {50*2*8192**3/t/1e12:.1f} TFLOP/s")
+
+    for micro in (8, 32):
+        b = {"input_ids": jnp.asarray(rng.integers(0, cfg.vocab_size, (micro, seq), dtype=np.int32))}
+
+        @jax.jit
+        def fwd(p, b):
+            loss, _ = module.apply({"params": p}, b, train=False)
+            return loss
+
+        @jax.jit
+        def fwdbwd(p, b):
+            def loss_fn(pp):
+                loss, _ = module.apply({"params": pp}, b, train=False)
+                return loss
+            l, g = jax.value_and_grad(loss_fn)(p)
+            return l, g
+
+        t_f = fetch_time(lambda: fwd(params16, b), lambda r: r)
+        t_fb = fetch_time(lambda: fwdbwd(params16, b), lambda r: r[1]["lm_head"]["embedding"] if "lm_head" in r[1] else jax.tree_util.tree_leaves(r[1])[0])
+        fwd_fl = 2 * 124e6 * micro * seq  # 2*N*T matmul flops approx (fwd)
+        print(f"micro={micro}: fwd={t_f*1e3:.1f}ms ({fwd_fl/t_f/1e12:.1f} TF/s) "
+              f"fwd+bwd={t_fb*1e3:.1f}ms ({3*fwd_fl/t_fb/1e12:.1f} TF/s)")
+
+    # optimizer-only update (adamw on fp32 master)
+    tx = engine.tx
+    grads = jax.tree_util.tree_map(lambda x: jnp.ones(x.shape, jnp.float32), state.params)
+
+    @jax.jit
+    def opt_only(params, opt_state, grads):
+        updates, new_opt = tx.update(grads, opt_state, params)
+        import optax
+        return optax.apply_updates(params, updates), new_opt
+
+    t_o = fetch_time(lambda: opt_only(state.params, state.opt_state, grads),
+                     lambda r: jax.tree_util.tree_leaves(r[0])[0])
+    print(f"optimizer-only: {t_o*1e3:.1f} ms")
+
+    # embedding + lm-head matmul microbenches (vocab is the big matmul)
+    emb = jnp.zeros((50304, 768), jnp.bfloat16)
+    h = jnp.zeros((8 * 1024, 768), jnp.bfloat16)
+
+    @jax.jit
+    def head(h, emb):
+        return (h @ emb.T)[0, 0]
+
+    t_h = fetch_time(lambda: head(h, emb), lambda r: r)
+    print(f"lm head matmul (8k x 768 x 50k): {t_h*1e3:.2f} ms => {2*8192*768*50304/t_h/1e12:.1f} TF/s")
+
+
+if __name__ == "__main__":
+    main()

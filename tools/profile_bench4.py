@@ -1,0 +1,82 @@
+"""Fourth stage: per-shape matmul efficiency, flash vs xla attention, one
+block, and a jax.profiler trace attempt."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def fetch_time(fn, out_leaf=lambda r: r, n=10, warmup=3):
+    for _ in range(warmup):
+        r = fn()
+    _ = np.asarray(out_leaf(r))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    _ = np.asarray(out_leaf(r))
+    return (time.perf_counter() - t0) / n
+
+
+def mm_rate(M, K, N, dtype=jnp.bfloat16, n=10):
+    a = jnp.zeros((M, K), dtype)
+    b = jnp.zeros((K, N), dtype)
+    f = jax.jit(lambda a, b: (a @ b).sum())
+    t = fetch_time(lambda: f(a, b), n=n)
+    return t, 2 * M * K * N / t / 1e12
+
+
+def main():
+    print("matmul shape sweep (bf16):")
+    for (M, K, N) in [(8192, 768, 768), (8192, 768, 3072), (8192, 3072, 768),
+                      (8192, 768, 50304), (32768, 768, 3072), (8192, 8192, 8192)]:
+        t, r = mm_rate(M, K, N)
+        print(f"  [{M},{K}]x[{K},{N}]: {t*1e3:.2f} ms {r:.1f} TF/s")
+
+    # attention: flash vs xla, fwd only
+    from deepspeed_tpu.ops.registry import dispatch
+    B, S, H, D = 8, 1024, 12, 64
+    q = jnp.zeros((B, S, H, D), jnp.bfloat16)
+    k = jnp.zeros((B, S, H, D), jnp.bfloat16)
+    v = jnp.zeros((B, S, H, D), jnp.bfloat16)
+    att_fl = 4 * B * H * S * S * D
+    for impl in ("pallas", "xla"):
+        try:
+            fn = jax.jit(lambda q, k, v, f=dispatch("causal_attention", impl): f(q, k, v, mask=None).sum())
+            t = fetch_time(lambda: fn(q, k, v))
+            print(f"attention {impl}: {t*1e3:.2f} ms ({att_fl/t/1e12:.1f} TF/s)")
+        except Exception as e:
+            print(f"attention {impl}: FAILED {type(e).__name__} {e}")
+
+    # attention bwd: flash vs xla
+    for impl in ("pallas", "xla"):
+        try:
+            f = dispatch("causal_attention", impl)
+            fn = jax.jit(lambda q, k, v: jax.grad(lambda qq: f(qq, k, v, mask=None).astype(jnp.float32).sum())(q).sum())
+            t = fetch_time(lambda: fn(q, k, v))
+            print(f"attention-bwd {impl}: {t*1e3:.2f} ms")
+        except Exception as e:
+            print(f"attention-bwd {impl}: FAILED {type(e).__name__} {e}")
+
+    # profiler trace attempt
+    try:
+        a = jnp.zeros((4096, 4096), jnp.bfloat16)
+        f = jax.jit(lambda a: a @ a)
+        with jax.profiler.trace("/tmp/jaxtrace"):
+            r = f(a)
+            np.asarray(r[0, 0])
+        import glob
+        files = glob.glob("/tmp/jaxtrace/**/*", recursive=True)
+        print(f"profiler trace files: {len(files)}")
+        for p in files[:8]:
+            print("  ", p, os.path.getsize(p) if os.path.isfile(p) else "dir")
+    except Exception as e:
+        print(f"profiler trace FAILED: {type(e).__name__} {e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Chained engine-step timing (the one true number) + trace op breakdown."""
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import shutil
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+
+def main():
+    micro = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    gas = int(sys.argv[2]) if len(sys.argv) > 2 and not sys.argv[2].startswith("-") else 1
+    trace = "--trace" in sys.argv
+    cfg = TransformerConfig(
+        vocab_size=50304, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, max_seq_len=1024,
+        norm="layernorm", activation="gelu", position="learned",
+        tie_embeddings=True, dtype=jnp.bfloat16,
+    )
+    seq = 1024
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+        },
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    placed = engine._shard_global_batch(batch)
+    state = engine.state
+    step_fn = engine._train_step
+    for _ in range(3):
+        state, m = step_fn(state, placed)
+    _ = np.asarray(m["loss"])
+
+    n = 10
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, m = step_fn(state, placed)
+    _ = np.asarray(m["loss"])
+    dt = (time.perf_counter() - t0) / n
+    tokens = engine.train_batch_size * seq
+    mfu = cfg.flops_per_token(seq) * tokens / dt / 197e12
+    print(f"micro={micro}: step={dt*1e3:.1f} ms tok/s={tokens/dt:,.0f} mfu={mfu*100:.1f}%")
+
+    if trace:
+        shutil.rmtree("/tmp/steptrace", ignore_errors=True)
+        with jax.profiler.trace("/tmp/steptrace"):
+            for _ in range(3):
+                state, m = step_fn(state, placed)
+            _ = np.asarray(m["loss"])
+        tj = sorted(glob.glob("/tmp/steptrace/**/*.trace.json.gz", recursive=True))[-1]
+        with gzip.open(tj, "rt") as f:
+            tr = json.load(f)
+        agg = collections.defaultdict(float)
+        cnt = collections.Counter()
+        pid_names = {e["pid"]: e["args"].get("name", "") for e in tr["traceEvents"]
+                     if e.get("ph") == "M" and e.get("name") == "process_name" and "args" in e}
+        dev = [p for p, nm in pid_names.items() if "TPU" in nm]
+        for e in tr["traceEvents"]:
+            if e.get("ph") == "X" and e.get("pid") in dev:
+                agg[e.get("name", "?")] += e.get("dur", 0) / 1e3
+                cnt[e.get("name", "?")] += 1
+        for nm, v in sorted(agg.items(), key=lambda kv: -kv[1])[:20]:
+            print(f"  {v/3:8.2f} ms/step x{cnt[nm]//3:4d}  {nm[:100]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Capture a jax.profiler trace of the engine train step; parse trace.json.gz
+for the device-op breakdown."""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import shutil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+
+def main():
+    cfg = TransformerConfig(
+        vocab_size=50304, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, max_seq_len=1024,
+        norm="layernorm", activation="gelu", position="learned",
+        tie_embeddings=True, dtype=jnp.bfloat16,
+    )
+    micro, seq = 8, 1024
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+        },
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    placed = engine._shard_global_batch(batch)
+    state = engine.state
+    step_fn = engine._train_step
+    for _ in range(3):
+        state, m = step_fn(state, placed)
+    _ = np.asarray(m["loss"])
+
+    shutil.rmtree("/tmp/steptrace", ignore_errors=True)
+    with jax.profiler.trace("/tmp/steptrace"):
+        for _ in range(3):
+            state, m = step_fn(state, placed)
+        _ = np.asarray(m["loss"])
+
+    tj = sorted(glob.glob("/tmp/steptrace/**/*.trace.json.gz", recursive=True))[-1]
+    with gzip.open(tj, "rt") as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    # find device-side complete events (ph == 'X'); aggregate by name
+    pid_names = {e["pid"]: e["args"].get("name", "") for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name" and "args" in e}
+    agg = collections.defaultdict(float)
+    cnt = collections.Counter()
+    total_by_pid = collections.defaultdict(float)
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pid = e.get("pid")
+        dur = e.get("dur", 0) / 1e6  # us -> s
+        total_by_pid[pid] += dur
+        nm = e.get("name", "?")
+        agg[(pid, nm)] += dur
+        cnt[(pid, nm)] += 1
+    print("pids:", {p: pid_names.get(p, "?") for p in total_by_pid})
+    for pid in total_by_pid:
+        label = pid_names.get(pid, "?")
+        if "TPU" in label or "tpu" in label or total_by_pid[pid] > 0.01:
+            print(f"\n== pid {pid} ({label}) total {total_by_pid[pid]*1e3:.1f} ms ==")
+            rows = sorted(((v, k) for k, v in agg.items() if k[0] == pid), reverse=True)[:25]
+            for v, (p, nm) in rows:
+                print(f"  {v*1e3:8.2f} ms  x{cnt[(p, nm)]:4d}  {nm[:110]}")
+
+
+if __name__ == "__main__":
+    main()
