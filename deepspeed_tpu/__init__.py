@@ -43,3 +43,13 @@ def tp_model_init(*args, **kwargs):
     from deepspeed_tpu.parallel.autotp import tp_model_init as _tp_model_init
 
     return _tp_model_init(*args, **kwargs)
+
+
+def load_hf_checkpoint(*args, **kwargs):
+    """Ingest a HuggingFace safetensors checkpoint into (TransformerConfig,
+    params) for ``initialize``/``init_inference`` (reference
+    ``module_inject/load_checkpoint.py`` + ``inference/v2/engine_factory.py``;
+    implementation in ``checkpoint/hf.py``)."""
+    from deepspeed_tpu.checkpoint.hf import load_hf_checkpoint as _load
+
+    return _load(*args, **kwargs)

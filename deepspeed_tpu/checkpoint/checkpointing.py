@@ -129,6 +129,9 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         opt_state=restored["opt_state"] if load_optimizer_states else state.opt_state,
         loss_scale=LossScaleState(**restored["loss_scale"]),
         rng=restored["rng"],
+        # error-feedback residuals are per-run scratch (reference reinitializes
+        # worker/server error buffers on load as well)
+        comm_error=state.comm_error,
     )
 
     client_state: Dict[str, Any] = {}

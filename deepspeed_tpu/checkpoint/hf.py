@@ -1,0 +1,254 @@
+"""HuggingFace checkpoint ingestion: safetensors -> CausalLM param pytree.
+
+TPU-native analog of the reference's model-implementation/checkpoint-loading
+stack: ``module_inject/load_checkpoint.py`` (name-mapped weight copy into
+injected modules), ``inference/v2/engine_factory.py`` (per-family policies:
+llama/mistral/mixtral/...), and ``inference/engine.py:301``
+(``load_model_with_checkpoint``, sharded/meta checkpoints). Instead of
+surgically rewriting torch modules, we translate the HF state dict into the
+framework's stacked-scan param tree once; AutoTP placement then shards it over
+the mesh (``parallel/autotp.place_parameters``).
+
+Supported families: llama (incl. mistral — same graph), gpt2, mixtral.
+Sharded checkpoints (``model.safetensors.index.json``) are read shard-by-shard
+into one host dict before conversion — peak host memory is the full fp* model
+plus the stacked copy being built. A per-layer streaming path (convert and
+free as each shard arrives) is the upgrade if host RAM ever binds.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+
+# --------------------------------------------------------------------- load
+
+def load_safetensors_state(path: str) -> Dict[str, np.ndarray]:
+    """Read a .safetensors file / HF checkpoint dir into {name: ndarray}."""
+    from safetensors import safe_open
+
+    def read_file(fp):
+        out = {}
+        with safe_open(fp, framework="np") as f:
+            for k in f.keys():
+                out[k] = f.get_tensor(k)
+        return out
+
+    if os.path.isfile(path):
+        return read_file(path)
+    index = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index):
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        state: Dict[str, np.ndarray] = {}
+        for shard in sorted(set(weight_map.values())):
+            state.update(read_file(os.path.join(path, shard)))
+        return state
+    single = os.path.join(path, "model.safetensors")
+    if os.path.exists(single):
+        return read_file(single)
+    files = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    state = {}
+    for f in files:
+        state.update(read_file(os.path.join(path, f)))
+    return state
+
+
+def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
+    """Map an HF ``config.json`` dict to a TransformerConfig."""
+    mt = hf_config.get("model_type", "llama")
+    if mt == "gpt2":
+        h = hf_config["n_embd"]
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config.get("n_inner") or 4 * h,
+            num_layers=hf_config["n_layer"],
+            num_heads=hf_config["n_head"],
+            max_seq_len=hf_config.get("n_positions", 1024),
+            norm="layernorm",
+            activation="gelu",
+            position="learned",
+            tie_embeddings=True,
+        )
+    if mt in ("llama", "mistral", "mixtral"):
+        kw = dict(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            num_kv_heads=hf_config.get("num_key_value_heads"),
+            head_dim=hf_config.get("head_dim"),
+            max_seq_len=hf_config.get("max_position_embeddings", 4096),
+            norm="rmsnorm",
+            activation="silu_glu",
+            position="rope",
+            rope_theta=float(hf_config.get("rope_theta", 10000.0)),
+            norm_eps=float(hf_config.get("rms_norm_eps", 1e-5)),
+            tie_embeddings=bool(hf_config.get("tie_word_embeddings", False)),
+        )
+        if mt == "mixtral":
+            kw.update(
+                num_experts=hf_config["num_local_experts"],
+                moe_top_k=hf_config.get("num_experts_per_tok", 2),
+            )
+        return TransformerConfig(**kw)
+    raise ValueError(f"unsupported HF model_type {mt!r} (supported: llama/mistral/mixtral/gpt2)")
+
+
+def detect_family(state: Dict[str, np.ndarray]) -> str:
+    keys = state.keys()
+    if any("block_sparse_moe" in k for k in keys):
+        return "mixtral"
+    if any("self_attn.q_proj" in k for k in keys):
+        return "llama"
+    if any(k.endswith("attn.c_attn.weight") for k in keys):
+        return "gpt2"
+    raise ValueError("cannot detect model family from checkpoint keys")
+
+
+# ------------------------------------------------------------------ convert
+
+def _stack(fn: Callable[[int], Dict[str, Any]], L: int) -> Dict[str, Any]:
+    """Per-layer subtree -> stacked [L, ...] leaves (the nn.scan layout)."""
+    import jax
+
+    per = [fn(i) for i in range(L)]
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *per)
+
+
+def _convert_llama(state, cfg: TransformerConfig) -> Dict[str, Any]:
+    h, hd = cfg.hidden_size, cfg.dims_per_head
+    H, Hkv = cfg.num_heads, cfg.kv_heads
+
+    def g(name):
+        return np.asarray(state[name])
+
+    def layer(i):
+        p = f"model.layers.{i}."
+        blk = {
+            "attn_norm": {"scale": g(p + "input_layernorm.weight")},
+            "mlp_norm": {"scale": g(p + "post_attention_layernorm.weight")},
+            "attn": {
+                # torch Linear stores [out, in]; flax DenseGeneral wants
+                # [in, heads, head_dim]
+                "wq": {"kernel": g(p + "self_attn.q_proj.weight").T.reshape(h, H, hd)},
+                "wk": {"kernel": g(p + "self_attn.k_proj.weight").T.reshape(h, Hkv, hd)},
+                "wv": {"kernel": g(p + "self_attn.v_proj.weight").T.reshape(h, Hkv, hd)},
+                "wo": {"kernel": g(p + "self_attn.o_proj.weight").T.reshape(H, hd, h)},
+            },
+        }
+        if cfg.num_experts > 0:
+            ex = p + "block_sparse_moe."
+            blk["moe"] = {
+                "gate": {"wg": {"kernel": g(ex + "gate.weight").T}},
+                "experts": {
+                    "w_gate": np.stack([g(f"{ex}experts.{e}.w1.weight").T for e in range(cfg.num_experts)]),
+                    "w_up": np.stack([g(f"{ex}experts.{e}.w3.weight").T for e in range(cfg.num_experts)]),
+                    "w_down": np.stack([g(f"{ex}experts.{e}.w2.weight").T for e in range(cfg.num_experts)]),
+                },
+            }
+        else:
+            blk["mlp"] = {
+                "w_gate": {"kernel": g(p + "mlp.gate_proj.weight").T},
+                "w_up": {"kernel": g(p + "mlp.up_proj.weight").T},
+                "w_down": {"kernel": g(p + "mlp.down_proj.weight").T},
+            }
+        return blk
+
+    params: Dict[str, Any] = {
+        "embed": {"embedding": g("model.embed_tokens.weight")},
+        "final_norm": {"scale": g("model.norm.weight")},
+        "layers": _stack(layer, cfg.num_layers),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": g("lm_head.weight").T}
+    return params
+
+
+def _convert_gpt2(state, cfg: TransformerConfig) -> Dict[str, Any]:
+    h, hd, H = cfg.hidden_size, cfg.dims_per_head, cfg.num_heads
+
+    def g(name):
+        # HF sometimes prefixes with "transformer."
+        for key in (name, "transformer." + name):
+            if key in state:
+                return np.asarray(state[key])
+        raise KeyError(f"checkpoint is missing tensor {name!r} (also tried 'transformer.{name}')")
+
+    def layer(i):
+        p = f"h.{i}."
+        # GPT-2 Conv1D stores [in, out] (already flax orientation)
+        ca_w, ca_b = g(p + "attn.c_attn.weight"), g(p + "attn.c_attn.bias")
+        q_w, k_w, v_w = np.split(ca_w, 3, axis=1)
+        q_b, k_b, v_b = np.split(ca_b, 3)
+        return {
+            "attn_norm": {"scale": g(p + "ln_1.weight"), "bias": g(p + "ln_1.bias")},
+            "mlp_norm": {"scale": g(p + "ln_2.weight"), "bias": g(p + "ln_2.bias")},
+            "attn": {
+                "wq": {"kernel": q_w.reshape(h, H, hd), "bias": q_b.reshape(H, hd)},
+                "wk": {"kernel": k_w.reshape(h, H, hd), "bias": k_b.reshape(H, hd)},
+                "wv": {"kernel": v_w.reshape(h, H, hd), "bias": v_b.reshape(H, hd)},
+                "wo": {"kernel": g(p + "attn.c_proj.weight").reshape(H, hd, h),
+                       "bias": g(p + "attn.c_proj.bias")},
+            },
+            "mlp": {
+                "w_up": {"kernel": g(p + "mlp.c_fc.weight"), "bias": g(p + "mlp.c_fc.bias")},
+                "w_down": {"kernel": g(p + "mlp.c_proj.weight"), "bias": g(p + "mlp.c_proj.bias")},
+            },
+        }
+
+    return {
+        "embed": {"embedding": g("wte.weight")},
+        "pos_embed": g("wpe.weight"),
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+        "layers": _stack(layer, cfg.num_layers),
+    }
+
+
+_CONVERTERS = {
+    "llama": _convert_llama,
+    "mistral": _convert_llama,
+    "mixtral": _convert_llama,
+    "gpt2": _convert_gpt2,
+}
+
+
+def convert_hf_state(
+    state: Dict[str, np.ndarray],
+    config: TransformerConfig,
+    family: Optional[str] = None,
+) -> Dict[str, Any]:
+    """HF state dict -> CausalLM stacked-scan param pytree."""
+    family = family or detect_family(state)
+    if family not in _CONVERTERS:
+        raise ValueError(f"unsupported family {family!r}; supported: {sorted(_CONVERTERS)}")
+    return _CONVERTERS[family](state, config)
+
+
+def load_hf_checkpoint(
+    path: str,
+    config: Optional[TransformerConfig] = None,
+    family: Optional[str] = None,
+) -> Tuple[TransformerConfig, Dict[str, Any]]:
+    """One-call ingestion: checkpoint dir (config.json + safetensors) ->
+    (TransformerConfig, params) ready for ``initialize(model_parameters=...)``
+    or ``init_inference(params=...)``."""
+    if config is None:
+        cfg_path = os.path.join(path, "config.json") if os.path.isdir(path) else None
+        if cfg_path is None or not os.path.exists(cfg_path):
+            raise ValueError("pass config= or point at a dir containing config.json")
+        with open(cfg_path) as f:
+            config = config_from_hf(json.load(f))
+    state = load_safetensors_state(path)
+    params = convert_hf_state(state, config, family=family)
+    return config, params

@@ -150,8 +150,10 @@ class MeshConfig(DeepSpeedConfigModel):
 
 class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     """reference ``runtime/activation_checkpointing/config``; on TPU this maps
-    to jax.checkpoint (remat) policies applied per layer."""
+    to jax.checkpoint (remat) policies applied to the compiled loss
+    (``runtime/activation_checkpointing.py``)."""
 
+    enabled: bool = False
     partition_activations: bool = False
     cpu_checkpointing: bool = False  # maps to XLA host-memory offload of residuals
     contiguous_memory_optimization: bool = False

@@ -52,6 +52,11 @@ class TransformerConfig:
     attn_impl: str = "auto"  # auto | xla | flash
     sp_impl: str = "ulysses"  # ulysses (all-to-all) | ring (ppermute) over sp
     dtype: Any = jnp.float32  # activation dtype inside the module
+    # Fused chunked-vocab LM-head + cross-entropy on the training path (the
+    # [tokens, vocab] logits never materialize). Auto-disabled for small
+    # vocabularies where chunking buys nothing.
+    fused_ce: bool = True
+    fused_ce_min_vocab: int = 4096
     # MoE (0 experts => dense MLP). Mirrors reference moe/layer.py knobs.
     num_experts: int = 0
     moe_top_k: int = 2
@@ -231,9 +236,26 @@ class Block(nn.Module):
         return (x, mask, positions, aux), None
 
 
+class _HeadKernel(nn.Module):
+    """Declares the untied LM-head kernel param without running the matmul —
+    the fused-CE path reads the weight directly. Param path/shape/init match
+    ``nn.Dense(name="lm_head")`` exactly so both paths share one parameter."""
+
+    hidden: int
+    vocab: int
+
+    @nn.compact
+    def __call__(self):
+        return self.param(
+            "kernel", nn.initializers.lecun_normal(), (self.hidden, self.vocab)
+        )
+
+
 class CausalLM(nn.Module):
     """Decoder-only LM. batch: {'input_ids': [B,S], optional 'labels',
-    'attention_mask', 'position_ids'} -> (loss, logits)."""
+    'attention_mask', 'position_ids'} -> (loss, logits). On the training path
+    with ``fused_ce`` active, logits is None (the fused chunked-vocab CE never
+    materializes it)."""
 
     config: TransformerConfig
 
@@ -272,16 +294,29 @@ class CausalLM(nn.Module):
                 (x, _, _, aux), _ = block_cls(cfg, train, name=f"layer_{i}")((x, pad_mask, positions, aux), None)
 
         x = _norm(cfg, "final_norm")(x)
-        if cfg.tie_embeddings:
-            embed = self.variables["params"]["embed"]["embedding"]
-            logits = x @ embed.T.astype(cfg.dtype)
-        else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
-
         labels = batch.get("labels")
         if labels is None:
             labels = jnp.concatenate([ids[:, 1:], jnp.full((B, 1), -100, dtype=ids.dtype)], axis=1)
-        loss = cross_entropy_loss(logits, labels, pad_mask)
+
+        use_fused = train and cfg.fused_ce and cfg.vocab_size >= cfg.fused_ce_min_vocab
+        if use_fused:
+            # fused chunked-vocab LM head + CE: no [B,S,V] logits in HBM
+            # (see ops/cross_entropy.py). Training returns logits=None.
+            from deepspeed_tpu.ops.cross_entropy import lm_head_cross_entropy
+
+            if cfg.tie_embeddings:
+                head = self.variables["params"]["embed"]["embedding"]  # [V, h]
+            else:
+                head = _HeadKernel(cfg.hidden_size, cfg.vocab_size, name="lm_head")().T
+            loss = lm_head_cross_entropy(x, head.astype(cfg.dtype), labels, pad_mask)
+            logits = None
+        else:
+            if cfg.tie_embeddings:
+                embed = self.variables["params"]["embed"]["embedding"]
+                logits = x @ embed.T.astype(cfg.dtype)
+            else:
+                logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
+            loss = cross_entropy_loss(logits, labels, pad_mask)
         if cfg.num_experts > 0:
             # aux is pre-weighted by MoELayer; average over layers
             loss = loss + aux / cfg.num_layers

@@ -1,0 +1,147 @@
+"""Fused LM-head + cross-entropy with chunked vocabulary.
+
+TPU-native analog of the reference's fused softmax/logits kernels
+(``csrc/transformer/inference/csrc/softmax.cu``, and the motivation behind
+``sequence/fpdt_layer.py:1137 FPDT_LogitsLoss``): the [tokens, vocab] logits
+matrix never materializes in HBM. The forward streams vocab chunks through a
+``lax.scan`` (running max + sum-exp, exact logsumexp), and the custom VJP
+recomputes each chunk's probabilities to accumulate dx/dE — trading one extra
+pass of matmul FLOPs for O(tokens x chunk) peak memory instead of
+O(tokens x vocab).
+
+For the 125M bench (8192 tokens x 50304 vocab) this removes a 1.65 GB fp32
+logits round-trip plus the softmax backward's equal-sized traffic, and is what
+unlocks micro-batch 16+ within v5e HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _cdiv(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+def _chunked_embed(embed: jax.Array, n_chunks: int):
+    """[V, D] -> ([n, C, D], per-chunk valid-column mask [n, C])."""
+    V, D = embed.shape
+    C = _cdiv(V, n_chunks)
+    pad = n_chunks * C - V
+    if pad:
+        embed = jnp.pad(embed, ((0, pad), (0, 0)))
+    cols = jnp.arange(n_chunks * C).reshape(n_chunks, C)
+    return embed.reshape(n_chunks, C, D), cols < V
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_linear_cross_entropy(x, embed, labels, weight, n_chunks):
+    loss, _ = _fce_fwd_impl(x, embed, labels, weight, n_chunks)
+    return loss
+
+
+def _fce_fwd_impl(x, embed, labels, weight, n_chunks):
+    """x: [N, D] (any float dtype); embed: [V, D]; labels: [N] int;
+    weight: [N] f32 per-token loss weight (0 for ignored tokens, typically
+    1/num_valid for a mean). Returns (loss, logz[N])."""
+    N, D = x.shape
+    ech, colmask = _chunked_embed(embed, n_chunks)
+
+    def chunk(carry, inp):
+        m, s = carry
+        e_c, keep = inp
+        lg = jax.lax.dot_general(
+            x, e_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [N, C]
+        lg = jnp.where(keep[None, :], lg, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(lg, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(jnp.exp(lg - m_new[:, None]), axis=-1)
+        return (m_new, s), None
+
+    (m, s), _ = jax.lax.scan(
+        chunk, (jnp.full((N,), -jnp.inf, jnp.float32), jnp.zeros((N,), jnp.float32)),
+        (ech, colmask),
+    )
+    logz = m + jnp.log(s)
+
+    gold_rows = jnp.take(embed, labels, axis=0)  # [N, D]
+    gold = jnp.sum(x.astype(jnp.float32) * gold_rows.astype(jnp.float32), axis=-1)
+    loss = jnp.sum((logz - gold) * weight)
+    return loss, logz
+
+
+def _fce_vjp_fwd(x, embed, labels, weight, n_chunks):
+    loss, logz = _fce_fwd_impl(x, embed, labels, weight, n_chunks)
+    return loss, (x, embed, labels, weight, logz)
+
+
+def _fce_vjp_bwd(n_chunks, res, g):
+    x, embed, labels, weight, logz = res
+    N, D = x.shape
+    V = embed.shape[0]
+    ech, colmask = _chunked_embed(embed, n_chunks)
+    w = (weight * g).astype(jnp.float32)  # [N] dL/dlogz per token
+
+    xf = x.astype(jnp.float32)
+
+    def chunk(dx_acc, inp):
+        e_c, keep = inp
+        lg = jax.lax.dot_general(
+            x, e_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        p = jnp.where(keep[None, :], jnp.exp(lg - logz[:, None]), 0.0) * w[:, None]  # [N, C]
+        pc = p.astype(x.dtype)
+        dx_acc = dx_acc + jax.lax.dot_general(
+            pc, e_c, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        de_c = jax.lax.dot_general(
+            pc, x, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [C, D]
+        return dx_acc, de_c
+
+    dx, de_chunks = jax.lax.scan(chunk, jnp.zeros((N, D), jnp.float32), (ech, colmask))
+    de = de_chunks.reshape(-1, D)[:V]
+
+    # gold terms: dloss/dgold = -w
+    gold_rows = jnp.take(embed, labels, axis=0).astype(jnp.float32)
+    dx = dx - w[:, None] * gold_rows
+    de = de.at[labels].add(-w[:, None] * xf)
+
+    dlabels = None
+    dweight = logz - jnp.sum(xf * gold_rows, axis=-1)  # dloss/dweight (rarely used)
+    return dx.astype(x.dtype), de.astype(embed.dtype), dlabels, (dweight * g).astype(jnp.float32)
+
+
+fused_linear_cross_entropy.defvjp(_fce_vjp_fwd, _fce_vjp_bwd)
+
+
+def lm_head_cross_entropy(
+    x: jax.Array,  # [B, S, D] final hidden states
+    embed: jax.Array,  # [V, D] (tied embedding or lm_head.T)
+    labels: jax.Array,  # [B, S] int, ignore_index marks ignored positions
+    pad_mask: Optional[jax.Array] = None,  # [B, S] 1=keep
+    ignore_index: int = -100,
+    chunk_size: int = 8192,
+) -> jax.Array:
+    """Mean token cross entropy of ``x @ embed.T`` vs labels, fused + chunked.
+
+    Matches ``models.transformer.cross_entropy_loss`` semantics (fp32 math,
+    mean over non-ignored tokens) without materializing the logits.
+    """
+    B, S, D = x.shape
+    V = embed.shape[0]
+    valid = labels != ignore_index
+    if pad_mask is not None:
+        valid = valid & (pad_mask > 0)
+    flat_valid = valid.reshape(-1)
+    n_valid = jnp.maximum(jnp.sum(flat_valid.astype(jnp.float32)), 1.0)
+    weight = flat_valid.astype(jnp.float32) / n_valid
+    safe_labels = jnp.where(flat_valid, labels.reshape(-1), 0)
+    n_chunks = max(1, _cdiv(V, chunk_size))
+    return fused_linear_cross_entropy(
+        x.reshape(-1, D), embed, safe_labels, weight, n_chunks
+    )

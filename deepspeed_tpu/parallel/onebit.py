@@ -1,0 +1,89 @@
+"""1-bit compressed gradient allreduce with error feedback.
+
+TPU-native analog of the reference's compressed-allreduce backends
+(``runtime/comm/nccl.py:51 NcclBackend.compressed_allreduce`` — sign+scale
+compression with worker/server error feedback driving OnebitAdam/OnebitLamb/
+ZeroOneAdam, ``runtime/comm/compressed.py`` packbits path).
+
+Scheme (single-stage compensation, executed inside ``shard_map`` over the
+data axes):
+  comp_i  = g_i + e_i                      (error-compensated local gradient)
+  scale_i = mean(|comp_i|)                 (per-tensor fp32 scale)
+  wire    = packbits(sign(comp_i)) + scale (n/8 bytes + 4, vs 4n for fp32)
+  g_mean  = (1/W) sum_i sign_i * scale_i   (decompressed average)
+  e_i'    = comp_i - sign_i * scale_i      (residual kept locally)
+
+The wire format is an uint8 all_gather — 1/32 the bytes of an fp32
+ring-allreduce's payload per hop (the reference claims the same 32x for its
+NCCL path). Signs unpack and reduce locally after the gather.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _ceil_to(n: int, k: int) -> int:
+    return (n + k - 1) // k * k
+
+
+def pack_signs(x: jax.Array) -> jax.Array:
+    """Flattened float array -> uint8 bitmap (1 = non-negative)."""
+    n = x.size
+    bits = (x.reshape(-1) >= 0).astype(jnp.uint8)
+    pad = _ceil_to(n, 8) - n
+    if pad:
+        bits = jnp.concatenate([bits, jnp.zeros((pad,), jnp.uint8)])
+    bits = bits.reshape(-1, 8)
+    weights = (1 << jnp.arange(8, dtype=jnp.uint8)).astype(jnp.uint8)
+    return jnp.sum(bits * weights[None, :], axis=1, dtype=jnp.uint8)
+
+
+def unpack_signs(packed: jax.Array, n: int) -> jax.Array:
+    """uint8 bitmap -> {-1, +1} float32 array of length n."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (packed[:, None] >> shifts[None, :]) & 1
+    signs = bits.reshape(-1)[:n].astype(jnp.float32) * 2.0 - 1.0
+    return signs
+
+
+def _compress_leaf(g: jax.Array, e: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """-> (packed_u8, scale, new_error). g, e: same shape (e may lead with 1s)."""
+    comp = g.astype(jnp.float32) + e.reshape(g.shape).astype(jnp.float32)
+    scale = jnp.mean(jnp.abs(comp))
+    packed = pack_signs(comp)
+    signs = unpack_signs(packed, g.size).reshape(g.shape)
+    new_e = comp - signs * scale
+    return packed, scale, new_e
+
+
+def compressed_grad_mean(grads: Any, errors: Any, axis_names: Tuple[str, ...]) -> Tuple[Any, Any]:
+    """Inside shard_map: exact-mean of per-rank sign-compressed gradients.
+
+    ``grads`` leaves: local per-rank gradients (full tensor shape).
+    ``errors`` leaves: [1, *shape] local slice of the persistent buffer.
+    Returns (mean gradients, new error slices).
+    """
+    def leaf(g, e):
+        packed, scale, new_e = _compress_leaf(g, e)
+        # ship u8 signs + fp32 scale; W = product of axis sizes
+        all_packed = jax.lax.all_gather(packed, axis_names)  # [W, n/8] u8
+        all_scale = jax.lax.all_gather(scale, axis_names)  # [W]
+        W = all_scale.shape[0]
+
+        def one(i, acc):
+            signs = unpack_signs(all_packed[i], g.size).reshape(g.shape)
+            return acc + signs * all_scale[i]
+
+        mean = jax.lax.fori_loop(0, W, one, jnp.zeros(g.shape, jnp.float32)) / W
+        return mean, new_e[None]
+
+    flat_g, tree = jax.tree_util.tree_flatten(grads)
+    flat_e = jax.tree_util.tree_leaves(errors)
+    out = [leaf(g, e) for g, e in zip(flat_g, flat_e)]
+    means = jax.tree_util.tree_unflatten(tree, [m for m, _ in out])
+    new_errs = jax.tree_util.tree_unflatten(tree, [e for _, e in out])
+    return means, new_errs

@@ -235,11 +235,58 @@ def spec_from_pipeline_module(module: PipelineModule, pp: int, seed: int = 0) ->
             return loss_fn(h, batch["labels"])
         return loss_fn(h, batch)
 
+    ckpt_interval = module.activation_checkpoint_interval
+
     def sequential_loss(params, batch, rng):
+        # activation_checkpoint_interval=k: save activations only at every
+        # k-th layer boundary, rematerialize inside each group (reference
+        # PipelineModule.forward exec_range + checkpoint_interval,
+        # pipe/module.py:340).
         h = batch
-        for i, layer in enumerate(layers):
-            h = layer.apply(_layer_params(params, i), h, jax.random.fold_in(rng, i))
+        n = len(layers)
+        i = 0
+        while i < n:
+            j = min(i + ckpt_interval, n) if ckpt_interval > 0 else i + 1
+
+            def seg(p, h, i=i, j=j):
+                for t in range(i, j):
+                    h = layers[t].apply(_layer_params(p, t), h, jax.random.fold_in(rng, t))
+                return h
+
+            if ckpt_interval > 0:
+                seg = jax.checkpoint(seg, prevent_cse=False)
+            h = seg(params, h)
+            i = j
         return _finish(h, batch)
+
+    def _apply_stack(stack, h, srng, apply_one):
+        """Scan the stacked layer run, checkpointing every k layers."""
+        n_local = jax.tree_util.tree_leaves(stack)[0].shape[0]
+        rngs = jax.random.split(srng, n_local)
+
+        def body(c, xs):
+            lp, r = xs
+            return apply_one(lp, c, r), None
+
+        k = ckpt_interval
+        if k <= 0 or n_local % min(k, n_local):
+            if k > 0:
+                body = jax.checkpoint(body, prevent_cse=False)  # non-dividing k: per-layer
+            out, _ = jax.lax.scan(body, h, (stack, rngs))
+            return out
+        k = min(k, n_local)
+        gstack = jax.tree_util.tree_map(
+            lambda v: v.reshape((n_local // k, k) + v.shape[1:]), stack
+        )
+        grngs = rngs.reshape((n_local // k, k) + rngs.shape[1:])
+
+        def gbody(c, xs):
+            lp, rs = xs
+            out, _ = jax.lax.scan(body, c, (lp, rs))
+            return out, None
+
+        out, _ = jax.lax.scan(jax.checkpoint(gbody, prevent_cse=False), h, (gstack, grngs))
+        return out
 
     def pipelined_loss(params, batch, rng):
         from deepspeed_tpu.topology.mesh import get_mesh, has_mesh
@@ -263,20 +310,9 @@ def spec_from_pipeline_module(module: PipelineModule, pp: int, seed: int = 0) ->
         stream = jax.tree_util.tree_map(split, h)
 
         apply_mid = layers[lo].apply  # all stack layers share one apply
-        remat = module.activation_checkpoint_interval > 0
 
         def stage_fn(stage_stack, carry, srng):
-            n_local = jax.tree_util.tree_leaves(stage_stack)[0].shape[0]
-            rngs = jax.random.split(srng, n_local)
-
-            def body(c, xs):
-                lp, r = xs
-                return apply_mid(lp, c, r), None
-
-            if remat:
-                body = jax.checkpoint(body, prevent_cse=False)
-            out, _ = jax.lax.scan(body, carry, (stage_stack, rngs))
-            return out
+            return _apply_stack(stage_stack, carry, srng, apply_mid)
 
         from deepspeed_tpu.parallel.pipeline_spmd import spmd_pipeline
 

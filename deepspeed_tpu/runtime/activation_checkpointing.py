@@ -1,0 +1,67 @@
+"""Activation checkpointing (rematerialization) policies.
+
+TPU-native analog of ``deepspeed/runtime/activation_checkpointing/
+checkpointing.py`` (``checkpoint`` :948, ``CheckpointFunction`` :488,
+``partition_activations`` :377). The reference re-runs each wrapped module's
+forward during backward and optionally partitions/offloads the saved inputs;
+on TPU the same trade is ``jax.checkpoint`` with a saveable-policy, applied to
+the loss function inside the compiled train step — XLA then schedules the
+recomputation, and "partitioned activations" correspond to saving nothing /
+offloading residuals to host memory.
+
+Policy names (config ``activation_checkpointing.policy``):
+  - ``none``: save everything (no remat) — only valid when ``enabled`` false
+  - ``full``: save nothing, recompute everything (reference default behavior
+    of wrapping every transformer layer)
+  - ``dots``: save matmul outputs with no batch dims (XLA's classic
+    "checkpoint_dots" — good default for transformer stacks)
+  - ``offload``: save residuals to pinned host memory instead of recomputing
+    (reference ``cpu_checkpointing``)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+POLICIES = ("none", "full", "dots", "offload")
+
+
+def resolve_policy(name: str):
+    """Policy name -> jax.checkpoint ``policy=`` argument."""
+    pol = jax.checkpoint_policies
+    if name == "full":
+        return pol.nothing_saveable
+    if name == "dots":
+        return pol.dots_with_no_batch_dims_saveable
+    if name == "offload":
+        return pol.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=[],
+            offload_src="device",
+            offload_dst="pinned_host",
+        )
+    raise ValueError(f"unknown activation_checkpointing policy {name!r}; one of {POLICIES}")
+
+
+def apply_activation_checkpointing(loss_fn: Callable, config) -> Callable:
+    """Wrap a ``(params, batch, rng) -> loss`` fn per the engine config.
+
+    ``config`` is the ``ActivationCheckpointingConfig`` section. Returns the
+    original fn unless enabled. ``cpu_checkpointing=True`` selects the host-
+    offload policy regardless of ``policy``.
+    """
+    if not getattr(config, "enabled", False):
+        return loss_fn
+    name = "offload" if config.cpu_checkpointing else (config.policy or "full")
+    if name == "none":
+        return loss_fn
+    policy = resolve_policy(name)
+    return jax.checkpoint(loss_fn, policy=policy, prevent_cse=False)
+
+
+def checkpoint(function: Callable, *args: Any):
+    """Reference-API shim (``deepspeed.checkpointing.checkpoint``): runs
+    ``function(*args)`` under full rematerialization."""
+    return jax.checkpoint(function, policy=jax.checkpoint_policies.nothing_saveable)(*args)

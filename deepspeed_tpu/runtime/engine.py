@@ -61,6 +61,11 @@ class TrainState(NamedTuple):
     opt_state: Any
     loss_scale: LossScaleState
     rng: jax.Array  # uint32 key data
+    # 1-bit gradient compression error-feedback buffers (None unless
+    # gradient_compression / a OneBit optimizer is active): per-dp-rank
+    # residuals, leaves shaped [dp_world, *param.shape] sharded on dim 0
+    # (reference runtime/comm/nccl.py worker_error).
+    comm_error: Any = None
 
 
 class DeepSpeedTPUEngine:
@@ -78,7 +83,7 @@ class DeepSpeedTPUEngine:
         seed: Optional[int] = None,
     ):
         self.model = model
-        self.mesh = mesh if mesh is not None else build_mesh(config.mesh_config)
+        self.mesh = mesh if mesh is not None else self._build_engine_mesh(config)
         set_mesh(self.mesh)
 
         # Re-resolve the batch triad now that the true dp world is known.
@@ -112,6 +117,7 @@ class DeepSpeedTPUEngine:
                 "are not supported together with optimizer offload's split-"
                 "backend step; drop one of the two"
             )
+        self._onebit = self._onebit_config()
 
         # ---- state init + placement --------------------------------------
         self._init_state(model_parameters, seed)
@@ -226,6 +232,39 @@ class DeepSpeedTPUEngine:
         else:
             self.offload_mode = "memories"
         log_dist(f"ZeRO-Offload enabled: mode={self.offload_mode} device={dev}", ranks=[0])
+
+    @staticmethod
+    def _build_engine_mesh(config) -> Mesh:
+        """Mesh from config, with the MiCS sub-group split applied.
+
+        ``mics_shard_size=m`` (reference ``zero/mics.py:64 MiCS_Init`` +
+        ``zero/config.py:326``) shards params within groups of m devices and
+        replicates across groups. On a mesh that IS a re-factoring of the
+        fsdp axis: fsdp becomes m (the shard group) and the leftover factor
+        folds into dp (pure replication + gradient averaging), so the
+        hierarchical/2-hop gather machinery reduces to an allgather over a
+        smaller, ICI-contiguous axis.
+        """
+        base = build_mesh(config.mesh_config)
+        m = config.zero_config.mics_shard_size
+        if m is None or m <= 0:
+            return base
+        if config.zero_config.stage < 3:
+            raise ValueError("mics_shard_size requires ZeRO stage 3 (sharded parameters)")
+        F = base.shape["fsdp"]
+        if F == m:
+            return base
+        if F % m:
+            raise ValueError(f"mics_shard_size={m} must divide the fsdp axis size {F}")
+        sizes = dict(base.shape)
+        sizes["fsdp"] = m
+        sizes["dp"] = sizes["dp"] * (F // m)
+        if config.zero_config.mics_hierarchical_params_gather:
+            log_dist(
+                "mics_hierarchical_params_gather: the intra-group allgather is "
+                "inherent to the fsdp-subgroup mesh; no extra hop needed", ranks=[0],
+            )
+        return build_mesh(axis_sizes=sizes)
 
     def _build_lr_schedule(self, client_sched) -> Tuple[Schedule, Any]:
         if client_sched is not None and callable(client_sched):
@@ -369,6 +408,26 @@ class DeepSpeedTPUEngine:
         )
         self.grad_sharding = zero_mod.grads_sharding(param_shapes, mesh, self.zero_config, base_specs)
 
+        if getattr(self, "_onebit", None):
+            # per-rank error-feedback residuals: [dp_world, *shape], dim 0
+            # sharded over the live data axes (each rank owns its own slice)
+            live = self._onebit
+            live_entry = live if len(live) > 1 else live[0]
+            W = 1
+            for a in live:
+                W *= mesh.shape[a]
+            err_sharding = jax.tree_util.tree_map(
+                lambda _: NamedSharding(mesh, PartitionSpec(live_entry)), param_shapes
+            )
+            errors = jax.jit(
+                lambda: jax.tree_util.tree_map(
+                    lambda l: jnp.zeros((W,) + tuple(l.shape), jnp.float32), param_shapes
+                ),
+                out_shardings=err_sharding,
+            )()
+            self.state = self.state._replace(comm_error=errors)
+            self.state_sharding = self.state_sharding._replace(comm_error=err_sharding)
+
     def _build_base_specs(self, param_shapes) -> Any:
         """Per-param model-parallel PartitionSpecs from the model's rules."""
         rules = self.model.partition_rules
@@ -383,7 +442,17 @@ class DeepSpeedTPUEngine:
 
     # ----------------------------------------------------------- train step
     def _loss_and_aux(self, params, batch, rng):
-        out = self.model.loss_fn(params, batch, rng)
+        loss_fn = self.model.loss_fn
+        ac_cfg = self.config.model.activation_checkpointing
+        if ac_cfg.enabled:
+            # remat policy applied to the whole loss: XLA re-schedules the
+            # recompute (reference activation_checkpointing/checkpointing.py:948)
+            from deepspeed_tpu.runtime.activation_checkpointing import (
+                apply_activation_checkpointing,
+            )
+
+            loss_fn = apply_activation_checkpointing(loss_fn, ac_cfg)
+        out = loss_fn(params, batch, rng)
         if isinstance(out, tuple):
             return out[0], out[1:]
         return out, ()
@@ -492,6 +561,105 @@ class DeepSpeedTPUEngine:
             check_vma=False,
         )
 
+    def _onebit_config(self):
+        """Live data axes when 1-bit compressed gradient allreduce is active.
+
+        Triggered by ``gradient_compression.enabled`` or a OneBit optimizer
+        name (reference OnebitAdam/OnebitLamb/ZeroOneAdam,
+        ``runtime/comm/nccl.py compressed_allreduce``). Validates composition
+        at construction — dead/lying knobs are worse than errors."""
+        from deepspeed_tpu.topology.mesh import BATCH_AXES
+
+        gc = self.config.model.gradient_compression
+        opt = self.config.model.optimizer
+        opt_name = opt.type.lower().replace("_", "") if opt else ""
+        onebit_opt = opt_name in ("onebitadam", "onebitlamb", "zerooneadam")
+        if not (gc.enabled or onebit_opt):
+            return None
+        if gc.enabled and gc.bits != 1:
+            raise NotImplementedError("gradient_compression.bits must be 1 (sign compression)")
+        if self.zero_config.stage >= 2:
+            raise ValueError(
+                "gradient_compression / OneBit optimizers need full local gradients: "
+                "use ZeRO stage <= 1 (the reference 1-bit optimizers have the same constraint)"
+            )
+        if self._zpp:
+            raise ValueError("gradient_compression does not compose with ZeRO++ quantized collectives")
+        if self.offload_mode in ("host-jit", "nvme", "memories"):
+            raise ValueError("gradient_compression does not compose with optimizer offload")
+        live = tuple(a for a in BATCH_AXES if self.mesh.shape[a] > 1)
+        if not live:
+            logger.warning("gradient_compression enabled but only one data rank; compression is a no-op")
+            return None
+        return live
+
+    def _build_onebit_fn(self, live) -> Callable:
+        """shard_map program: local grad accumulation + sign-compressed exact-
+        mean allreduce with error feedback (parallel/onebit.py)."""
+        from jax import shard_map
+
+        from deepspeed_tpu.parallel import onebit as onebit_mod
+
+        mesh = self.mesh
+
+        def _manual_only(spec: PartitionSpec) -> PartitionSpec:
+            entries = []
+            for e in spec:
+                if e is None:
+                    entries.append(None)
+                    continue
+                names = e if isinstance(e, tuple) else (e,)
+                keep = tuple(a for a in names if a in live)
+                entries.append(keep if len(keep) > 1 else (keep[0] if keep else None))
+            return PartitionSpec(*entries)
+
+        base_specs = jax.tree_util.tree_map(lambda sh: sh.spec, self._base_shardings)
+        param_in_specs = jax.tree_util.tree_map(_manual_only, base_specs)
+        live_entry = live if len(live) > 1 else live[0]
+        err_specs = jax.tree_util.tree_map(lambda _: PartitionSpec(live_entry), base_specs)
+        batch_spec = PartitionSpec(None, live_entry)
+
+        def local_fn(params, batch, scale, inv, step_rng, errors):
+            r0 = jax.random.wrap_key_data(step_rng)
+            rank = jax.lax.axis_index(live)
+
+            def scaled_loss(p, b, rr):
+                loss, _aux = self._loss_and_aux(p, b, rr)
+                return (loss.astype(jnp.float32) * scale).astype(
+                    self.compute_dtype if self.fp16 else jnp.float32
+                ), loss
+
+            grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+
+            def micro_step(carry, xs):
+                acc, i = carry
+                r = jax.random.fold_in(jax.random.fold_in(r0, i), rank)
+                (_, loss), g = grad_fn(params, xs, r)
+                g = cast_floating(g, jnp.float32)
+                acc = jax.tree_util.tree_map(lambda a, b: a + b, acc, g)
+                return (acc, i + 1), loss
+
+            zero = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (acc, _), losses = jax.lax.scan(micro_step, (zero, 0), batch)
+            # compress in TRUE gradient units (unscale first): the residuals
+            # stay valid across dynamic loss-scale changes
+            acc = jax.tree_util.tree_map(lambda g: g * inv, acc)
+            mean_grads, new_err = onebit_mod.compressed_grad_mean(acc, errors, live)
+            return mean_grads, new_err, jax.lax.pmean(losses, live)
+
+        return shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(param_in_specs, batch_spec, PartitionSpec(), PartitionSpec(), PartitionSpec(), err_specs),
+            out_specs=(
+                jax.tree_util.tree_map(lambda _: PartitionSpec(), base_specs),
+                err_specs,
+                PartitionSpec(),
+            ),
+            axis_names=set(live),
+            check_vma=False,
+        )
+
     def _build_train_step(self) -> Callable:
         gas = self.config.gradient_accumulation_steps
         clip = self.config.gradient_clipping
@@ -500,11 +668,36 @@ class DeepSpeedTPUEngine:
         grad_pspecs = self.grad_sharding  # NamedShardings: usable without a context mesh
 
         zpp_fn = self._build_zpp_micro_fn(*self._zpp) if self._zpp else None
+        ob_fn = self._build_onebit_fn(self._onebit) if self._onebit else None
 
         def train_step(state: TrainState, batch):
             rng = jax.random.wrap_key_data(state.rng)
             rng, step_rng = jax.random.split(rng)
             scale = state.loss_scale.loss_scale
+
+            if ob_fn is not None:
+                compute_params = self._compute_params(state.params)
+                # inv: residuals are stored in TRUE gradient units, so a
+                # dynamic-loss-scale change between steps cannot corrupt the
+                # carried error feedback.
+                inv = 1.0 / (gas * scale)
+                grads, new_err, losses = ob_fn(
+                    compute_params, batch, scale, inv, jax.random.key_data(step_rng), state.comm_error
+                )
+                new_state, metrics = self._update_math(
+                    state, grads, jax.random.key_data(rng), grads_are_unscaled=True
+                )
+                # fp16 overflow: a non-finite step would store NaN residuals
+                # and poison every later step — keep the previous buffers
+                # (the reference skips its error-feedback update on overflow
+                # the same way).
+                keep = ~metrics["overflow"]
+                new_err = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(keep, n, o), new_err, state.comm_error
+                )
+                new_state = new_state._replace(comm_error=new_err)
+                metrics["loss"] = jnp.mean(losses.astype(jnp.float32))
+                return new_state, metrics
 
             if zpp_fn is not None:
                 # ZeRO++ path: compute params stay in master layout; the
@@ -557,7 +750,8 @@ class DeepSpeedTPUEngine:
             donate_argnums=(0,),
         )
 
-    def _update_math(self, state: TrainState, grads, new_rng_data) -> Tuple[TrainState, Dict[str, Any]]:
+    def _update_math(self, state: TrainState, grads, new_rng_data,
+                     grads_are_unscaled: bool = False) -> Tuple[TrainState, Dict[str, Any]]:
         """Scale / clip / optimizer update / overflow-skip / loss-scale step.
 
         The ONE copy of the update semantics, traced into the fused step, the
@@ -569,8 +763,9 @@ class DeepSpeedTPUEngine:
         dynamic = self.fp16 and fp16_cfg.dynamic
         scale = state.loss_scale.loss_scale
 
-        inv = 1.0 / (gas * scale)
-        grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        if not grads_are_unscaled:
+            inv = 1.0 / (gas * scale)
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
         finite = all_finite(grads) if self.fp16 else jnp.asarray(True)
         gnorm = global_norm(grads)
         if clip and clip > 0:
@@ -599,6 +794,7 @@ class DeepSpeedTPUEngine:
             opt_state=sel(new_opt, state.opt_state),
             loss_scale=new_ls,
             rng=new_rng_data,
+            comm_error=state.comm_error,
         )
         metrics = {
             "grad_norm": gnorm,
@@ -889,6 +1085,12 @@ class DeepSpeedTPUEngine:
         recomputes forward+backward for the micro-batch (``batch`` or the one
         passed to the last ``forward``). ``train_batch`` is the efficient path.
         """
+        if self._onebit:
+            raise NotImplementedError(
+                "1-bit compressed gradients are only wired into train_batch "
+                "(the error-feedback state lives in the fused step); use "
+                "train_batch with gradient_compression"
+            )
         set_mesh(self.mesh)
         if batch is None:
             batch = getattr(self, "_last_batch", None)

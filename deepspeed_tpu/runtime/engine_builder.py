@@ -49,7 +49,10 @@ def initialize(
         raise ValueError("config (dict or JSON path) is required")
 
     cfg = DeepSpeedTPUConfig(config)
-    mesh = mesh if mesh is not None else build_mesh(cfg.mesh_config)
+    if mesh is None:
+        # MiCS sub-grouping and any other config-driven mesh adjustments live
+        # in the engine's mesh builder.
+        mesh = DeepSpeedTPUEngine._build_engine_mesh(cfg)
     cfg = DeepSpeedTPUConfig(cfg.raw, dp_world_size=get_data_parallel_world_size(mesh))
 
     # Pipeline dispatch (reference __init__.py:209)

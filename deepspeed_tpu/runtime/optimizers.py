@@ -64,14 +64,15 @@ def get_optimizer(
     wd = float(params.get("weight_decay", 0.0))
     key = name.lower().replace("_", "")
 
+    # OneBit optimizers = base update rule + sign-compressed gradient
+    # allreduce with error feedback; the engine activates the compressed
+    # collective automatically for these names (engine._onebit_config,
+    # parallel/onebit.py — reference runtime/comm/nccl.py:51).
     if key in (ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
-        logger.warning(
-            f"{name}: 1-bit gradient compression is configured separately on TPU "
-            "(gradient_compression config); using Adam update rule."
-        )
+        logger.info(f"{name}: Adam update rule + engine-level 1-bit compressed allreduce")
         key = ADAM_OPTIMIZER
     if key == ONEBIT_LAMB_OPTIMIZER:
-        logger.warning(f"{name}: using Lamb update rule; compression via gradient_compression config.")
+        logger.info(f"{name}: Lamb update rule + engine-level 1-bit compressed allreduce")
         key = LAMB_OPTIMIZER
 
     if key == ADAM_OPTIMIZER:

@@ -1,0 +1,153 @@
+"""HF checkpoint ingestion (reference module_inject/load_checkpoint.py +
+inference/v2/engine_factory.py): safetensors -> param pytree -> engines.
+
+Ground truth is the transformers implementation itself: a tiny random HF model
+is saved with save_pretrained, ingested, and must reproduce the HF logits.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.checkpoint.hf import (
+    config_from_hf,
+    convert_hf_state,
+    detect_family,
+    load_hf_checkpoint,
+)
+from deepspeed_tpu.models import CausalLM
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def _save_tiny_llama(tmp_path, tie=False, moe=False):
+    if moe:
+        cfg = transformers.MixtralConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rope_theta=10000.0,
+            num_local_experts=4, num_experts_per_tok=2,
+            tie_word_embeddings=tie,
+        )
+        model = transformers.MixtralForCausalLM(cfg)
+    else:
+        cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rope_theta=10000.0,
+            tie_word_embeddings=tie,
+        )
+        model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+def test_llama_ingestion_logits_parity(tmp_path):
+    hf_model = _save_tiny_llama(tmp_path)
+    cfg, params = load_hf_checkpoint(str(tmp_path))
+    assert cfg.norm == "rmsnorm" and cfg.num_kv_heads == 2
+
+    ids = np.random.default_rng(0).integers(0, 128, (2, 12))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+
+    module = CausalLM(cfg)
+    _, logits = module.apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray, params)},
+        {"input_ids": jnp.asarray(ids, jnp.int32)}, train=False)
+    np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3, atol=2e-4)
+
+
+def test_gpt2_ingestion_logits_parity(tmp_path):
+    cfg_hf = transformers.GPT2Config(
+        vocab_size=96, n_embd=32, n_layer=2, n_head=4, n_positions=64)
+    hf_model = transformers.GPT2LMHeadModel(cfg_hf)
+    hf_model.eval()
+    hf_model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg, params = load_hf_checkpoint(str(tmp_path))
+    assert cfg.norm == "layernorm" and cfg.tie_embeddings
+
+    ids = np.random.default_rng(1).integers(0, 96, (2, 10))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+    module = CausalLM(cfg)
+    _, logits = module.apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray, params)},
+        {"input_ids": jnp.asarray(ids, jnp.int32)}, train=False)
+    np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3, atol=2e-4)
+
+
+def test_mixtral_ingestion_structure(tmp_path):
+    """Mixtral converts to the exact tree the in-repo MoE CausalLM expects
+    (logits parity is not pinned: HF routes without capacity dropping)."""
+    _save_tiny_llama(tmp_path, moe=True)
+    cfg, params = load_hf_checkpoint(str(tmp_path))
+    assert cfg.num_experts == 4
+
+    module = CausalLM(cfg)
+    batch = {"input_ids": jnp.zeros((2, 8), jnp.int32)}
+    want = jax.eval_shape(
+        lambda: module.init({"params": jax.random.PRNGKey(0)}, batch, train=False)["params"])
+    got = jax.tree_util.tree_map(jnp.asarray, params)
+    want_flat = jax.tree_util.tree_flatten_with_path(want)[0]
+    got_flat = {jax.tree_util.keystr(k): v for k, v in jax.tree_util.tree_flatten_with_path(got)[0]}
+    for k, leaf in want_flat:
+        ks = jax.tree_util.keystr(k)
+        assert ks in got_flat, f"missing {ks}"
+        assert got_flat[ks].shape == leaf.shape, f"{ks}: {got_flat[ks].shape} != {leaf.shape}"
+    # and it runs
+    loss, _ = module.apply({"params": got}, {"input_ids": jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, (2, 8)), jnp.int32)}, train=False)
+    assert np.isfinite(float(loss))
+
+
+def test_init_inference_tp2_from_hf(tmp_path, devices):
+    """VERDICT round-2 'done' bar: tiny llama safetensors -> init_inference
+    (tp=2) on the CPU mesh -> generate."""
+    import deepspeed_tpu
+
+    _save_tiny_llama(tmp_path)
+    cfg, params = load_hf_checkpoint(str(tmp_path))
+    engine = deepspeed_tpu.init_inference(
+        cfg, config={"tensor_parallel": {"tp_size": 2}, "dtype": "float32", "seq_bucket": 8},
+        params=params)
+    out = engine.generate(np.asarray([[5, 6, 7]]), max_new_tokens=4, do_sample=False)
+    assert out.shape == (1, 7)
+
+
+def test_initialize_training_from_hf(tmp_path, devices):
+    """HF params feed initialize(model_parameters=...) and train."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm_spec
+
+    _save_tiny_llama(tmp_path)
+    cfg, params = load_hf_checkpoint(str(tmp_path))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=8),
+        model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}, "steps_per_print": 100},
+    )
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 128, (8, 8), dtype=np.int32)}
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_detect_family():
+    assert detect_family({"model.layers.0.self_attn.q_proj.weight": 0}) == "llama"
+    assert detect_family({"h.0.attn.c_attn.weight": 0}) == "gpt2"
+    assert detect_family({"model.layers.0.block_sparse_moe.gate.weight": 0}) == "mixtral"
+    with pytest.raises(ValueError):
+        detect_family({"bogus": 0})
+
+
+def test_config_from_hf_rejects_unknown():
+    with pytest.raises(ValueError, match="model_type"):
+        config_from_hf({"model_type": "resnet"})

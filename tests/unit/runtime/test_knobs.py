@@ -1,0 +1,182 @@
+"""The three round-2 'dead knobs', now live (VERDICT item 5):
+
+(a) gradient_compression -> 1-bit sign+error-feedback compressed allreduce
+    (reference runtime/comm/nccl.py:51, OnebitAdam family)
+(b) activation_checkpointing -> jax.checkpoint policy on the compiled loss
+    (reference runtime/activation_checkpointing/checkpointing.py:948)
+(c) mics_shard_size -> fsdp sub-group mesh (reference zero/mics.py:64)
+
+Each knob must demonstrably change the compiled program or raise.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+TC = TransformerConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
+                       num_layers=2, num_heads=4, max_seq_len=32)
+
+
+def _cfg(**over):
+    base = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "steps_per_print": 1000,
+    }
+    base.update(over)
+    return base
+
+
+def _batch(engine, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 128, (engine.train_batch_size, 16), dtype=np.int32)}
+
+
+# ------------------------------------------------------------- (a) onebit
+
+def test_onebit_packing_roundtrip():
+    from deepspeed_tpu.parallel.onebit import pack_signs, unpack_signs
+
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(37,)), jnp.float32)
+    signs = unpack_signs(pack_signs(x), 37)
+    np.testing.assert_array_equal(np.asarray(signs), np.where(np.asarray(x) >= 0, 1.0, -1.0))
+
+
+def test_onebit_trains_and_ships_uint8(devices):
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(TC, example_seq_len=16),
+        config=_cfg(optimizer={"type": "OneBitAdam", "params": {"lr": 1e-2}}),
+    )
+    assert engine._onebit  # compression active
+    batch = _batch(engine)
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"no learning under 1-bit compression: {losses}"
+    # the wire format is uint8: the compiled step must contain u8 collectives
+    placed = engine._shard_global_batch(batch)
+    text = engine._train_step.lower(engine.state, placed).as_text()
+    assert "all_gather" in text and "ui8" in text, "no uint8 all_gather on the wire"
+
+
+def test_onebit_error_feedback_state(devices):
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(TC, example_seq_len=16),
+        config=_cfg(gradient_compression={"enabled": True}),
+    )
+    assert engine.state.comm_error is not None
+    before = [np.asarray(x).copy() for x in jax.tree_util.tree_leaves(engine.state.comm_error)]
+    engine.train_batch(_batch(engine))
+    after = [np.asarray(x) for x in jax.tree_util.tree_leaves(engine.state.comm_error)]
+    # residuals become non-zero after one compressed step
+    assert any((a != b).any() for a, b in zip(after, before))
+
+
+def test_onebit_close_to_uncompressed(devices):
+    """Early-step trajectory stays near the exact-allreduce run (error
+    feedback bounds the drift; not exact by construction)."""
+    batch = None
+    runs = {}
+    for name, cfg in (
+        ("exact", _cfg()),
+        ("onebit", _cfg(gradient_compression={"enabled": True})),
+    ):
+        e, *_ = deepspeed_tpu.initialize(model=causal_lm_spec(TC, example_seq_len=16), config=cfg)
+        batch = _batch(e)
+        runs[name] = [float(e.train_batch(batch)["loss"]) for _ in range(4)]
+    # step 1 is bit-identical (no error accumulated yet); later steps drift
+    # with compression noise but stay in the same descent envelope
+    np.testing.assert_allclose(runs["onebit"][0], runs["exact"][0], rtol=1e-5)
+    np.testing.assert_allclose(runs["onebit"], runs["exact"], rtol=0.25)
+    assert all(b < a for a, b in zip(runs["onebit"], runs["onebit"][1:]))
+
+
+def test_onebit_rejects_stage2(devices):
+    with pytest.raises(ValueError, match="stage <= 1"):
+        deepspeed_tpu.initialize(
+            model=causal_lm_spec(TC, example_seq_len=16),
+            config=_cfg(gradient_compression={"enabled": True},
+                        zero_optimization={"stage": 2}),
+        )
+
+
+# ------------------------------------- (b) activation checkpointing policy
+
+def test_activation_checkpointing_changes_program_not_math(devices):
+    base, remat = [], []
+    for store, ac in ((base, {}), (remat, {"enabled": True, "policy": "full"})):
+        e, *_ = deepspeed_tpu.initialize(
+            model=causal_lm_spec(TC, example_seq_len=16),
+            config=_cfg(activation_checkpointing=ac),
+        )
+        batch = _batch(e)
+        store.extend(float(e.train_batch(batch)["loss"]) for _ in range(3))
+        if ac:
+            placed = e._shard_global_batch(batch)
+            jaxpr = str(e._train_step.trace(e.state, placed).jaxpr)
+            assert "remat" in jaxpr or "checkpoint" in jaxpr
+    np.testing.assert_allclose(remat, base, rtol=1e-5)
+
+
+def test_activation_checkpointing_bad_policy_raises(devices):
+    e, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(TC, example_seq_len=16),
+        config=_cfg(activation_checkpointing={"enabled": True, "policy": "bogus"}),
+    )
+    with pytest.raises(ValueError, match="policy"):
+        e.train_batch(_batch(e))
+
+
+# ------------------------------------------------------- (c) mics_shard_size
+
+def test_mics_submesh_shard_and_replication(devices):
+    """fsdp=8 + mics_shard_size=2 => params sharded over groups of 2 and
+    replicated 4x across groups (reference zero/mics.py:64 semantics)."""
+    e, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(TC, example_seq_len=16),
+        config=_cfg(mesh={"fsdp": 8, "dp": 1},
+                    zero_optimization={"stage": 3, "mics_shard_size": 2,
+                                       "param_persistence_threshold": 0}),
+    )
+    assert e.mesh.shape["fsdp"] == 2 and e.mesh.shape["dp"] == 4
+    # big leaves: sharded into 2 distinct shards, each replicated on 4 devices
+    leaf = e.state.params["embed"]["embedding"]
+    dbl = leaf.sharding.devices_indices_map(leaf.shape)
+    distinct = {str(v) for v in dbl.values()}
+    assert len(distinct) == 2, f"expected 2 distinct shards, got {len(distinct)}"
+
+
+def test_mics_trajectory_matches_full_fsdp(devices):
+    runs = {}
+    for name, zcfg in (
+        ("full", {"stage": 3}),
+        ("mics", {"stage": 3, "mics_shard_size": 2}),
+    ):
+        e, *_ = deepspeed_tpu.initialize(
+            model=causal_lm_spec(TC, example_seq_len=16),
+            config=_cfg(mesh={"fsdp": 8, "dp": 1}, zero_optimization=zcfg),
+        )
+        batch = _batch(e)
+        runs[name] = [float(e.train_batch(batch)["loss"]) for _ in range(3)]
+    np.testing.assert_allclose(runs["mics"], runs["full"], rtol=2e-4)
+
+
+def test_mics_rejects_stage1(devices):
+    with pytest.raises(ValueError, match="stage 3"):
+        deepspeed_tpu.initialize(
+            model=causal_lm_spec(TC, example_seq_len=16),
+            config=_cfg(mesh={"fsdp": 8, "dp": 1},
+                        zero_optimization={"stage": 1, "mics_shard_size": 2}),
+        )
+
+
+def test_mics_rejects_nondividing(devices):
+    with pytest.raises(ValueError, match="divide"):
+        deepspeed_tpu.initialize(
+            model=causal_lm_spec(TC, example_seq_len=16),
+            config=_cfg(mesh={"fsdp": 8, "dp": 1},
+                        zero_optimization={"stage": 3, "mics_shard_size": 3}),
+        )
