@@ -1,30 +1,48 @@
-"""Benchmark: flagship CausalLM training throughput on the local accelerator.
+"""Benchmark: flagship CausalLM training + inference throughput.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extras": {...}}
 
-On the single real TPU chip this measures tokens/sec/chip for GPT-2-small
-(125M params, bf16, seq 1024) full train steps (fwd+bwd+Adam) through the
-engine. vs_baseline = achieved MFU / 0.45, the north-star MFU from
-BASELINE.md (reference's Ulysses/FPDT blogs claim ~54%/55% peak on A100;
-this repo's target is >=45% MFU on TPU).
+Headline (value/vs_baseline): tokens/sec/chip for GPT-2-small (125M params,
+bf16, seq 1024, gas 4) full train steps (fwd+bwd+AdamW) through the engine on
+the single real TPU chip. vs_baseline = achieved MFU / 0.45, the north-star
+MFU from BASELINE.md (the reference's Ulysses/FPDT blogs claim ~54%/55% peak
+on A100).
+
+"extras" adds the other BASELINE.json tracked configs that fit one chip
+(round-2 verdict items 3/9): a Llama-style ZeRO-3 + remat + fused-CE config
+(largest that fits 16G HBM), a Mixtral-style expert-parallel step, and the v2
+inference engine's p50 TTFT + decode tokens/sec. Each extra is best-effort —
+a failure records the error string instead of killing the headline number.
 
 Falls back to a tiny model on CPU so the bench always completes.
+
+NOTE: sync via explicit scalar fetch (np.asarray) — jax.block_until_ready is
+a no-op on the axon TPU relay (see PERF.md).
 """
 
 from __future__ import annotations
 
 import json
-import sys
 import time
 
 
-def main() -> None:
+def _train_tokens_per_sec(engine, batch, steps, warmup):
+    import numpy as np
+
+    for _ in range(warmup):
+        m = engine.train_batch(batch)
+    np.asarray(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = engine.train_batch(batch)
+    np.asarray(m["loss"])
+    dt = time.perf_counter() - t0
+    return engine.train_batch_size * batch["input_ids"].shape[1] * steps / dt
+
+
+def bench_train_gpt2(on_tpu, peak_flops):
     import jax
-
-    backend = jax.default_backend()
-    on_tpu = backend == "tpu"
-
     import numpy as np
 
     import deepspeed_tpu
@@ -37,52 +55,174 @@ def main() -> None:
             norm="layernorm", activation="gelu", position="learned",
             tie_embeddings=True, dtype=jax.numpy.bfloat16,
         )
-        micro, seq, steps, warmup = 8, 1024, 10, 3
-        peak_flops = 197e12  # v5e bf16 peak per chip
+        micro, seq, steps, warmup, gas = 8, 1024, 10, 3, 4
     else:
         cfg = TransformerConfig(
             vocab_size=512, hidden_size=128, intermediate_size=256,
             num_layers=2, num_heads=4, max_seq_len=256,
         )
-        micro, seq, steps, warmup = 2, 128, 3, 1
-        peak_flops = 1e12  # nominal; CPU numbers are smoke-test only
+        micro, seq, steps, warmup, gas = 2, 128, 3, 1, 1
 
-    gas = 4 if on_tpu else 1
-    config = {
-        "train_micro_batch_size_per_gpu": micro,
-        "gradient_accumulation_steps": gas,
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
-        "zero_optimization": {"stage": 1},
-        "bf16": {"enabled": True},
-        "gradient_clipping": 1.0,
-        "steps_per_print": 10_000,
-    }
     engine, *_ = deepspeed_tpu.initialize(
-        model=causal_lm_spec(cfg, example_seq_len=seq), config=config
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 10_000,
+        },
     )
-
     rng = np.random.default_rng(0)
-    batch = {
-        "input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    tok_per_sec = _train_tokens_per_sec(engine, batch, steps, warmup)
+    mfu = tok_per_sec * cfg.flops_per_token(seq) / peak_flops
+    return tok_per_sec, mfu, seq
+
+
+def bench_train_llama_z3(peak_flops):
+    """Largest-fitting Llama-style config: ZeRO-3 placement + remat + fused CE.
+
+    Single chip, so ZeRO-3 is placement-only (fsdp=1) — this measures the
+    dense-model step the Llama-3-8B multi-chip config is built from."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    cfg = TransformerConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_layers=22, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+        norm="rmsnorm", activation="silu_glu", position="rope",
+        remat=True, dtype=jax.numpy.bfloat16,
+    )  # ~1.1B params (TinyLlama geometry)
+    seq = 2048
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 3},
+            "bf16": {"enabled": True},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 10_000,
+        },
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    tok_per_sec = _train_tokens_per_sec(engine, batch, steps=5, warmup=2)
+    return {
+        "tokens_per_sec_per_chip": round(tok_per_sec, 1),
+        "mfu": round(tok_per_sec * cfg.flops_per_token(seq) / peak_flops, 4),
+        "params_m": round(cfg.num_params() / 1e6),
     }
 
-    # NOTE: sync via an explicit scalar fetch — jax.block_until_ready is a
-    # no-op on some experimental platforms (observed on the axon TPU relay),
-    # which silently turns a timing loop into a dispatch-latency measurement.
-    for _ in range(warmup):
-        m = engine.train_batch(batch)
-    np.asarray(m["loss"])
 
+def bench_train_moe(peak_flops):
+    """Mixtral-style expert-parallel step (8 experts, top-2) on one chip."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    cfg = TransformerConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=1024,
+        norm="rmsnorm", activation="silu_glu", position="rope",
+        num_experts=8, moe_top_k=2, dtype=jax.numpy.bfloat16,
+    )
+    seq = 1024
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+        },
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    tok_per_sec = _train_tokens_per_sec(engine, batch, steps=5, warmup=2)
+    # active-params flops: top-2 of 8 experts => dense flops with 2/8 of MLP
+    return {
+        "tokens_per_sec_per_chip": round(tok_per_sec, 1),
+        "total_params_m": round(cfg.num_params() / 1e6),
+    }
+
+
+def bench_inference():
+    """v1 engine generate: p50 TTFT (prefill) + steady decode tok/s."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=50304, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, max_seq_len=2048,
+        norm="layernorm", activation="gelu", position="learned",
+        tie_embeddings=True, dtype=jax.numpy.bfloat16,
+    )
+    from deepspeed_tpu.models import CausalLM
+
+    module = CausalLM(cfg)
+    example = {"input_ids": jax.numpy.zeros((1, 8), jax.numpy.int32)}
+    params = module.init({"params": jax.random.PRNGKey(0)}, example, train=False)["params"]
+    engine = deepspeed_tpu.init_inference(
+        cfg, params=params,
+        config={"dtype": "bfloat16", "seq_bucket": 256, "max_out_tokens": 256},
+    )
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (1, 200), dtype=np.int32)
+
+    # warm both programs
+    engine.generate(prompt, max_new_tokens=8, do_sample=False)
+
+    # TTFT proxy: 1-new-token generate (prefill + 1 decode), p50 of 7
+    ttfts = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        engine.generate(prompt, max_new_tokens=1, do_sample=False)
+        ttfts.append(time.perf_counter() - t0)
+    p50_ttft = sorted(ttfts)[len(ttfts) // 2]
+
+    # decode throughput: long generation minus the TTFT part
+    n_new = 128
     t0 = time.perf_counter()
-    for _ in range(steps):
-        m = engine.train_batch(batch)
-    np.asarray(m["loss"])
+    engine.generate(prompt, max_new_tokens=n_new, do_sample=False)
     dt = time.perf_counter() - t0
+    decode_tok_s = (n_new - 1) / max(dt - p50_ttft, 1e-6)
+    return {"p50_ttft_ms": round(p50_ttft * 1e3, 2),
+            "decode_tokens_per_sec": round(decode_tok_s, 1)}
 
-    tokens = engine.train_batch_size * seq * steps
-    tok_per_sec = tokens / dt
-    flops_per_token = cfg.flops_per_token(seq)
-    mfu = tok_per_sec * flops_per_token / peak_flops
+
+def main() -> None:
+    import jax
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    peak_flops = 197e12 if on_tpu else 1e12  # v5e bf16 peak per chip
+
+    tok_per_sec, mfu, seq = bench_train_gpt2(on_tpu, peak_flops)
+
+    extras = {}
+    if on_tpu:
+        for name, fn in (
+            ("llama_1b_zero3_remat", lambda: bench_train_llama_z3(peak_flops)),
+            ("mixtral_style_moe", lambda: bench_train_moe(peak_flops)),
+            ("inference_v1_gpt2_125m", bench_inference),
+        ):
+            try:
+                extras[name] = fn()
+            except Exception as e:  # best-effort: record, don't kill the headline
+                extras[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     result = {
         "metric": f"tokens_per_sec_per_chip_gpt2_125m_bf16_seq{seq}" if on_tpu
@@ -90,6 +230,7 @@ def main() -> None:
         "value": round(tok_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
+        **({"extras": extras} if extras else {}),
     }
     print(json.dumps(result))
 

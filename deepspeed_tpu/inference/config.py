@@ -30,11 +30,22 @@ class TPConfig(DeepSpeedConfigModel):
 
 
 class QuantConfig(DeepSpeedConfigModel):
-    """Weight-only quantization (reference ``QuantizationConfig`` — int8 woq)."""
+    """Weight-only quantization (reference ``QuantizationConfig`` int4/int8 +
+    ``ops/fp_quantizer`` fp8; implementation ``inference/woq.py``)."""
 
     enabled: bool = False
     bits: int = 8
     group_size: int = 128
+    qtype: str = "int"  # 'int' (int8/int4 by bits) | 'fp' (fp8)
+
+
+class ZeroInferenceConfig(DeepSpeedConfigModel):
+    """ZeRO-Inference: weights live in host memory and stream through the
+    forward (reference stage-3-for-inference + AIO, blogs/deepspeed-gds)."""
+
+    enabled: bool = False
+    offload: str = "cpu"  # 'cpu' (pinned host memory) — nvme via swap_tensor
+    min_leaf_size: int = 1 << 16  # leaves smaller than this stay on device
 
 
 class InferenceConfig(DeepSpeedConfigModel):
@@ -43,6 +54,7 @@ class InferenceConfig(DeepSpeedConfigModel):
     dtype: str = "bf16"
     tensor_parallel: TPConfig = Field(default_factory=TPConfig)
     quant: QuantConfig = Field(default_factory=QuantConfig)
+    zero_inference: ZeroInferenceConfig = Field(default_factory=ZeroInferenceConfig)
     max_out_tokens: int = 1024  # hard cap on generate(max_new_tokens=...)
     min_out_tokens: int = 1  # reserved (reference scheduler admission knob)
     max_batch_size: Optional[int] = None  # hard cap on generate batch size

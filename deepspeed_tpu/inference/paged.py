@@ -60,8 +60,15 @@ def _slot_ids(block_tables: jax.Array, positions: jax.Array, valid: jax.Array,
     return jnp.where(valid, slot, trash)
 
 
-def paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block_size):
-    """Masked GQA attention of new queries against paged caches.
+from deepspeed_tpu.ops.registry import dispatch, register
+
+
+@register("paged_attention", "xla")
+def _xla_paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block_size,
+                         new_lens=None):
+    """Masked GQA attention of new queries against paged caches (dense-gather
+    fallback; the Pallas flash-decode kernel in
+    ``ops/pallas/paged_attention.py`` wins dispatch on TPU).
 
     q: [N, C, H, hd]; pool_{k,v}_l: [S_flat, kvH, hd] (one layer's pool);
     block_tables: [N, P]; q_positions: [N, C]. Returns [N, C, H, hd].
@@ -83,6 +90,15 @@ def paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block_size
     probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
     ctx = jnp.einsum("nkgct,ntkd->nckgd", probs, cv)
     return ctx.reshape(N, C, H, hd)
+
+
+def paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block_size,
+                    new_lens=None, impl: str = "auto"):
+    import deepspeed_tpu.ops.pallas.paged_attention  # noqa: F401  (registers the kernel)
+
+    return dispatch("paged_attention", impl)(
+        q, pool_k_l, pool_v_l, block_tables, q_positions, block_size, new_lens=new_lens
+    )
 
 
 def ragged_forward(
@@ -126,7 +142,7 @@ def ragged_forward(
         kvH, hd = k.shape[-2], k.shape[-1]
         pk = pk.at[flat_slot].set(k.astype(pk.dtype).reshape(-1, kvH, hd), mode="drop")
         pv = pv.at[flat_slot].set(v.astype(pv.dtype).reshape(-1, kvH, hd), mode="drop")
-        ctx = paged_attention(q, pk, pv, block_tables, positions, bs)
+        ctx = paged_attention(q, pk, pv, block_tables, positions, bs, new_lens=new_lens)
         x = x + _attn_out(lp["attn"], cfg, ctx)
         h = _apply_norm(lp["mlp_norm"], cfg, x)
         if cfg.num_experts > 0:

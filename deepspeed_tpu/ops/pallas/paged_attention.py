@@ -1,0 +1,187 @@
+"""Paged (block-table) flash-decode attention as a Pallas TPU kernel.
+
+TPU-native analog of FastGen's ``blocked_flash`` kernel
+(``inference/v2/kernels/ragged_ops/blocked_flash/`` — paged attention over a
+blocked KV cache) — the kernel the reference's 2.3x-vs-vLLM claim lives in
+(``blogs/deepspeed-fastgen/README.md:28``).
+
+Design: the KV pool stays in HBM (``memory_space=ANY``); the block table rides
+scalar prefetch so the kernel issues manual DMAs of exactly the pages each
+sequence owns — no dense gather ever materializes. Grid is
+``(rows, kv_heads, page_chunks)``; each step copies ``pages_per_block`` pages
+into VMEM, runs one online-softmax update for all query heads in the GQA
+group, and page-chunks past a row's live length are skipped entirely
+(compute AND DMA — the guard wraps the copies).
+
+Against the XLA fallback (gather pages to dense then masked attention) this
+removes the gathered-copy write+read and the [rows, tokens] fp32 score
+round-trip: decode becomes one streaming read of the live KV pages, which is
+the bandwidth floor for paged attention.
+
+The KV-insert+RoPE side of the reference's kernel pair
+(``linear_blocked_kv_rotary``) stays an XLA scatter: ``.at[slots].set`` with
+the RoPE rotation feeding it fuses into a single scatter program under XLA,
+so a hand kernel buys nothing there.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.ops.registry import register
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+_LANES = 8
+DEFAULT_PAGES_PER_BLOCK = 8
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _cdiv(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+def _decode_kernel(bt_ref, ap_ref, q_ref, qpos_ref, k_hbm, v_hbm, o_ref,
+                   kbuf, vbuf, acc_ref, m_ref, l_ref, sem_k, sem_v, *,
+                   bs, ppcb, kv_heads):
+    n = pl.program_id(0)
+    kh = pl.program_id(1)
+    pc = pl.program_id(2)
+    npc = pl.num_programs(2)
+
+    @pl.when(pc == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        copies = []
+        for i in range(ppcb):
+            page = bt_ref[n, pc * ppcb + i]
+            copies.append(pltpu.make_async_copy(
+                k_hbm.at[pl.ds(page * bs, bs), pl.ds(kh, 1)],
+                kbuf.at[pl.ds(i * bs, bs)], sem_k))
+            copies.append(pltpu.make_async_copy(
+                v_hbm.at[pl.ds(page * bs, bs), pl.ds(kh, 1)],
+                vbuf.at[pl.ds(i * bs, bs)], sem_v))
+        for c in copies:
+            c.start()
+        for c in copies:
+            c.wait()
+
+        q = q_ref[0, 0]  # [Cg, hd] (pre-scaled)
+        k = kbuf[:, 0]  # [ppcb*bs, hd]
+        v = vbuf[:, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [Cg, T]
+        # causality over SEQUENCE positions: token j of this page-chunk is at
+        # global position pc*ppcb*bs + j; visible iff <= the query's position
+        j = pc * ppcb * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        qpos = qpos_ref[0]  # [Cg]
+        s = jnp.where(j <= qpos[:, None], s, _NEG_INF)
+
+        m_prev = jnp.max(m_ref[:], axis=-1, keepdims=True)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.where(m_cur == _NEG_INF, 0.0, m_cur)
+        p = jnp.exp(s - m_safe)
+        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
+        l_prev = jnp.max(l_ref[:], axis=-1, keepdims=True)
+        l_ref[:] = jnp.broadcast_to(alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    # skip page-chunks entirely beyond the row's live pages (guard wraps the
+    # DMAs too — dead pages cost no bandwidth)
+    pl.when(pc * ppcb < ap_ref[n])(_compute)
+
+    @pl.when(pc == npc - 1)
+    def _finalize():
+        l = jnp.max(l_ref[:], axis=-1, keepdims=True)
+        o_ref[0, 0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+@register("paged_attention", "pallas")
+def flash_decode_paged(
+    q: jax.Array,  # [N, C, H, hd]
+    pool_k_l: jax.Array,  # [S_flat, kvH, hd]
+    pool_v_l: jax.Array,
+    block_tables: jax.Array,  # [N, P] int32
+    q_positions: jax.Array,  # [N, C] int32
+    block_size: int,
+    new_lens: jax.Array = None,  # [N] live tokens (for page skipping)
+    pages_per_block: int = DEFAULT_PAGES_PER_BLOCK,
+) -> jax.Array:
+    N, C, H, hd = q.shape
+    kvH = pool_k_l.shape[1]
+    G = H // kvH
+    P = block_tables.shape[1]
+    bs = block_size
+    ppcb = min(pages_per_block, P)
+    Pp = _cdiv(P, ppcb) * ppcb
+    if Pp != P:
+        block_tables = jnp.pad(block_tables, ((0, 0), (0, Pp - P)))
+    npc = Pp // ppcb
+
+    Cg = C * G
+    Cgp = _cdiv(Cg, _LANES) * _LANES
+
+    # [N, kvH, Cg, hd] query layout; rows are (c, g) pairs, padded to sublanes
+    scale = jnp.asarray(hd ** -0.5, q.dtype)
+    q5 = (q * scale).reshape(N, C, kvH, G, hd).transpose(0, 2, 1, 3, 4).reshape(N, kvH, Cg, hd)
+    qpos_rows = jnp.broadcast_to(q_positions[:, :, None], (N, C, G)).reshape(N, Cg)
+    if Cgp != Cg:
+        q5 = jnp.pad(q5, ((0, 0), (0, 0), (0, Cgp - Cg), (0, 0)))
+        # padded rows see nothing (position -1 masks every token)
+        qpos_rows = jnp.pad(qpos_rows, ((0, 0), (0, Cgp - Cg)), constant_values=-1)
+
+    # live pages per row: positions are ascending within the live prefix
+    if new_lens is None:
+        max_pos = jnp.max(q_positions, axis=1)
+    else:
+        last = jnp.maximum(new_lens - 1, 0)
+        max_pos = jnp.take_along_axis(q_positions, last[:, None], axis=1)[:, 0]
+    active_pages = (max_pos + 1 + bs - 1) // bs  # [N]
+
+    kernel = functools.partial(_decode_kernel, bs=bs, ppcb=ppcb, kv_heads=kvH)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # block_tables, active_pages
+            grid=(N, kvH, npc),
+            in_specs=[
+                pl.BlockSpec((1, 1, Cgp, hd), lambda n, kh, pc, bt, ap: (n, kh, 0, 0)),
+                pl.BlockSpec((1, Cgp), lambda n, kh, pc, bt, ap: (n, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((1, 1, Cgp, hd), lambda n, kh, pc, bt, ap: (n, kh, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((ppcb * bs, 1, hd), pool_k_l.dtype),
+                pltpu.VMEM((ppcb * bs, 1, hd), pool_v_l.dtype),
+                pltpu.VMEM((Cgp, hd), jnp.float32),
+                pltpu.VMEM((Cgp, _LANES), jnp.float32),
+                pltpu.VMEM((Cgp, _LANES), jnp.float32),
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA,
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((N, kvH, Cgp, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=_interpret(),
+    )(block_tables, active_pages, q5, qpos_rows, pool_k_l, pool_v_l)
+
+    out = out[:, :, :Cg].reshape(N, kvH, C, G, hd).transpose(0, 2, 1, 3, 4)
+    return out.reshape(N, C, H, hd)

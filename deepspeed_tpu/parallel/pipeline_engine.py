@@ -269,24 +269,30 @@ def spec_from_pipeline_module(module: PipelineModule, pp: int, seed: int = 0) ->
             return apply_one(lp, c, r), None
 
         k = ckpt_interval
-        if k <= 0 or n_local % min(k, n_local):
-            if k > 0:
-                body = jax.checkpoint(body, prevent_cse=False)  # non-dividing k: per-layer
+        if k <= 0:
             out, _ = jax.lax.scan(body, h, (stack, rngs))
             return out
         k = min(k, n_local)
-        gstack = jax.tree_util.tree_map(
-            lambda v: v.reshape((n_local // k, k) + v.shape[1:]), stack
-        )
-        grngs = rngs.reshape((n_local // k, k) + rngs.shape[1:])
 
         def gbody(c, xs):
             lp, rs = xs
             out, _ = jax.lax.scan(body, c, (lp, rs))
             return out, None
 
-        out, _ = jax.lax.scan(jax.checkpoint(gbody, prevent_cse=False), h, (gstack, grngs))
-        return out
+        gbody = jax.checkpoint(gbody, prevent_cse=False)
+        main = (n_local // k) * k
+        if main:
+            gstack = jax.tree_util.tree_map(
+                lambda v: v[:main].reshape((main // k, k) + v.shape[1:]), stack
+            )
+            grngs = rngs[:main].reshape((main // k, k) + rngs.shape[1:])
+            h, _ = jax.lax.scan(gbody, h, (gstack, grngs))
+        if n_local % k:
+            # trailing partial group: one extra checkpoint boundary, honoring
+            # the configured interval for the rest (reference exec_range tail)
+            rest = jax.tree_util.tree_map(lambda v: v[main:][None], stack)
+            h, _ = jax.lax.scan(gbody, h, (rest, rngs[main:][None]))
+        return h
 
     def pipelined_loss(params, batch, rng):
         from deepspeed_tpu.topology.mesh import get_mesh, has_mesh

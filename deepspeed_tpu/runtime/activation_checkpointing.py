@@ -36,12 +36,10 @@ def resolve_policy(name: str):
     if name == "dots":
         return pol.dots_with_no_batch_dims_saveable
     if name == "offload":
-        return pol.save_and_offload_only_these_names(
-            names_which_can_be_saved=[],
-            names_which_can_be_offloaded=[],
-            offload_src="device",
-            offload_dst="pinned_host",
-        )
+        # matmul outputs (no batch dims) move to pinned host memory instead of
+        # being recomputed — the reference's partitioned/CPU activation
+        # checkpointing (checkpointing.py:377 partition_activations + CPU ckpt)
+        return pol.offload_dot_with_no_batch_dims("device", "pinned_host")
     raise ValueError(f"unknown activation_checkpointing policy {name!r}; one of {POLICIES}")
 
 

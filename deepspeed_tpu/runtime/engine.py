@@ -264,7 +264,11 @@ class DeepSpeedTPUEngine:
                 "mics_hierarchical_params_gather: the intra-group allgather is "
                 "inherent to the fsdp-subgroup mesh; no extra hop needed", ranks=[0],
             )
-        return build_mesh(axis_sizes=sizes)
+        # re-mesh through a copied MeshConfig so multi-slice handling
+        # (num_slices / dcn_axis hybrid device order) survives: the MiCS shard
+        # group must stay ICI-contiguous — that IS the point of the knob
+        mics_cfg = config.mesh_config.model_copy(update=sizes)
+        return build_mesh(mics_cfg)
 
     def _build_lr_schedule(self, client_sched) -> Tuple[Schedule, Any]:
         if client_sched is not None and callable(client_sched):
@@ -281,14 +285,23 @@ class DeepSpeedTPUEngine:
         mesh = self.mesh
         rng = jax.random.PRNGKey(seed)
 
+        init_rng = None
         if model_parameters is None:
             init_rng, rng = jax.random.split(rng)
-            # Init on host then place: fine for CPU-mesh tests and single-host;
-            # large-model init should pass pre-sharded model_parameters.
-            model_parameters = self.model.init_fn(init_rng)
-        master_f32 = cast_floating(model_parameters, jnp.float32)
-
-        param_shapes = jax.eval_shape(lambda: master_f32)
+            # Sharded construction (the zero.Init analog,
+            # partition_parameters.py:825): shapes come from eval_shape (no
+            # compute), shardings are derived from them, and the actual init
+            # runs ONCE under jit with out_shardings — every leaf materializes
+            # directly in its target placement, so models larger than host RAM
+            # can be constructed. The eager init-then-place path remains for
+            # caller-provided params (e.g. HF ingestion) and host offload.
+            param_shapes = jax.eval_shape(
+                lambda r: cast_floating(self.model.init_fn(r), jnp.float32), init_rng
+            )
+            master_f32 = None
+        else:
+            master_f32 = cast_floating(model_parameters, jnp.float32)
+            param_shapes = jax.eval_shape(lambda: master_f32)
 
         # Model-parallel base placements (AutoTP rules) — ZeRO composes on top.
         base_specs = self._build_base_specs(param_shapes)
@@ -319,7 +332,19 @@ class DeepSpeedTPUEngine:
             host_sh = SingleDeviceSharding(self._host_device)
             self.param_sharding = jax.tree_util.tree_map(lambda _: host_sh, param_shapes)
 
-        params = jax.device_put(master_f32, self.param_sharding)
+        if master_f32 is not None:
+            params = jax.device_put(master_f32, self.param_sharding)
+        elif self.offload_mode in ("host-jit", "nvme"):
+            # host-resident masters: eager init lands on host anyway
+            params = jax.device_put(
+                cast_floating(self.model.init_fn(init_rng), jnp.float32), self.param_sharding
+            )
+        else:
+            # sharded construction: leaves materialize pre-placed (zero.Init)
+            params = jax.jit(
+                lambda r: cast_floating(self.model.init_fn(r), jnp.float32),
+                out_shardings=self.param_sharding,
+            )(init_rng)
 
         opt_shapes = jax.eval_shape(self.tx.init, params)
         if self.offload_mode in ("host-jit", "nvme"):

@@ -1,0 +1,119 @@
+"""Weight-only-quant inference + ZeRO-Inference (round-2 verdict items 4/7).
+
+Reference: deepspeed/inference/quantization (int8/int4 WOQ),
+csrc/fp_quantizer (fp8), ZeRO-Inference weight offload.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+CFG = TransformerConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_layers=2, num_heads=4, max_seq_len=128, dtype=jnp.float32)
+
+
+def _params():
+    module = CausalLM(CFG)
+    batch = {"input_ids": jnp.zeros((1, 8), jnp.int32)}
+    return module.init({"params": jax.random.PRNGKey(0)}, batch, train=False)["params"]
+
+
+def _engine(**cfg_over):
+    cfg = {"dtype": "float32", "seq_bucket": 16, "max_out_tokens": 64, **cfg_over}
+    return deepspeed_tpu.init_inference(CFG, params=_params(), config=cfg)
+
+
+# --------------------------------------------------------------- fp quant
+
+def test_fp8_roundtrip():
+    from deepspeed_tpu.ops.fp_quant import dequantize_fp8, quantize_fp8
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 128)) * 3.0
+    q, s = quantize_fp8(x, block_size=256)
+    assert q.dtype == jnp.float8_e4m3fn
+    back = dequantize_fp8(q, s, dtype=jnp.float32, block_size=256)
+    err = np.abs(np.asarray(back - x)) / (np.abs(np.asarray(x)) + 1e-3)
+    assert np.median(err) < 0.05
+
+
+def test_int4_pack_roundtrip():
+    from deepspeed_tpu.ops.fp_quant import dequantize_int4, quantize_int4
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 64))
+    q, s = quantize_int4(x, block_size=128)
+    assert q.dtype == jnp.uint8 and q.shape == (32, 32)  # 2 values / byte
+    back = dequantize_int4(q, s, dtype=jnp.float32, block_size=128)
+    # 4-bit symmetric: worst-case half-step error = absmax/14
+    assert np.abs(np.asarray(back - x)).max() < np.abs(np.asarray(x)).max() / 7
+
+
+def test_int4_odd_dim_rejected():
+    from deepspeed_tpu.ops.fp_quant import quantize_int4
+
+    with pytest.raises(ValueError, match="even"):
+        quantize_int4(jnp.zeros((4, 7)))
+
+
+# ------------------------------------------------------------------- WOQ
+
+@pytest.mark.parametrize("quant", [{"bits": 8}, {"bits": 4}, {"qtype": "fp"}])
+def test_woq_generate_close_to_dense(quant, devices):
+    dense = _engine()
+    woq = _engine(quant={"enabled": True, **quant})
+    prompt = np.asarray([[7, 8, 9, 10]])
+    ld = np.asarray(dense.forward(prompt), np.float32)
+    lq = np.asarray(woq.forward(prompt), np.float32)
+    # logits drift bounded by quantization noise
+    denom = np.abs(ld).max()
+    tol = 0.25 if quant.get("bits") == 4 else 0.1
+    assert np.abs(lq - ld).max() / denom < tol
+    out = woq.generate(prompt, max_new_tokens=4, do_sample=False)
+    assert out.shape == (1, 8)
+
+
+def test_woq_memory_shrinks(devices):
+    from deepspeed_tpu.inference.woq import quantize_params, woq_bytes
+
+    params = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), _params())
+    dense_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params))
+    q4 = quantize_params(params, "int4", min_size=0)
+    assert woq_bytes(q4) < 0.45 * dense_bytes  # ~4x on the kernels, embed dense
+
+
+def test_woq_tensor_is_pytree(devices):
+    from deepspeed_tpu.inference.woq import WOQTensor, quantize_params
+
+    q = quantize_params({"a": {"kernel": jnp.ones((64, 64))}}, "int8", min_size=0)
+    leaves = jax.tree_util.tree_leaves(q)
+    assert len(leaves) == 2  # values + scales
+    restored = jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(q), leaves)
+    assert isinstance(restored["a"]["kernel"], WOQTensor)
+    np.testing.assert_allclose(
+        np.asarray(restored["a"]["kernel"].astype(jnp.float32)), 1.0, rtol=1e-2)
+
+
+# --------------------------------------------------------- ZeRO-Inference
+
+def test_zero_inference_offload_generate(devices):
+    from deepspeed_tpu.inference.woq import OffloadedTensor
+
+    eng = _engine(zero_inference={"enabled": True, "min_leaf_size": 0})
+    wq = eng.params["layers"]["attn"]["wq"]["kernel"]
+    assert isinstance(wq, OffloadedTensor)
+    assert wq.x.sharding.memory_kind == "pinned_host"
+    # the embedding stays device-resident (gather cannot read host operands)
+    emb = eng.params["embed"]["embedding"]
+    assert not isinstance(emb, OffloadedTensor)
+    out = eng.generate(np.asarray([[3, 4, 5]]), max_new_tokens=3, do_sample=False)
+    assert out.shape == (1, 6)
+
+
+def test_zero_inference_composes_with_woq(devices):
+    eng = _engine(quant={"enabled": True, "bits": 8},
+                  zero_inference={"enabled": True, "min_leaf_size": 0})
+    out = eng.generate(np.asarray([[3, 4, 5]]), max_new_tokens=3, do_sample=False)
+    assert out.shape == (1, 6)

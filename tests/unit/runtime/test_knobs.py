@@ -180,3 +180,32 @@ def test_mics_rejects_nondividing(devices):
             config=_cfg(mesh={"fsdp": 8, "dp": 1},
                         zero_optimization={"stage": 3, "mics_shard_size": 3}),
         )
+
+
+def test_onebit_universal_checkpoint_excludes_residuals(tmp_path, devices):
+    """comm_error is per-run scratch: a OneBit run's universal checkpoint
+    loads into a plain engine (and vice versa) — mesh-independence holds."""
+    from deepspeed_tpu.checkpoint.universal import load_universal, save_universal
+
+    ob, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(TC, example_seq_len=16),
+        config=_cfg(gradient_compression={"enabled": True}),
+    )
+    batch = _batch(ob)
+    ob.train_batch(batch)
+    save_universal(ob, str(tmp_path))
+
+    plain, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(TC, example_seq_len=16), config=_cfg())
+    load_universal(plain, str(tmp_path))
+    assert plain.state.comm_error is None
+    l = float(plain.train_batch(batch)["loss"])
+    assert np.isfinite(l)
+
+    ob2, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(TC, example_seq_len=16),
+        config=_cfg(gradient_compression={"enabled": True}),
+    )
+    load_universal(ob2, str(tmp_path))
+    assert ob2.state.comm_error is not None  # fresh residuals, not restored
+    assert np.isfinite(float(ob2.train_batch(batch)["loss"]))
