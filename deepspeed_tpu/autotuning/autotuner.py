@@ -61,6 +61,7 @@ class Autotuner:
         base_config: Dict,
         micro_batch_candidates: Sequence[int] = (1, 2, 4, 8),
         stage_candidates: Sequence[int] = (0, 1, 2, 3),
+        remat_candidates: Sequence[bool] = (False, True),
         memory_budget_bytes: Optional[int] = None,
         metric: str = "throughput",
     ):
@@ -68,6 +69,7 @@ class Autotuner:
         self.base_config = dict(base_config)
         self.micro_batch_candidates = list(micro_batch_candidates)
         self.stage_candidates = list(stage_candidates)
+        self.remat_candidates = list(remat_candidates)
         self.memory_budget = memory_budget_bytes
         self.metric = metric
         self.results: List[ExperimentResult] = []
@@ -77,13 +79,19 @@ class Autotuner:
         out = []
         for stage in self.stage_candidates:
             for mb in self.micro_batch_candidates:
-                cfg = dict(self.base_config)
-                cfg.pop("train_batch_size", None)  # re-derived from micro
-                cfg["train_micro_batch_size_per_gpu"] = mb
-                zo = dict(cfg.get("zero_optimization", {}))
-                zo["stage"] = stage
-                cfg["zero_optimization"] = zo
-                out.append(cfg)
+                for remat in self.remat_candidates:
+                    cfg = dict(self.base_config)
+                    cfg.pop("train_batch_size", None)  # re-derived from micro
+                    cfg["train_micro_batch_size_per_gpu"] = mb
+                    zo = dict(cfg.get("zero_optimization", {}))
+                    zo["stage"] = stage
+                    cfg["zero_optimization"] = zo
+                    ac = dict(cfg.get("activation_checkpointing", {}))
+                    ac["enabled"] = remat  # remat=False must really disable it
+                    if remat:
+                        ac.setdefault("policy", "dots")  # keep a user's policy
+                    cfg["activation_checkpointing"] = ac
+                    out.append(cfg)
         return out
 
     def _prune_by_memory(self, cfgs: List[Dict], n_params: int, dp_world: int) -> List[Dict]:

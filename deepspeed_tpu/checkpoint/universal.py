@@ -176,7 +176,10 @@ def _load_universal_npz(engine, path: str, npz_file: str, strict: bool) -> str:
     comm_error = state_dict.pop("comm_error", None)  # per-run scratch
     flat_target = _flatten(state_dict)
     missing = [k for k in flat_target if k not in data.files and flat_target[k] is not None]
-    extra = [k for k in data.files if k not in flat_target]
+    # v1 checkpoints written before comm_error became per-run scratch may
+    # carry its atoms; they are skipped, not a mismatch
+    extra = [k for k in data.files
+             if k not in flat_target and not k.startswith("['comm_error']")]
     if (missing or extra) and strict:
         raise ValueError(f"universal checkpoint mismatch: missing={missing[:5]} extra={extra[:5]}")
 

@@ -1,0 +1,125 @@
+"""Elastic agent: restart training with a re-resolved world after failures.
+
+Reference analog: ``deepspeed/elasticity/elastic_agent.py:32 DSElasticAgent``
+(a torch-elastic agent subclass that restarts failed workers and lets
+elasticity re-resolve the batch config). TPU mapping: workers are per-host
+processes launched by ``launcher/runner.py``; on a worker failure the agent
+kills the generation, drops the failed host, asks
+``elasticity.compute_elastic_config`` for a valid (batch, micro, world)
+triple at the surviving world size, and relaunches — up to
+``max_restarts`` generations. State continuity comes from the framework's
+checkpoint/resume (universal checkpoints load under any world size).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    generation: int
+    world_size: int
+    returncodes: Dict[str, int]
+    ok: bool
+
+
+class DSElasticAgent:
+    """Launch + supervise worker processes; restart on failure with a
+    shrunken world.
+
+    ``launch_fn(hosts, gen, elastic_cfg) -> {host: Popen}`` abstracts process
+    creation so unit tests (and future schedulers) can inject their own; the
+    default shells out like ``launcher/runner.py`` does.
+    """
+
+    def __init__(
+        self,
+        hosts: Dict[str, int],  # host -> slots
+        elastic_config: Dict,  # reference 'elasticity' config section
+        launch_fn: Callable[[Sequence[str], int, Dict], Dict[str, subprocess.Popen]],
+        max_restarts: int = 3,
+        min_hosts: int = 1,
+        poll_interval_s: float = 0.5,
+    ):
+        self.hosts = dict(hosts)
+        self.elastic_config = elastic_config
+        self.launch_fn = launch_fn
+        self.max_restarts = max_restarts
+        self.min_hosts = min_hosts
+        self.poll_interval_s = poll_interval_s
+        self.history: List[GenerationResult] = []
+
+    # ------------------------------------------------------------------
+    def _world_size(self, hosts: Dict[str, int]) -> int:
+        return sum(hosts.values())
+
+    def resolve_config(self, hosts: Dict[str, int]) -> Tuple[Dict, int]:
+        """Elastic batch triple for this generation's world size."""
+        from deepspeed_tpu.elasticity.elasticity import ElasticityError
+
+        world = self._world_size(hosts)
+        batch, valid, _micro_map, micro = compute_elastic_config(
+            self.elastic_config, world_size=world)
+        if micro is None:
+            raise ElasticityError(
+                f"world size {world} is not elastic-compatible (valid: {valid})")
+        return {"train_batch_size": batch, "train_micro_batch_size_per_gpu": micro}, world
+
+    def _wait_generation(self, procs: Dict[str, subprocess.Popen]) -> Tuple[Dict[str, int], List[str]]:
+        """Block until all exit, or kill the generation on first failure
+        (the launcher's peers-die-together contract).
+
+        Returns (exit codes, failed hosts). Survivors the AGENT terminated
+        exit non-zero too, but they did not fail — only hosts that died on
+        their own count (otherwise one crash would disqualify every host and
+        no restart could ever happen)."""
+        live = dict(procs)
+        codes: Dict[str, int] = {}
+        agent_killed: set = set()
+        while live:
+            for host, p in list(live.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                codes[host] = rc
+                del live[host]
+                if rc != 0 and host not in agent_killed:
+                    for other_host, other in live.items():
+                        try:
+                            other.terminate()
+                            agent_killed.add(other_host)
+                        except Exception:
+                            pass
+            time.sleep(self.poll_interval_s)
+        for host, p in procs.items():
+            codes.setdefault(host, p.returncode if p.returncode is not None else -1)
+        failed = [h for h, rc in codes.items() if rc != 0 and h not in agent_killed]
+        return codes, failed
+
+    def run(self) -> GenerationResult:
+        """Supervise generations until success or restart budget exhausted."""
+        hosts = dict(self.hosts)
+        for gen in range(self.max_restarts + 1):
+            cfg, world = self.resolve_config(hosts)
+            logger.info(f"elastic generation {gen}: hosts={list(hosts)} world={world} cfg={cfg}")
+            procs = self.launch_fn(list(hosts), gen, cfg)
+            codes, failed = self._wait_generation(procs)
+            result = GenerationResult(gen, world, codes, ok=not any(rc != 0 for rc in codes.values()))
+            self.history.append(result)
+            if result.ok:
+                return result
+            # drop failed hosts; restart the survivors as a smaller world
+            for h in failed:
+                hosts.pop(h, None)
+            if len(hosts) < self.min_hosts:
+                logger.error(f"elastic agent: {len(hosts)} hosts left (< min {self.min_hosts}); giving up")
+                return result
+            logger.warning(f"elastic agent: workers failed on {failed}; restarting with {list(hosts)}")
+        return self.history[-1]

@@ -9,3 +9,4 @@ from deepspeed_tpu.linear.optimized_linear import (
     lora_param_labels,
     lora_trainable_mask,
 )
+from deepspeed_tpu.linear.tiled_linear import TiledLinear

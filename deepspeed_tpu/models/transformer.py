@@ -64,6 +64,22 @@ class TransformerConfig:
     moe_min_capacity: int = 4
     moe_aux_loss_weight: float = 0.01
     moe_drop_tokens: bool = True
+    # PR-MoE (reference moe/layer.py use_residual; DeepSpeed-MoE pyramid):
+    # a per-layer expert-count tuple makes the stack a pyramid (0 => dense
+    # layer); requires scan_layers=False (heterogeneous layers cannot scan).
+    moe_use_residual: bool = False
+    moe_layer_experts: Optional[Tuple[int, ...]] = None
+
+    def experts_for_layer(self, i: int) -> int:
+        if self.moe_layer_experts is not None:
+            return self.moe_layer_experts[i]
+        return self.num_experts
+
+    @property
+    def has_moe(self) -> bool:
+        return self.num_experts > 0 or bool(
+            self.moe_layer_experts and any(e > 0 for e in self.moe_layer_experts)
+        )
 
     @property
     def kv_heads(self) -> int:
@@ -204,6 +220,7 @@ class Block(nn.Module):
     # not forward kwargs through the scanned call.
     config: TransformerConfig
     train: bool = False
+    layer_idx: int = 0  # selects the pyramid expert count (PR-MoE)
 
     @nn.compact
     def __call__(self, carry, _=None):
@@ -213,11 +230,12 @@ class Block(nn.Module):
             _norm(cfg, "attn_norm")(x), mask, positions, self.train
         )
         h = _norm(cfg, "mlp_norm")(x)
-        if cfg.num_experts > 0:
+        n_exp = cfg.experts_for_layer(self.layer_idx)
+        if n_exp > 0:
             from deepspeed_tpu.parallel.moe import MoEConfig, MoELayer
 
             moe_cfg = MoEConfig(
-                num_experts=cfg.num_experts,
+                num_experts=n_exp,
                 top_k=cfg.moe_top_k,
                 capacity_factor=cfg.moe_capacity_factor,
                 min_capacity=cfg.moe_min_capacity,
@@ -227,6 +245,7 @@ class Block(nn.Module):
             l_aux, out = MoELayer(
                 moe_cfg, cfg.hidden_size, cfg.intermediate_size,
                 activation=cfg.activation, dtype=cfg.dtype, train=self.train,
+                use_residual=cfg.moe_use_residual,
                 name="moe",
             )(h)
             x = x + out
@@ -281,6 +300,11 @@ class CausalLM(nn.Module):
         if cfg.remat:
             block_cls = nn.remat(Block, prevent_cse=False)
         if cfg.scan_layers:
+            if cfg.moe_layer_experts is not None:
+                raise ValueError(
+                    "pyramid MoE (moe_layer_experts) needs scan_layers=False: "
+                    "heterogeneous expert counts cannot stack into one scan"
+                )
             stack = nn.scan(
                 block_cls,
                 variable_axes={"params": 0},
@@ -291,7 +315,8 @@ class CausalLM(nn.Module):
             (x, _, _, aux), _ = stack((x, pad_mask, positions, aux), None)
         else:
             for i in range(cfg.num_layers):
-                (x, _, _, aux), _ = block_cls(cfg, train, name=f"layer_{i}")((x, pad_mask, positions, aux), None)
+                (x, _, _, aux), _ = block_cls(cfg, train, layer_idx=i, name=f"layer_{i}")(
+                    (x, pad_mask, positions, aux), None)
 
         x = _norm(cfg, "final_norm")(x)
         labels = batch.get("labels")
@@ -317,7 +342,7 @@ class CausalLM(nn.Module):
             else:
                 logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
             loss = cross_entropy_loss(logits, labels, pad_mask)
-        if cfg.num_experts > 0:
+        if cfg.has_moe:
             # aux is pre-weighted by MoELayer; average over layers
             loss = loss + aux / cfg.num_layers
         return loss, logits
@@ -358,7 +383,7 @@ def _lm_head_and_loss(params, cfg: TransformerConfig, x, batch, aux):
         B = ids.shape[0]
         labels = jnp.concatenate([ids[:, 1:], jnp.full((B, 1), -100, dtype=ids.dtype)], axis=1)
     loss = cross_entropy_loss(logits, labels, batch.get("attention_mask"))
-    if cfg.num_experts > 0:
+    if cfg.has_moe:
         loss = loss + aux / cfg.num_layers
     return loss, logits
 

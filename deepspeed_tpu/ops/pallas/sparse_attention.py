@@ -1,0 +1,189 @@
+"""Block-sparse attention Pallas kernel: dead (qblk, kblk) tiles are SKIPPED.
+
+Reference analog: ``deepspeed/ops/sparse_attention/matmul.py:196`` — the
+Triton sdd/dsd block-skipping matmuls that make BigBird/Longformer layouts a
+real compute/memory win rather than a mask.
+
+Design: the (static numpy) block layout compiles into per-(head, qblock)
+active-column lists. The grid's last axis runs only to ``max_active`` columns
+(not n_blocks), the column index rides scalar prefetch into the K/V BlockSpec
+index maps, and rows with fewer active columns guard the tail — so both the
+DMA and the MXU work scale with ``layout.sum()`` instead of ``n^2``. Online
+softmax accumulates across a row's active tiles exactly as in the dense flash
+kernel.
+
+Backward: a custom VJP recomputes through the XLA dense-masked path (forward
+memory win is preserved; the backward pays O(S^2) scores — the two sparse
+backward kernels are the follow-up, same layout-list contract transposed).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+_LANES = 8
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def layout_to_lists(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """[H, n, n] 0/1 -> (cols [H, n, A], ncols [H, n]); padded entries repeat
+    the row's last active column (their compute is guarded off, and a valid
+    index keeps the prefetched DMA in range)."""
+    H, n, _ = layout.shape
+    ncols = layout.sum(-1).astype(np.int32)
+    A = max(1, int(ncols.max()))
+    cols = np.zeros((H, n, A), np.int32)
+    for h in range(H):
+        for i in range(n):
+            act = np.nonzero(layout[h, i])[0]
+            if act.size:
+                cols[h, i, :act.size] = act
+                cols[h, i, act.size:] = act[-1]
+    return cols, ncols
+
+
+def _sparse_fwd_kernel(cols_ref, ncols_ref, q_ref, k_ref, v_ref, o_ref,
+                       acc_ref, m_ref, l_ref, *, block, causal):
+    h = pl.program_id(1)
+    qi = pl.program_id(2)
+    j = pl.program_id(3)
+    A = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    kj = cols_ref[h, qi, j]
+    live = j < ncols_ref[h, qi]
+    if causal:
+        live = live & (kj <= qi)
+
+    def _compute():
+        q = q_ref[0, 0]  # [block, D] pre-scaled
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            # only the diagonal tile needs the iota mask
+            rows = qi * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            colS = kj * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where((kj != qi) | (colS <= rows), s, _NEG_INF)
+
+        m_prev = jnp.max(m_ref[:], axis=-1, keepdims=True)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.where(m_cur == _NEG_INF, 0.0, m_cur)
+        p = jnp.exp(s - m_safe)
+        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
+        l_prev = jnp.max(l_ref[:], axis=-1, keepdims=True)
+        l_ref[:] = jnp.broadcast_to(alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    pl.when(live)(_compute)
+
+    @pl.when(j == A - 1)
+    def _finalize():
+        l = jnp.max(l_ref[:], axis=-1, keepdims=True)
+        o_ref[0, 0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _sparse_fwd(q, k, v, cols, ncols, block, causal):
+    """q/k/v: [B, H, S, D] (q pre-scaled). Returns [B, H, S, D]."""
+    B, H, S, D = q.shape
+    n = S // block
+    A = cols.shape[-1]
+
+    out = pl.pallas_call(
+        functools.partial(_sparse_fwd_kernel, block=block, causal=causal),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # cols, ncols
+            grid=(B, H, n, A),
+            in_specs=[
+                pl.BlockSpec((1, 1, block, D), lambda b, h, qi, j, cols, ncols: (b, h, qi, 0)),
+                pl.BlockSpec((1, 1, block, D), lambda b, h, qi, j, cols, ncols: (b, h, cols[h, qi, j], 0)),
+                pl.BlockSpec((1, 1, block, D), lambda b, h, qi, j, cols, ncols: (b, h, cols[h, qi, j], 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block, D), lambda b, h, qi, j, cols, ncols: (b, h, qi, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((block, D), jnp.float32),
+                pltpu.VMEM((block, _LANES), jnp.float32),
+                pltpu.VMEM((block, _LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        ),
+        interpret=_interpret(),
+    )(cols, ncols, q, k, v)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _sparse_attention(q, k, v, layout_key, block, causal):
+    return _sparse_fwd_wrap(q, k, v, layout_key, block, causal)
+
+
+_LAYOUTS: dict = {}  # id -> (layout np, cols jnp, ncols jnp)
+
+
+def _register_layout(layout: np.ndarray):
+    key = (layout.shape, layout.tobytes())
+    if key not in _LAYOUTS:
+        cols, ncols = layout_to_lists(layout)
+        _LAYOUTS[key] = (layout, jnp.asarray(cols), jnp.asarray(ncols))
+    return key
+
+
+def _sparse_fwd_wrap(q, k, v, layout_key, block, causal):
+    _, cols, ncols = _LAYOUTS[layout_key]
+    scale = q.shape[-1] ** -0.5
+    qt = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)  # [B,H,S,D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _sparse_fwd(qt, kt, vt, cols, ncols, block, causal)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _sparse_vjp_fwd(q, k, v, layout_key, block, causal):
+    return _sparse_fwd_wrap(q, k, v, layout_key, block, causal), (q, k, v)
+
+
+def _sparse_vjp_bwd(layout_key, block, causal, res, g):
+    # recompute through the dense-masked XLA path: exact gradients, O(S^2)
+    # scores only in the backward (see module docstring)
+    from deepspeed_tpu.ops.sparse_attention import block_sparse_attention_dense
+
+    q, k, v = res
+    layout, _, _ = _LAYOUTS[layout_key]
+
+    def f(q, k, v):
+        return block_sparse_attention_dense(q, k, v, layout, block, causal)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv
+
+
+_sparse_attention.defvjp(_sparse_vjp_fwd, _sparse_vjp_bwd)
+
+
+def block_sparse_attention_pallas(q, k, v, layout: np.ndarray, block: int, causal: bool = True):
+    """Public entry: tile-skipping kernel forward + exact backward."""
+    key = _register_layout(np.asarray(layout))
+    return _sparse_attention(q, k, v, key, block, causal)

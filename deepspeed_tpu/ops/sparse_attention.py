@@ -132,7 +132,7 @@ def get_sparsity_config(name: str, num_heads: int, block: int = 16, **kw) -> Spa
 
 
 # ----------------------------------------------------------- compute path
-def block_sparse_attention(
+def block_sparse_attention_dense(
     q: jax.Array,  # [B, S, H, D]
     k: jax.Array,  # [B, S, H, D] (no GQA here; repeat kv first if needed)
     v: jax.Array,
@@ -140,12 +140,10 @@ def block_sparse_attention(
     block: int,
     causal: bool = True,
 ) -> jax.Array:
-    """Attention restricted to active blocks (reference SparseSelfAttention
-    forward = sdd matmul -> block softmax -> dsd matmul).
-
-    XLA path: flash-style accumulation over KEY blocks with the layout mask
-    folded in — masked (h, qblk, kblk) tiles contribute -inf scores. A Pallas
-    kernel skipping dead tiles is the drop-in upgrade (same layout contract).
+    """Dense-masked fallback + numerical baseline: materializes the full score
+    tensor and masks (reference SparseSelfAttention math without the
+    block-skipping). The Pallas kernel in ``ops/pallas/sparse_attention.py``
+    skips dead tiles and is the dispatched path.
     """
     B, S, H, D = q.shape
     n = S // block
@@ -167,3 +165,15 @@ def block_sparse_attention(
     probs = jnp.where(keep.any(-1, keepdims=True), probs, 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out.astype(q.dtype)
+
+
+def block_sparse_attention(q, k, v, layout, block: int, causal: bool = True,
+                           impl: str = "auto") -> jax.Array:
+    """Block-sparse attention. ``auto`` uses the tile-skipping Pallas kernel
+    (compute/DMA scale with ``layout.sum()``, reference matmul.py:196); 'xla'
+    forces the dense-masked baseline."""
+    if impl == "xla":
+        return block_sparse_attention_dense(q, k, v, layout, block, causal)
+    from deepspeed_tpu.ops.pallas.sparse_attention import block_sparse_attention_pallas
+
+    return block_sparse_attention_pallas(q, k, v, layout, block, causal)

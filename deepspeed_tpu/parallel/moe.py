@@ -203,6 +203,10 @@ class MoELayer(nn.Module):
     activation: str = "silu_glu"
     dtype: jnp.dtype = jnp.float32
     train: bool = False
+    # PR-MoE (reference moe/layer.py use_residual + the DeepSpeed-MoE paper's
+    # Pyramid-Residual design): a dense residual MLP acts as a shared expert,
+    # mixed with the routed output by a learned per-token coefficient.
+    use_residual: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -217,6 +221,15 @@ class MoELayer(nn.Module):
         )(expert_in)
         expert_out = _ep_constrain(expert_out, P("ep", None, None))
         out = jnp.einsum("tec,ecm->tm", combine.astype(self.dtype), expert_out)
+        if self.use_residual:
+            # residual expert: a dense FFN every token takes; the 2-way
+            # coefficient gate decides the routed/residual mix per token
+            res = Experts(1, M, self.hidden_dim, self.activation, self.dtype,
+                          name="residual_mlp")(tokens[None])[0]
+            coef = nn.Dense(2, use_bias=True, dtype=jnp.float32, name="coefficient")(
+                tokens.astype(jnp.float32))
+            c = jax.nn.softmax(coef, axis=-1).astype(self.dtype)
+            out = out * c[:, 0:1] + res * c[:, 1:2]
         # returned aux loss is already weighted — callers add it to their loss
         return self.config.aux_loss_weight * l_aux, out.reshape(B, S, M)
 

@@ -1,0 +1,112 @@
+"""Offline per-sample metric analysis feeding curriculum sampling.
+
+Reference analog: ``data_sampling/data_analyzer.py:22 DataAnalyzer`` /
+``:455 DistributedDataAnalyzer`` — map metric functions over a corpus,
+persist per-sample metric values + a value->samples index so the curriculum
+sampler can filter by difficulty without touching the data.
+
+Outputs per metric under ``save_path``:
+  ``<metric>_sample_to_metric.npy``  — value per sample (the 'difficulties'
+                                       array ``deepspeed_io`` consumes)
+  ``<metric>_metric_to_sample.npz``  — value -> sorted sample indices
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+
+def metric_seqlen(sample) -> int:
+    """Default metric: token count (reference 'seqlen')."""
+    return int(np.asarray(sample).reshape(-1).shape[0])
+
+
+class DataAnalyzer:
+    """Single-process analysis over an indexable dataset."""
+
+    def __init__(
+        self,
+        dataset,
+        metric_names: Sequence[str] = ("seqlen",),
+        metric_functions: Optional[Dict[str, Callable]] = None,
+        save_path: str = ".",
+        worker_id: int = 0,
+        num_workers: int = 1,
+    ):
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = {"seqlen": metric_seqlen, **(metric_functions or {})}
+        self.save_path = save_path
+        self.worker_id = worker_id
+        self.num_workers = num_workers
+        for m in self.metric_names:
+            if m not in self.metric_functions:
+                raise ValueError(f"no metric function for {m!r}")
+
+    def _my_range(self):
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        lo = self.worker_id * per
+        return lo, min(lo + per, n)
+
+    def run_map(self) -> Dict[str, np.ndarray]:
+        """Compute this worker's slice; returns {metric: values} and writes
+        the partial file ``<metric>_sample_to_metric.w<id>.npy``."""
+        lo, hi = self._my_range()
+        out = {}
+        for name in self.metric_names:
+            fn = self.metric_functions[name]
+            vals = np.asarray([fn(self.dataset[i]) for i in range(lo, hi)])
+            out[name] = vals
+            if self.num_workers > 1:
+                os.makedirs(self.save_path, exist_ok=True)
+                np.save(os.path.join(self.save_path, f"{name}_sample_to_metric.w{self.worker_id}.npy"), vals)
+        return out
+
+    def run_reduce(self, partials: Optional[Dict[str, Sequence[np.ndarray]]] = None) -> Dict[str, str]:
+        """Merge worker partials and write the final maps; returns file paths."""
+        os.makedirs(self.save_path, exist_ok=True)
+        paths = {}
+        for name in self.metric_names:
+            if partials and name in partials:
+                vals = np.concatenate(list(partials[name]))
+            elif self.num_workers > 1:
+                vals = np.concatenate([
+                    np.load(os.path.join(self.save_path, f"{name}_sample_to_metric.w{w}.npy"))
+                    for w in range(self.num_workers)
+                ])
+            else:
+                vals = self.run_map()[name]
+            s2m = os.path.join(self.save_path, f"{name}_sample_to_metric.npy")
+            np.save(s2m, vals)
+            uniq = {}
+            for v in np.unique(vals):
+                # full repr, not int-truncated: float metrics must not collide
+                uniq[str(v)] = np.nonzero(vals == v)[0]
+            np.savez(os.path.join(self.save_path, f"{name}_metric_to_sample.npz"), **uniq)
+            paths[name] = s2m
+        return paths
+
+    def run(self) -> Dict[str, str]:
+        return self.run_reduce({m: [v] for m, v in self.run_map().items()})
+
+
+class DistributedDataAnalyzer(DataAnalyzer):
+    """Multi-worker flavor (reference :455): each worker calls ``run_map``
+    over its contiguous shard; worker 0 then calls ``run_reduce``. On TPU
+    pods the workers are host processes — the map phase is embarrassingly
+    parallel file I/O, so no collective is needed."""
+
+    def run(self) -> Dict[str, str]:
+        self.run_map()
+        if self.worker_id == 0:
+            return self.run_reduce()
+        return {}
+
+
+def load_difficulties(save_path: str, metric: str = "seqlen") -> np.ndarray:
+    """The array ``deepspeed_io``'s curriculum sampler consumes."""
+    return np.load(os.path.join(save_path, f"{metric}_sample_to_metric.npy"))

@@ -1,0 +1,128 @@
+"""Memory-mapped indexed dataset + builder.
+
+Reference analog: ``deepspeed/runtime/data_pipeline/data_sampling/
+indexed_dataset.py:369 MMapIndexedDataset`` (the Megatron-style .bin/.idx
+pair) — random access into a token corpus without loading it, which is what
+lets curriculum/data-efficiency sampling run at pretraining scale.
+
+Format (own, versioned): ``<path>.idx`` holds a fixed header (magic, version,
+dtype code, sample count) followed by int64 byte offsets and int32 sample
+lengths; ``<path>.bin`` is the raw concatenated sample data. Reads are
+zero-copy numpy views over one mmap.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {
+    1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+    5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16, 9: np.uint32,
+}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streams samples to ``<prefix>.bin`` and finalizes ``<prefix>.idx``."""
+
+    def __init__(self, prefix: str, dtype=np.int32):
+        self._prefix = prefix
+        self._dtype = np.dtype(dtype)
+        if self._dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        parent = os.path.dirname(os.path.abspath(prefix))
+        os.makedirs(parent, exist_ok=True)
+        self._data = open(data_file_path(prefix), "wb")
+        self._sizes: list = []
+
+    def add_item(self, tokens: Sequence) -> None:
+        arr = np.asarray(tokens, dtype=self._dtype)
+        self._data.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def add_documents(self, docs: Iterable[Sequence]) -> None:
+        for d in docs:
+            self.add_item(d)
+
+    def merge_file(self, other_prefix: str) -> None:
+        """Append another builder's output (reference merge_file_ — the
+        distributed corpus-shard merge)."""
+        other = MMapIndexedDataset(other_prefix)
+        for i in range(len(other)):
+            self.add_item(other[i])
+
+    def finalize(self) -> None:
+        self._data.close()
+        sizes = np.asarray(self._sizes, np.int32)
+        itemsize = self._dtype.itemsize
+        pointers = np.zeros(len(sizes), np.int64)
+        np.cumsum(sizes[:-1].astype(np.int64) * itemsize, out=pointers[1:])
+        with open(index_file_path(self._prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<HHq", _VERSION, _DTYPE_CODES[self._dtype], len(sizes)))
+            f.write(pointers.tobytes())
+            f.write(sizes.tobytes())
+
+
+class MMapIndexedDataset:
+    """Zero-copy random access over a finalized .bin/.idx pair."""
+
+    def __init__(self, prefix: str):
+        self._prefix = prefix
+        with open(index_file_path(prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{index_file_path(prefix)}: bad magic {magic!r}")
+            version, dcode, count = struct.unpack("<HHq", f.read(12))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            self._dtype = np.dtype(_DTYPES[dcode])
+            self._pointers = np.frombuffer(f.read(count * 8), np.int64)
+            self._sizes = np.frombuffer(f.read(count * 4), np.int32)
+        self._bin = np.memmap(data_file_path(prefix), dtype=np.uint8, mode="r")
+
+    def __len__(self) -> int:
+        return len(self._sizes)
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return self._sizes
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(len(self)))]
+        ptr = int(self._pointers[idx])
+        n = int(self._sizes[idx])
+        return np.frombuffer(self._bin, dtype=self._dtype, count=n, offset=ptr)
+
+    def get(self, idx: int, offset: int = 0, length: Optional[int] = None) -> np.ndarray:
+        sample = self[idx]
+        end = len(sample) if length is None else offset + length
+        return sample[offset:end]
+
+    @property
+    def supports_prefetch(self) -> bool:
+        return False  # the OS page cache is the prefetcher
+
+    @staticmethod
+    def exists(prefix: str) -> bool:
+        return os.path.exists(index_file_path(prefix)) and os.path.exists(data_file_path(prefix))

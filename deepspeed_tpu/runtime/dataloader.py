@@ -48,6 +48,7 @@ class DeepSpeedTPUDataLoader:
         seed: int = 0,
         drop_last: bool = True,
         collate_fn=None,
+        sampler=None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -55,6 +56,9 @@ class DeepSpeedTPUDataLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.collate_fn = collate_fn
+        # external index-batch sampler (e.g. the curriculum
+        # DeepSpeedDataSampler — reference data_sampling/data_sampler.py:36)
+        self.sampler = sampler
         self.epoch = 0
         self._arrays = self._as_arrays(dataset)
         n = self._length()
@@ -82,24 +86,30 @@ class DeepSpeedTPUDataLoader:
     def __len__(self) -> int:
         return self.num_batches
 
+    def _materialize(self, idx) -> Any:
+        if self._arrays is not None:
+            if isinstance(self._arrays, dict):
+                return {k: v[idx] for k, v in self._arrays.items()}
+            return self._arrays[idx]
+        samples = [self.dataset[int(i)] for i in idx]
+        if self.collate_fn is not None:
+            return self.collate_fn(samples)
+        if isinstance(samples[0], dict):
+            return {k: np.stack([s[k] for s in samples]) for k in samples[0]}
+        return np.stack(samples)
+
     def __iter__(self) -> Iterator[Any]:
+        if self.sampler is not None:
+            self.sampler.set_epoch(self.epoch)
+            for idx in self.sampler:
+                yield self._materialize(np.asarray(idx))
+            self.epoch += 1
+            return
         n = self._length()
         order = np.arange(n)
         if self.shuffle:
             order = np.random.default_rng(self.seed + self.epoch).permutation(n)
         for b in range(self.num_batches):
             idx = order[b * self.batch_size : (b + 1) * self.batch_size]
-            if self._arrays is not None:
-                if isinstance(self._arrays, dict):
-                    yield {k: v[idx] for k, v in self._arrays.items()}
-                else:
-                    yield self._arrays[idx]
-            else:
-                samples = [self.dataset[int(i)] for i in idx]
-                if self.collate_fn is not None:
-                    yield self.collate_fn(samples)
-                elif isinstance(samples[0], dict):
-                    yield {k: np.stack([s[k] for s in samples]) for k in samples[0]}
-                else:
-                    yield np.stack(samples)
+            yield self._materialize(idx)
         self.epoch += 1

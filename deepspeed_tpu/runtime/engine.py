@@ -247,18 +247,31 @@ class DeepSpeedTPUEngine:
         """
         base = build_mesh(config.mesh_config)
         m = config.zero_config.mics_shard_size
+        hpz = config.zero_config.zero_hpz_partition_size
+        if m and m > 0 and hpz > 1:
+            raise ValueError("mics_shard_size and zero_hpz_partition_size are mutually exclusive")
+        if (m is None or m <= 0) and hpz > 1:
+            # hpZ re-factors the mesh the same way (fsdp -> intra-node group);
+            # the placement difference (masters stay sharded over the FULL
+            # data world) is applied in _init_state.
+            m = hpz
         if m is None or m <= 0:
             return base
         if config.zero_config.stage < 3:
-            raise ValueError("mics_shard_size requires ZeRO stage 3 (sharded parameters)")
+            raise ValueError(
+                "mics_shard_size / zero_hpz_partition_size require ZeRO stage 3 (sharded parameters)"
+            )
         F = base.shape["fsdp"]
         if F == m:
             return base
-        if F % m:
-            raise ValueError(f"mics_shard_size={m} must divide the fsdp axis size {F}")
+        world = F * base.shape["dp"]  # the sub-group draws from the data world
+        if world % m:
+            raise ValueError(
+                f"shard-group size {m} must divide the data world {world} (dp x fsdp)"
+            )
         sizes = dict(base.shape)
         sizes["fsdp"] = m
-        sizes["dp"] = sizes["dp"] * (F // m)
+        sizes["dp"] = world // m
         if config.zero_config.mics_hierarchical_params_gather:
             log_dist(
                 "mics_hierarchical_params_gather: the intra-group allgather is "
@@ -308,7 +321,19 @@ class DeepSpeedTPUEngine:
         self._base_shardings = jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s), base_specs
         )
-        if self.zero_config.stage >= 3:
+        self._hpz_compute_sharding = None
+        if self.zero_config.stage >= 3 and self.zero_config.zero_hpz_partition_size > 1:
+            # ZeRO++ hpZ (zero/config.py:294, utils/groups.py:650): masters
+            # keep the FULL data-world partition (dp x fsdp jointly — maximal
+            # ZeRO-3 memory win); compute params constrain to a SECONDARY
+            # partition over the (re-meshed, ICI-local) fsdp axis only. One
+            # cross-group gather materializes the secondary copy per step;
+            # every per-layer allgather then rides the intra-node axis.
+            self.param_sharding = zero_mod.master_sharding(param_shapes, mesh, self.zero_config, base_specs)
+            self._hpz_compute_sharding = zero_mod.params_sharding(
+                param_shapes, mesh, self.zero_config, base_specs
+            )
+        elif self.zero_config.stage >= 3:
             # Stage 3: master params use the fsdp param placement so compute
             # params inherit it without an extra reshard.
             self.param_sharding = zero_mod.params_sharding(param_shapes, mesh, self.zero_config, base_specs)
@@ -495,6 +520,10 @@ class DeepSpeedTPUEngine:
             # parallel (tp) placements are preserved; only data-axis shards
             # gather.
             compute = jax.lax.with_sharding_constraint(compute, self._base_shardings)
+        elif self._hpz_compute_sharding is not None:
+            # hpZ secondary partition: one gather across the dp groups here;
+            # per-layer gathers downstream ride only the intra-node fsdp axis
+            compute = jax.lax.with_sharding_constraint(compute, self._hpz_compute_sharding)
         return compute
 
     def _zpp_config(self):
@@ -502,14 +531,13 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.topology.mesh import BATCH_AXES
 
         zc = self.zero_config
-        if zc.zero_hpz_partition_size > 1:
-            raise NotImplementedError(
-                "zero_hpz_partition_size > 1 (hpZ secondary partition) is not "
-                "implemented: on TPU the hierarchical hop is expressed by "
-                "splitting the fsdp axis into (ici, dcn) sub-axes in the mesh; "
-                "use a mesh with that split instead of the hpZ knob"
-            )
         qw, qg = zc.zero_quantized_weights, zc.zero_quantized_gradients
+        if zc.zero_hpz_partition_size > 1 and (qw or qg):
+            raise NotImplementedError(
+                "hpZ (zero_hpz_partition_size) + quantized collectives "
+                "(qwZ/qgZ) are not composed yet: the quantized gather path "
+                "bypasses the secondary-partition constraint; enable one"
+            )
         if not (qw or qg):
             return None
         if qg and zc.stage < 2:
@@ -1248,12 +1276,56 @@ class DeepSpeedTPUEngine:
 
     # ------------------------------------------------------------------ I/O
     def deepspeed_io(self, dataset, batch_size: Optional[int] = None) -> Any:
+        """Build the training dataloader (reference ``deepspeed_io``
+        engine.py:1854). Consults the ``data_efficiency`` config: an enabled
+        curriculum (``data_sampling.curriculum_learning``) installs the
+        difficulty-filtered ``DeepSpeedDataSampler``."""
         from deepspeed_tpu.runtime.dataloader import DeepSpeedTPUDataLoader
 
+        bs = batch_size or self.config.train_micro_batch_size_per_gpu * get_data_parallel_world_size(self.mesh)
+        sampler = self._build_data_efficiency_sampler(dataset, bs)
+        if sampler is not None and isinstance(dataset, dict) and "difficulties" in dataset:
+            dataset = {k: v for k, v in dataset.items() if k != "difficulties"}
         return DeepSpeedTPUDataLoader(
             dataset,
-            batch_size=batch_size or self.config.train_micro_batch_size_per_gpu * get_data_parallel_world_size(self.mesh),
+            batch_size=bs,
             seed=self.config.model.seed,
+            sampler=sampler,
+        )
+
+    def _build_data_efficiency_sampler(self, dataset, batch_size: int):
+        de = self.config.model.data_efficiency
+        if not de.enabled:
+            return None
+        ds_cfg = de.data_sampling or {}
+        cl = ds_cfg.get("curriculum_learning", {})
+        if not ds_cfg.get("enabled", True) or not cl.get("enabled", False):
+            return None
+        from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+        from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+
+        sched = CurriculumScheduler(cl)
+        difficulties = getattr(dataset, "difficulties", None)
+        if difficulties is None and isinstance(dataset, dict):
+            difficulties = dataset.get("difficulties")
+        if difficulties is None and sched.metric == "seqlen" and isinstance(dataset, dict) \
+                and "input_ids" in dataset:
+            # seqlen metric default: per-sample non-pad length (the reference
+            # precomputes this into an index map, data_analyzer.py)
+            ids = np.asarray(dataset["input_ids"])
+            mask = dataset.get("attention_mask")
+            difficulties = (np.asarray(mask).sum(-1) if mask is not None
+                            else np.full(len(ids), ids.shape[-1]))
+        if difficulties is None:
+            raise ValueError(
+                "curriculum_learning needs per-sample difficulties: provide "
+                "dataset.difficulties / a 'difficulties' column, or use the "
+                "'seqlen' metric with an input_ids column"
+            )
+        n = len(np.asarray(difficulties))
+        return DeepSpeedDataSampler(
+            n, batch_size, difficulties=np.asarray(difficulties),
+            curriculum=sched, seed=de.seed,
         )
 
     @functools.cached_property

@@ -1,0 +1,126 @@
+"""Debugging access to sharded training state by parameter path.
+
+Reference analog: ``deepspeed/utils/tensor_fragment.py:132-199`` —
+``safe_get_full_fp32_param`` / ``safe_get_full_optimizer_state`` /
+``safe_get_full_grad`` and the ``safe_set_*`` writers, which reassemble a
+ZeRO-partitioned tensor for inspection and scatter edits back to the shards.
+
+On TPU the partitions are shardings, so "gather the fragments" is
+``jax.device_get`` (XLA assembles the global array) and "scatter back" is
+``jax.device_put`` with the leaf's sharding. Parameters are addressed by
+pytree path — ``"embed/embedding"`` or ``("embed", "embedding")`` — instead
+of a module attribute, because the engine state is a pytree, not a module
+graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+PathLike = Union[str, Sequence[str]]
+
+# reference state names (torch Adam) -> optax ScaleByAdamState fields
+_OPT_STATE_ALIASES = {"exp_avg": "mu", "exp_avg_sq": "nu", "mu": "mu", "nu": "nu"}
+
+
+def _path_parts(path: PathLike):
+    if isinstance(path, str):
+        return [p for p in path.replace(".", "/").split("/") if p]
+    return list(path)
+
+
+def _get_leaf(tree: Any, path: PathLike):
+    node = tree
+    for part in _path_parts(path):
+        if isinstance(node, dict):
+            if part not in node:
+                raise KeyError(f"no parameter {'/'.join(_path_parts(path))!r}: "
+                               f"{part!r} not in {sorted(node)[:10]}")
+            node = node[part]
+        else:
+            node = getattr(node, part)
+    return node
+
+
+def _set_leaf(tree: Any, path: PathLike, value):
+    parts = _path_parts(path)
+    node = tree
+    for part in parts[:-1]:
+        node = node[part]
+    node[parts[-1]] = value
+
+
+def _replace_in_params(engine, path: PathLike, value) -> None:
+    params = jax.tree_util.tree_map(lambda x: x, engine.state.params)  # shallow rebuild
+    old = _get_leaf(params, path)
+    new = jax.device_put(np.asarray(value, dtype=old.dtype).reshape(old.shape), old.sharding)
+    _set_leaf(params, path, new)
+    engine.state = engine.state._replace(params=params)
+
+
+# ------------------------------------------------------------------ params
+def safe_get_full_fp32_param(engine, path: PathLike) -> np.ndarray:
+    """Gathered fp32 master parameter (reference :132)."""
+    return np.asarray(jax.device_get(_get_leaf(engine.state.params, path)))
+
+
+def safe_set_full_fp32_param(engine, path: PathLike, value) -> None:
+    """Write a full tensor back into the (sharded) master (reference :180)."""
+    _replace_in_params(engine, path, value)
+
+
+# --------------------------------------------------------------- opt state
+def _find_moment_tree(opt_state, field: str):
+    """First optax sub-state carrying ``field`` (mu/nu for Adam-family)."""
+    for s in jax.tree_util.tree_leaves(
+            opt_state, is_leaf=lambda x: hasattr(x, field)):
+        if hasattr(s, field):
+            return getattr(s, field)
+    return None
+
+
+def safe_get_full_optimizer_state(engine, path: PathLike, state_name: str) -> Optional[np.ndarray]:
+    """Gathered optimizer moment for a parameter (reference :141)."""
+    field = _OPT_STATE_ALIASES.get(state_name)
+    if field is None:
+        raise ValueError(f"unknown optimizer state {state_name!r} (use exp_avg/exp_avg_sq)")
+    tree = _find_moment_tree(engine.state.opt_state, field)
+    if tree is None:
+        return None
+    return np.asarray(jax.device_get(_get_leaf(tree, path)))
+
+
+def safe_set_full_optimizer_state(engine, path: PathLike, state_name: str, value) -> None:
+    """Write a full optimizer moment back to its shards (reference :190)."""
+    field = _OPT_STATE_ALIASES.get(state_name)
+    if field is None:
+        raise ValueError(f"unknown optimizer state {state_name!r}")
+
+    def rebuild(node):
+        if hasattr(node, field):
+            tree = jax.tree_util.tree_map(lambda x: x, getattr(node, field))
+            old = _get_leaf(tree, path)
+            new = jax.device_put(np.asarray(value, old.dtype).reshape(old.shape), old.sharding)
+            _set_leaf(tree, path, new)
+            return node._replace(**{field: tree})
+        return node
+
+    opt_state = jax.tree_util.tree_map(
+        rebuild, engine.state.opt_state, is_leaf=lambda x: hasattr(x, field)
+    )
+    engine.state = engine.state._replace(opt_state=opt_state)
+
+
+# ------------------------------------------------------------------- grads
+def safe_get_full_grad(engine, path: PathLike) -> Optional[np.ndarray]:
+    """Gathered gradient (reference :152). Only populated between
+    ``backward()`` and ``step()`` on the fwd/bwd/step parity path — the fused
+    ``train_batch`` consumes gradients inside one compiled program and never
+    materializes them for the host (by design; that is the perf contract)."""
+    pending = getattr(engine, "_pending_grads", None)
+    if pending is None:
+        return None
+    return np.asarray(jax.device_get(_get_leaf(pending, path)))

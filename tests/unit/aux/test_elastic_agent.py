@@ -1,0 +1,92 @@
+"""Elastic agent restart semantics (reference elasticity/elastic_agent.py:32).
+
+Workers are real subprocesses; a scripted failure on one host must kill the
+generation, drop the host, re-resolve the batch triad for the smaller world,
+and relaunch.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+from deepspeed_tpu.elasticity.elasticity import ElasticityError
+
+ECFG = {
+    "enabled": True,
+    "max_train_batch_size": 48,
+    "micro_batch_sizes": [1, 2, 4],
+    "min_gpus": 1,
+    "max_gpus": 64,
+}
+
+
+def _proc(code: int) -> subprocess.Popen:
+    return subprocess.Popen([sys.executable, "-c", f"import sys; sys.exit({code})"])
+
+
+def test_agent_restarts_without_failed_host():
+    launches = []
+
+    def launch(hosts, gen, cfg):
+        launches.append((gen, sorted(hosts), dict(cfg)))
+        # generation 0: worker on host 'b' fails; generation 1: all succeed
+        return {h: _proc(1 if (gen == 0 and h == "b") else 0) for h in hosts}
+
+    agent = DSElasticAgent({"a": 4, "b": 4}, ECFG, launch, max_restarts=2,
+                           poll_interval_s=0.05)
+    result = agent.run()
+    assert result.ok and result.generation == 1
+    assert launches[0][1] == ["a", "b"] and launches[1][1] == ["a"]
+    # batch triad re-resolved for the smaller world
+    w0 = launches[0][2]
+    w1 = launches[1][2]
+    assert w0["train_batch_size"] % 8 == 0
+    assert w1["train_batch_size"] % 4 == 0
+    assert len(agent.history) == 2 and not agent.history[0].ok
+
+
+def test_agent_gives_up_after_budget():
+    def launch(hosts, gen, cfg):
+        return {h: _proc(1) for h in hosts}  # everything always fails
+
+    agent = DSElasticAgent({"a": 2, "b": 2, "c": 2, "d": 2}, ECFG, launch,
+                           max_restarts=2, poll_interval_s=0.05)
+    result = agent.run()
+    assert not result.ok
+    assert len(agent.history) <= 3
+
+
+def test_agent_rejects_incompatible_world():
+    # micro batches {4}: a 3-chip world can never divide the batch
+    cfg = {**ECFG, "micro_batch_sizes": [4], "max_train_batch_size": 8}
+    agent = DSElasticAgent({"a": 3}, cfg, lambda *a: {}, poll_interval_s=0.05)
+    with pytest.raises(ElasticityError):
+        agent.run()
+
+
+def test_agent_keeps_terminated_survivors():
+    """Long-lived survivors killed BY the agent are not 'failed': they must
+    be relaunched in the next generation (regression: one crash used to
+    disqualify every host)."""
+    launches = []
+
+    def launch(hosts, gen, cfg):
+        launches.append(sorted(hosts))
+        procs = {}
+        for h in hosts:
+            if gen == 0 and h == "b":
+                procs[h] = _proc(1)  # crashes immediately
+            elif gen == 0:
+                # healthy long-lived worker: only exits when terminated
+                procs[h] = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+            else:
+                procs[h] = _proc(0)
+        return procs
+
+    agent = DSElasticAgent({"a": 4, "b": 4, "c": 4}, ECFG, launch,
+                           max_restarts=2, poll_interval_s=0.05)
+    result = agent.run()
+    assert result.ok and result.generation == 1
+    assert launches[1] == ["a", "c"], launches  # only the crasher was dropped

@@ -149,3 +149,38 @@ def test_get_checkpoint_engine_selection():
     eng.shutdown()
     with pytest.raises(ValueError):
         get_checkpoint_engine("bogus")
+
+
+def test_universal_checkpoint_moe_expert_params(tmp_path, devices):
+    """MoE-specific checkpoint handling (reference engine.py:3375 expert
+    checkpoint special-casing): ep-sharded expert params round-trip through a
+    universal checkpoint into a DIFFERENT ep layout."""
+    import deepspeed_tpu
+    from deepspeed_tpu.checkpoint.universal import load_universal, save_universal
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                            num_layers=2, num_heads=2, max_seq_len=16,
+                            num_experts=4, moe_top_k=1)
+
+    def make(mesh):
+        e, *_ = deepspeed_tpu.initialize(
+            model=causal_lm_spec(cfg, example_seq_len=8),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                    "mesh": mesh, "steps_per_print": 1000})
+        return e
+
+    e1 = make({"dp": 2, "ep": 4})
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 64, (8, 8), dtype=np.int32)}
+    e1.train_batch(batch)
+    save_universal(e1, str(tmp_path))
+
+    e2 = make({"dp": 4, "ep": 2})  # different expert-parallel degree
+    load_universal(e2, str(tmp_path))
+    np.testing.assert_allclose(
+        np.asarray(e2.state.params["layers"]["moe"]["experts"]["w_up"]),
+        np.asarray(e1.state.params["layers"]["moe"]["experts"]["w_up"]), rtol=1e-6)
+    l2 = float(e2.train_batch(batch)["loss"])
+    l1 = float(e1.train_batch(batch)["loss"])
+    np.testing.assert_allclose(l2, l1, rtol=1e-4)

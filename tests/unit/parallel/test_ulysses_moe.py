@@ -155,3 +155,62 @@ class TestMoE:
                 {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
                 jnp.zeros((1, 4, 8)),
             )
+
+
+# ----------------------------------------------------------------- PR-MoE
+
+def test_pr_moe_residual_trains(devices):
+    """PR-MoE residual expert + coefficient gate (reference moe/layer.py
+    use_residual): trains, and the residual params exist."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                            num_layers=2, num_heads=2, max_seq_len=16,
+                            num_experts=4, moe_top_k=1, moe_use_residual=True)
+    e, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=8),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "mesh": {"dp": 4, "ep": 2}, "steps_per_print": 1000})
+    p = e.state.params["layers"]["moe"]
+    assert "residual_mlp" in p and "coefficient" in p
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 64, (8, 8), dtype=np.int32)}
+    losses = [float(e.train_batch(batch)["loss"]) for _ in range(5)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+def test_pyramid_moe_per_layer_experts(devices):
+    """Pyramid expert counts per layer (dense -> 2 -> 4), scan disabled."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+    import pytest as _pytest
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                            num_layers=3, num_heads=2, max_seq_len=16,
+                            moe_layer_experts=(0, 2, 4), scan_layers=False)
+    e, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=8),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "mesh": {"dp": 4, "ep": 2}, "steps_per_print": 1000})
+    p = e.state.params
+    assert "mlp" in p["layer_0"] and "moe" not in p["layer_0"]
+    assert p["layer_1"]["moe"]["experts"]["w_up"].shape[0] == 2
+    assert p["layer_2"]["moe"]["experts"]["w_up"].shape[0] == 4
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 64, (8, 8), dtype=np.int32)}
+    losses = [float(e.train_batch(batch)["loss"]) for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+    # pyramid + scan is rejected with a clear error
+    bad = TransformerConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                            num_layers=3, num_heads=2, max_seq_len=16,
+                            moe_layer_experts=(0, 2, 4), scan_layers=True)
+    with _pytest.raises(ValueError, match="scan_layers=False"):
+        deepspeed_tpu.initialize(
+            model=causal_lm_spec(bad, example_seq_len=8),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                    "steps_per_print": 1000})

@@ -85,10 +85,13 @@ def test_zpp_comm_bytes_reduced():
     assert total_a2a < 2 * n_params, (total_a2a, n_params)
 
 
-def test_hpz_knob_is_honest():
-    with pytest.raises(NotImplementedError):
+def test_hpz_with_quantized_collectives_raises():
+    """hpZ itself is implemented (tests/unit/runtime/test_hpz.py); the
+    unimplemented COMPOSITION with qwZ/qgZ must still fail loudly."""
+    with pytest.raises(NotImplementedError, match="hpZ"):
         deepspeed_tpu.initialize(
-            model=_model(), config=_cfg(stage=3, zero_hpz_partition_size=2)
+            model=_model(),
+            config=_cfg(stage=3, zero_hpz_partition_size=2, zero_quantized_weights=True),
         )
 
 

