@@ -86,7 +86,9 @@ def bench_train_llama_z3(peak_flops):
     """Largest-fitting Llama-style config: ZeRO-3 placement + remat + fused CE.
 
     Single chip, so ZeRO-3 is placement-only (fsdp=1) — this measures the
-    dense-model step the Llama-3-8B multi-chip config is built from."""
+    dense-model step the Llama-3-8B multi-chip config is built from. Sizing:
+    ~550M params keeps master+Adam fp32 states (12 bytes/param) + grads +
+    bf16 compute + remat activations inside 16G HBM."""
     import jax
     import numpy as np
 
@@ -94,11 +96,11 @@ def bench_train_llama_z3(peak_flops):
     from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
 
     cfg = TransformerConfig(
-        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-        num_layers=22, num_heads=16, num_kv_heads=8, max_seq_len=2048,
-        norm="rmsnorm", activation="silu_glu", position="rope",
+        vocab_size=32000, hidden_size=1536, intermediate_size=6144,
+        num_layers=14, num_heads=16, num_kv_heads=8, head_dim=96,
+        max_seq_len=2048, norm="rmsnorm", activation="silu_glu", position="rope",
         remat=True, dtype=jax.numpy.bfloat16,
-    )  # ~1.1B params (TinyLlama geometry)
+    )
     seq = 2048
     engine, *_ = deepspeed_tpu.initialize(
         model=causal_lm_spec(cfg, example_seq_len=seq),
@@ -216,7 +218,7 @@ def main() -> None:
     extras = {}
     if on_tpu:
         for name, fn in (
-            ("llama_1b_zero3_remat", lambda: bench_train_llama_z3(peak_flops)),
+            ("llama_550m_zero3_remat", lambda: bench_train_llama_z3(peak_flops)),
             ("mixtral_style_moe", lambda: bench_train_moe(peak_flops)),
             ("inference_v1_gpt2_125m", bench_inference),
         ):

@@ -83,20 +83,31 @@ class DSElasticAgent:
         live = dict(procs)
         codes: Dict[str, int] = {}
         agent_killed: set = set()
-        while live:
+
+        def sweep():
             for host, p in list(live.items()):
                 rc = p.poll()
-                if rc is None:
-                    continue
-                codes[host] = rc
-                del live[host]
-                if rc != 0 and host not in agent_killed:
-                    for other_host, other in live.items():
-                        try:
-                            other.terminate()
-                            agent_killed.add(other_host)
-                        except Exception:
-                            pass
+                if rc is not None:
+                    codes[host] = rc
+                    del live[host]
+
+        cascaded = False
+        while live:
+            sweep()
+            if not cascaded and any(
+                rc != 0 for h, rc in codes.items() if h not in agent_killed
+            ):
+                # one grace poll so SIMULTANEOUS crashers surface as genuine
+                # failures before the cascade marks survivors agent-killed
+                time.sleep(self.poll_interval_s)
+                sweep()
+                for other_host, other in live.items():
+                    try:
+                        other.terminate()
+                        agent_killed.add(other_host)
+                    except Exception:
+                        pass
+                cascaded = True
             time.sleep(self.poll_interval_s)
         for host, p in procs.items():
             codes.setdefault(host, p.returncode if p.returncode is not None else -1)

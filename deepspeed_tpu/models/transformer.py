@@ -70,6 +70,13 @@ class TransformerConfig:
     moe_use_residual: bool = False
     moe_layer_experts: Optional[Tuple[int, ...]] = None
 
+    def __post_init__(self):
+        if self.moe_layer_experts is not None and len(self.moe_layer_experts) != self.num_layers:
+            raise ValueError(
+                f"moe_layer_experts has {len(self.moe_layer_experts)} entries "
+                f"for num_layers={self.num_layers} — one expert count per layer"
+            )
+
     def experts_for_layer(self, i: int) -> int:
         if self.moe_layer_experts is not None:
             return self.moe_layer_experts[i]

@@ -139,14 +139,21 @@ def _sparse_attention(q, k, v, layout_key, block, causal):
     return _sparse_fwd_wrap(q, k, v, layout_key, block, causal)
 
 
-_LAYOUTS: dict = {}  # id -> (layout np, cols jnp, ncols jnp)
+# LRU-bounded layout registry: each entry pins host + device arrays, and
+# callers may regenerate layouts (random BigBird blocks, varying seq lens)
+_LAYOUTS: "dict" = {}  # insertion-ordered; oldest evicted past the cap
+_LAYOUT_CAP = 32
 
 
 def _register_layout(layout: np.ndarray):
     key = (layout.shape, layout.tobytes())
-    if key not in _LAYOUTS:
+    if key in _LAYOUTS:
+        _LAYOUTS[key] = _LAYOUTS.pop(key)  # refresh LRU position
+    else:
         cols, ncols = layout_to_lists(layout)
         _LAYOUTS[key] = (layout, jnp.asarray(cols), jnp.asarray(ncols))
+        while len(_LAYOUTS) > _LAYOUT_CAP:
+            _LAYOUTS.pop(next(iter(_LAYOUTS)))
     return key
 
 

@@ -169,9 +169,12 @@ def block_sparse_attention_dense(
 
 def block_sparse_attention(q, k, v, layout, block: int, causal: bool = True,
                            impl: str = "auto") -> jax.Array:
-    """Block-sparse attention. ``auto`` uses the tile-skipping Pallas kernel
-    (compute/DMA scale with ``layout.sum()``, reference matmul.py:196); 'xla'
-    forces the dense-masked baseline."""
+    """Block-sparse attention. On TPU, ``auto`` uses the tile-skipping Pallas
+    kernel (compute/DMA scale with ``layout.sum()``, reference matmul.py:196);
+    off-TPU it falls back to the dense-masked XLA path (the kernel would only
+    run under the slow Pallas interpreter there). 'pallas'/'xla' force."""
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "xla":
         return block_sparse_attention_dense(q, k, v, layout, block, causal)
     from deepspeed_tpu.ops.pallas.sparse_attention import block_sparse_attention_pallas

@@ -70,6 +70,7 @@ class DataAnalyzer:
         """Merge worker partials and write the final maps; returns file paths."""
         os.makedirs(self.save_path, exist_ok=True)
         paths = {}
+        local = None  # single-worker fallback: ONE pass computes every metric
         for name in self.metric_names:
             if partials and name in partials:
                 vals = np.concatenate(list(partials[name]))
@@ -79,7 +80,9 @@ class DataAnalyzer:
                     for w in range(self.num_workers)
                 ])
             else:
-                vals = self.run_map()[name]
+                if local is None:
+                    local = self.run_map()
+                vals = local[name]
             s2m = os.path.join(self.save_path, f"{name}_sample_to_metric.npy")
             np.save(s2m, vals)
             uniq = {}

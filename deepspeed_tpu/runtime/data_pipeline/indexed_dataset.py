@@ -63,6 +63,11 @@ class MMapIndexedDatasetBuilder:
         """Append another builder's output (reference merge_file_ — the
         distributed corpus-shard merge)."""
         other = MMapIndexedDataset(other_prefix)
+        if other.dtype != self._dtype:
+            raise ValueError(
+                f"cannot merge {other_prefix!r} (dtype {other.dtype}) into a "
+                f"{self._dtype} builder — values would be silently cast"
+            )
         for i in range(len(other)):
             self.add_item(other[i])
 

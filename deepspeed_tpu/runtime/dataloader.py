@@ -84,6 +84,9 @@ class DeepSpeedTPUDataLoader:
         self.epoch = epoch
 
     def __len__(self) -> int:
+        if self.sampler is not None and hasattr(self.sampler, "__len__"):
+            # the curriculum sampler may serve fewer batches early on
+            return len(self.sampler)
         return self.num_batches
 
     def _materialize(self, idx) -> Any:

@@ -130,3 +130,16 @@ def test_host_api_single_process():
     assert dist.get_rank() == 0
     dist.barrier()  # no-op single process
     assert dist.init_distributed() is False  # single-process => not multi
+
+
+def test_collective_bench_rows(devices):
+    """ds_bench analog: sweeps run on the CPU mesh and busbw factors hold."""
+    from deepspeed_tpu.comm.benchmark import run_collective_bench
+
+    for op in ("all_reduce", "all_gather", "reduce_scatter", "all_to_all"):
+        rows = run_collective_bench(op, sizes_mb=[0.05], axis="dp", iters=2, warmup=1)
+        (row,) = rows
+        assert row["world"] == 8 and row["latency_ms"] > 0
+        factor = row["busbw_gbps"] / max(row["algbw_gbps"], 1e-9)
+        want = 2 * 7 / 8 if op == "all_reduce" else 7 / 8
+        assert abs(factor - want) < 0.05, (op, factor)  # rows are rounded to 3dp

@@ -30,7 +30,7 @@ def test_pallas_sparse_matches_dense_masked(name, kw):
     cfg = get_sparsity_config(name, num_heads=2, block=8, **kw)
     lay = cfg.make_layout(64)
     want = block_sparse_attention_dense(q, k, v, lay, block=8)
-    got = block_sparse_attention(q, k, v, lay, block=8)
+    got = block_sparse_attention(q, k, v, lay, block=8, impl="pallas")
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
@@ -54,7 +54,7 @@ def test_pallas_sparse_gradients_match_dense():
     lay = cfg.make_layout(32)
 
     def loss_p(q, k, v):
-        return (block_sparse_attention(q, k, v, lay, block=8) ** 2).sum()
+        return (block_sparse_attention(q, k, v, lay, block=8, impl="pallas") ** 2).sum()
 
     def loss_d(q, k, v):
         return (block_sparse_attention_dense(q, k, v, lay, block=8) ** 2).sum()

@@ -26,6 +26,8 @@ def main():
         num_layers=12, num_heads=12, max_seq_len=1024,
         norm="layernorm", activation="gelu", position="learned",
         tie_embeddings=True, dtype=jnp.bfloat16,
+        scan_layers="--unroll" not in sys.argv,
+        fused_ce="--nofuse" not in sys.argv,
     )
     seq = 1024
     engine, *_ = deepspeed_tpu.initialize(
