@@ -135,7 +135,7 @@ def bench_train_moe(peak_flops):
         vocab_size=32000, hidden_size=1024, intermediate_size=2816,
         num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=1024,
         norm="rmsnorm", activation="silu_glu", position="rope",
-        num_experts=8, moe_top_k=2, dtype=jax.numpy.bfloat16,
+        num_experts=8, moe_top_k=2, remat=True, dtype=jax.numpy.bfloat16,
     )
     seq = 1024
     engine, *_ = deepspeed_tpu.initialize(
@@ -151,9 +151,10 @@ def bench_train_moe(peak_flops):
     rng = np.random.default_rng(0)
     batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
     tok_per_sec = _train_tokens_per_sec(engine, batch, steps=5, warmup=2)
-    # active-params flops: top-2 of 8 experts => dense flops with 2/8 of MLP
     return {
         "tokens_per_sec_per_chip": round(tok_per_sec, 1),
+        # flops_per_token uses ACTIVE params (top-2 of 8 experts) for MoE
+        "mfu_active": round(tok_per_sec * cfg.flops_per_token(seq) / peak_flops, 4),
         "total_params_m": round(cfg.num_params() / 1e6),
     }
 

@@ -97,21 +97,47 @@ class TransformerConfig:
         return self.head_dim or self.hidden_size // self.num_heads
 
     def flops_per_token(self, seq_len: int) -> float:
-        """Approximate training FLOPs/token (fwd+bwd, 6ND + attention)."""
-        n = self.num_params()
+        """Approximate training FLOPs/token (fwd+bwd, 6ND + attention).
+
+        For MoE configs N is the ACTIVE parameter count (top-k experts)."""
+        n = self.num_active_params()
         attn = 12 * self.num_layers * self.hidden_size * seq_len  # score+value matmuls
         return 6 * n + attn
+
+    def _mlp_params(self) -> int:
+        """One MLP's (one expert's) parameter count."""
+        proj = 3 if self.activation == "silu_glu" else 2
+        return proj * self.hidden_size * self.intermediate_size
 
     def num_params(self) -> int:
         h, v, l = self.hidden_size, self.vocab_size, self.num_layers
         hd = self.dims_per_head
         qkv = h * hd * (self.num_heads + 2 * self.kv_heads) + hd * self.num_heads * h
-        if self.activation == "silu_glu":
-            mlp = 3 * h * self.intermediate_size
-        else:
-            mlp = 2 * h * self.intermediate_size
-        emb = v * h * (1 if self.tie_embeddings else 2)
-        return l * (qkv + mlp + 2 * h) + emb + h
+        mlp = self._mlp_params()
+        total = v * h * (1 if self.tie_embeddings else 2)  # embedding (+ head)
+        total += h  # final norm
+        for i in range(l):
+            n_exp = self.experts_for_layer(i)
+            if n_exp > 0:
+                layer_mlp = n_exp * mlp + h * n_exp  # experts + router
+                if self.moe_use_residual:
+                    layer_mlp += mlp + 2 * h + 2  # residual MLP + coefficient gate
+            else:
+                layer_mlp = mlp
+            total += qkv + layer_mlp + 2 * h
+        return total
+
+    def num_active_params(self) -> int:
+        """Params a single token touches (top-k experts instead of all)."""
+        if not self.has_moe:
+            return self.num_params()
+        mlp = self._mlp_params()
+        dead = 0
+        for i in range(self.num_layers):
+            n_exp = self.experts_for_layer(i)
+            if n_exp > 0:
+                dead += (n_exp - min(self.moe_top_k, n_exp)) * mlp
+        return self.num_params() - dead
 
 
 # ---------------------------------------------------------------- presets

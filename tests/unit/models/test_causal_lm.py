@@ -105,6 +105,24 @@ def test_presets_exist():
     assert 1.0e8 < PRESETS["gpt2-125m"].num_params() < 2.0e8
 
 
+@pytest.mark.parametrize("n_exp,top_k,residual", [(0, 2, False), (4, 2, False), (4, 1, True)])
+def test_num_params_matches_init(devices, n_exp, top_k, residual):
+    """Analytic num_params == actual initialized leaf count (dense/MoE/PR-MoE)."""
+    import jax
+
+    cfg = TransformerConfig(**{
+        **TINY.__dict__, "num_experts": n_exp, "moe_top_k": top_k,
+        "moe_use_residual": residual,
+    })
+    engine, *_ = deepspeed_tpu.initialize(model=causal_lm_spec(cfg), config=_cfg())
+    actual = sum(x.size for x in jax.tree.leaves(engine.state.params))
+    assert actual == cfg.num_params()
+    if n_exp:
+        assert cfg.num_active_params() < cfg.num_params()
+    else:
+        assert cfg.num_active_params() == cfg.num_params()
+
+
 def test_padding_mask(devices):
     engine, *_ = deepspeed_tpu.initialize(model=causal_lm_spec(TINY), config=_cfg())
     batch = _tokens(engine.train_batch_size, 16)
