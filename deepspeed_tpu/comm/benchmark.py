@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deepspeed_tpu.comm.comm import CommsLogger
 from deepspeed_tpu.topology.mesh import build_mesh
 
 OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all")
@@ -37,13 +38,8 @@ def _collective_fn(op: str, axis: str):
     raise ValueError(f"unknown op {op!r} (one of {OPS})")
 
 
-def _busbw_factor(op: str, n: int) -> float:
-    """Algorithmic bandwidth factor: bytes moved per byte of payload per rank."""
-    if n <= 1:
-        return 0.0
-    if op == "all_reduce":
-        return 2 * (n - 1) / n
-    return (n - 1) / n  # gather/scatter/a2a
+# Algorithmic bus-bandwidth factors are shared with the in-band comm telemetry.
+_busbw_factor = CommsLogger._bus_factor
 
 
 def run_collective_bench(
@@ -74,7 +70,8 @@ def run_collective_bench(
                           out_specs=P() if op == "all_reduce" else P(axis),
                           check_vma=False)
         )
-        for _ in range(warmup):
+        r = f(x)  # compile + first run (counts as warmup)
+        for _ in range(max(warmup - 1, 0)):
             r = f(x)
         np.asarray(jax.tree_util.tree_leaves(r)[0].ravel()[0])
         t0 = time.perf_counter()

@@ -139,26 +139,37 @@ def _sparse_attention(q, k, v, layout_key, block, causal):
     return _sparse_fwd_wrap(q, k, v, layout_key, block, causal)
 
 
-# LRU-bounded layout registry: each entry pins host + device arrays, and
-# callers may regenerate layouts (random BigBird blocks, varying seq lens)
+# LRU-bounded layout cache: entries pin host + device arrays, and callers may
+# regenerate layouts (random BigBird blocks, varying seq lens). The key is
+# SELF-DESCRIBING (shape, dtype, raw bytes), so eviction is always safe: a
+# pending custom-VJP backward that looks up an evicted key just rebuilds the
+# arrays from the key itself.
 _LAYOUTS: "dict" = {}  # insertion-ordered; oldest evicted past the cap
 _LAYOUT_CAP = 32
 
 
 def _register_layout(layout: np.ndarray):
-    key = (layout.shape, layout.tobytes())
+    key = (layout.shape, layout.dtype.str, layout.tobytes())
+    _layout_arrays(key)
+    return key
+
+
+def _layout_arrays(key):
+    """(layout, cols, ncols) for a registry key, rebuilding after eviction."""
     if key in _LAYOUTS:
         _LAYOUTS[key] = _LAYOUTS.pop(key)  # refresh LRU position
     else:
+        shape, dtype, raw = key
+        layout = np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape)
         cols, ncols = layout_to_lists(layout)
         _LAYOUTS[key] = (layout, jnp.asarray(cols), jnp.asarray(ncols))
         while len(_LAYOUTS) > _LAYOUT_CAP:
             _LAYOUTS.pop(next(iter(_LAYOUTS)))
-    return key
+    return _LAYOUTS[key]
 
 
 def _sparse_fwd_wrap(q, k, v, layout_key, block, causal):
-    _, cols, ncols = _LAYOUTS[layout_key]
+    _, cols, ncols = _layout_arrays(layout_key)
     scale = q.shape[-1] ** -0.5
     qt = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)  # [B,H,S,D]
     kt = k.transpose(0, 2, 1, 3)
@@ -177,7 +188,7 @@ def _sparse_vjp_bwd(layout_key, block, causal, res, g):
     from deepspeed_tpu.ops.sparse_attention import block_sparse_attention_dense
 
     q, k, v = res
-    layout, _, _ = _LAYOUTS[layout_key]
+    layout, _, _ = _layout_arrays(layout_key)
 
     def f(q, k, v):
         return block_sparse_attention_dense(q, k, v, layout, block, causal)

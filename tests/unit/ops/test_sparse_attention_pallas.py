@@ -63,3 +63,29 @@ def test_pallas_sparse_gradients_match_dense():
     gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gp, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+
+
+def test_layout_cache_eviction_safe_under_grad():
+    """Backward after >_LAYOUT_CAP registrations must not KeyError: keys are
+    self-describing, so evicted entries rebuild from the key."""
+    from deepspeed_tpu.ops.pallas import sparse_attention as sa
+
+    q, k, v = _qkv(S=16, H=1)
+
+    def loss(q, k, v, lay):
+        return (block_sparse_attention(q, k, v, lay, block=8, impl="pallas") ** 2).sum()
+
+    # register one layout under grad, then churn the cache past the cap with
+    # unique layouts (i encoded in the spare sub-diagonal bit pattern)
+    lay0 = np.ones((1, 2, 2), dtype=np.int64)
+    f = jax.vjp(lambda q: loss(q, k, v, lay0), q)[1]
+    qq, kk, vv = _qkv(S=64, H=1)
+    for i in range(sa._LAYOUT_CAP + 4):
+        lay = np.eye(8, dtype=np.int64)[None]
+        for b in range(6):
+            lay[0, b + 2, b] = (i >> b) & 1
+        block_sparse_attention(qq, kk, vv, lay, block=8, impl="pallas")
+    key0 = (lay0.shape, lay0.dtype.str, lay0.tobytes())
+    assert key0 not in sa._LAYOUTS  # really evicted
+    (dq,) = f(jnp.ones(()))  # backward still works
+    assert np.isfinite(np.asarray(dq)).all()

@@ -18,8 +18,9 @@ from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
 
 
 def main():
-    micro = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    gas = int(sys.argv[2]) if len(sys.argv) > 2 and not sys.argv[2].startswith("-") else 1
+    positional = [a for a in sys.argv[1:] if not a.startswith("-")]
+    micro = int(positional[0]) if positional else 8
+    gas = int(positional[1]) if len(positional) > 1 else 1
     trace = "--trace" in sys.argv
     cfg = TransformerConfig(
         vocab_size=50304, hidden_size=768, intermediate_size=3072,
