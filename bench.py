@@ -49,13 +49,18 @@ def bench_train_gpt2(on_tpu, peak_flops):
     from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
 
     if on_tpu:
+        # scan_layers=False: the per-layer scan's activation stacking costs
+        # ~25% of wall-clock at this depth (PERF.md round 3); fused_ce=False:
+        # the chunked-vocab CE is a memory lever, not a speed lever — the XLA
+        # logits path is faster whenever the fp32 logits fit.
         cfg = TransformerConfig(
             vocab_size=50304, hidden_size=768, intermediate_size=3072,
             num_layers=12, num_heads=12, max_seq_len=1024,
             norm="layernorm", activation="gelu", position="learned",
             tie_embeddings=True, dtype=jax.numpy.bfloat16,
+            scan_layers=False, fused_ce=False,
         )
-        micro, seq, steps, warmup, gas = 8, 1024, 10, 3, 4
+        micro, seq, steps, warmup, gas = 4, 1024, 10, 3, 8
     else:
         cfg = TransformerConfig(
             vocab_size=512, hidden_size=128, intermediate_size=256,
@@ -83,12 +88,14 @@ def bench_train_gpt2(on_tpu, peak_flops):
 
 
 def bench_train_llama_z3(peak_flops):
-    """Largest-fitting Llama-style config: ZeRO-3 placement + remat + fused CE.
+    """Largest-fitting Llama-style config: ZeRO-3 placement + remat.
 
     Single chip, so ZeRO-3 is placement-only (fsdp=1) — this measures the
     dense-model step the Llama-3-8B multi-chip config is built from. Sizing:
     ~550M params keeps master+Adam fp32 states (12 bytes/param) + grads +
-    bf16 compute + remat activations inside 16G HBM."""
+    bf16 compute + remat activations + fp32 logits ([4,2048,32000] = 1 GiB;
+    the XLA CE path is faster than the chunked fused CE whenever the logits
+    fit — PERF.md round 3) inside 16G HBM."""
     import jax
     import numpy as np
 
@@ -99,7 +106,7 @@ def bench_train_llama_z3(peak_flops):
         vocab_size=32000, hidden_size=1536, intermediate_size=6144,
         num_layers=14, num_heads=16, num_kv_heads=8, head_dim=96,
         max_seq_len=2048, norm="rmsnorm", activation="silu_glu", position="rope",
-        remat=True, dtype=jax.numpy.bfloat16,
+        remat=True, dtype=jax.numpy.bfloat16, scan_layers=False, fused_ce=False,
     )
     seq = 2048
     engine, *_ = deepspeed_tpu.initialize(
@@ -136,6 +143,7 @@ def bench_train_moe(peak_flops):
         num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=1024,
         norm="rmsnorm", activation="silu_glu", position="rope",
         num_experts=8, moe_top_k=2, remat=True, dtype=jax.numpy.bfloat16,
+        scan_layers=False, fused_ce=False,
     )
     seq = 1024
     engine, *_ = deepspeed_tpu.initialize(

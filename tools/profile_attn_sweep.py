@@ -1,0 +1,62 @@
+"""Sweep flash-attention kernel block sizes inside ONE jitted program.
+
+A lax.scan chains ITER kernel invocations with a data dependency (the output
+feeds the next query), so per-program relay dispatch (~6 ms) amortizes away
+and the measured time is the kernel itself.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.pallas.flash_attention import flash_causal_attention
+
+
+def bench(fn, *args, iters=20):
+    @jax.jit
+    def chained(q, k, v):
+        def body(q, _):
+            o = fn(q, k, v)
+            return (o * jnp.asarray(1e-3, o.dtype) + q * jnp.asarray(0.999, q.dtype)), ()
+
+        out, _ = jax.lax.scan(body, q, None, length=iters)
+        return out
+
+    r = chained(*args)
+    _ = np.asarray(r[0, 0, 0, 0])  # warm compile + sync
+    t0 = time.perf_counter()
+    r = chained(*args)
+    _ = np.asarray(r[0, 0, 0, 0])
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    B, S, H, D = 4, 1024, 12, 64
+    if len(sys.argv) > 2:
+        B, S = int(sys.argv[1]), int(sys.argv[2])
+    elif len(sys.argv) > 1:
+        B = int(sys.argv[1])
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D), jnp.bfloat16)
+    fl = 4 * B * H * S * S * D  # dense fwd flops; causal useful ~ (1+nblk)/(2 nblk)
+
+    for bq, bk in ((256, 256), (256, 512), (512, 256), (512, 512), (512, 1024),
+                   (1024, 512), (1024, 1024)):
+        if bq > S or bk > S:
+            continue
+        fn = lambda q, k, v: flash_causal_attention(q, k, v, block_q=bq, block_k=bk)
+        try:
+            t = bench(fn, q, k, v)
+        except Exception as e:  # noqa: BLE001 - sweep keeps going past bad configs
+            print(f"bq={bq} bk={bk}: FAIL {type(e).__name__}")
+            continue
+        print(f"bq={bq:5d} bk={bk:5d}: {t*1e3:7.3f} ms  dense-rate {fl/t/1e12:6.1f} TF/s")
+
+
+if __name__ == "__main__":
+    main()

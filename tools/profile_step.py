@@ -29,6 +29,7 @@ def main():
         tie_embeddings=True, dtype=jnp.bfloat16,
         scan_layers="--unroll" not in sys.argv,
         fused_ce="--nofuse" not in sys.argv,
+        attn_impl="xla" if "--xlaattn" in sys.argv else "auto",
     )
     seq = 1024
     engine, *_ = deepspeed_tpu.initialize(
