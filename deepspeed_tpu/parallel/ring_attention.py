@@ -91,6 +91,8 @@ def ring_attention(
     Hkv = k.shape[2]
     if S % P_ring:
         raise ValueError(f"seq {S} not divisible by ring size {P_ring}")
+    if causal and S % (2 * P_ring) == 0:
+        return _ring_zigzag(q, k, v, mesh, axis, P_ring)
     G = H // Hkv
     S_loc = S // P_ring
 
@@ -119,7 +121,23 @@ def ring_attention(
             vb = jax.lax.ppermute(vb, axis, perm)
             # after `hop` rotations we hold the block born on device idx - hop
             src = (idx - hop) % P_ring
-            m, l, o = _block_attend(qg, kb, vb, m, l, o, q_start, src * S_loc, causal)
+            if causal:
+                # blocks born strictly later than this device's queries are
+                # fully masked — skip their matmuls. NOTE: the ring is
+                # synchronous, so this saves energy/occupancy, not wall-clock
+                # (devices that do attend set each hop's critical path); the
+                # balanced answer is the zigzag placement (_ring_zigzag),
+                # which handles every 2P-divisible causal case — this path
+                # only runs for odd-shaped fallbacks.
+                m, l, o = jax.lax.cond(
+                    src <= idx,
+                    lambda m, l, o, kb, vb: _block_attend(
+                        qg, kb, vb, m, l, o, q_start, src * S_loc, causal),
+                    lambda m, l, o, kb, vb: (m, l, o),
+                    m, l, o, kb, vb,
+                )
+            else:
+                m, l, o = _block_attend(qg, kb, vb, m, l, o, q_start, src * S_loc, causal)
             return (kb, vb, m, l, o), None
 
         (kb, vb, m, l, o), _ = jax.lax.scan(
@@ -137,4 +155,133 @@ def ring_attention(
         in_specs=(spec_q, spec_q, spec_q),
         out_specs=spec_q,
     )
+    return fn(q, k, v)
+
+
+def _ring_zigzag(q, k, v, mesh, axis: str, P_ring: int):
+    """Causal ring attention with zigzag (striped) block placement.
+
+    Contiguous placement under causality is pathologically imbalanced: device
+    0's queries see one K/V block while device P-1's see all P — and the ring
+    is synchronous, so every hop runs at the slowest device's pace. Splitting
+    the sequence into 2P blocks and giving device i blocks (i, 2P-1-i) makes
+    every device's visible work per hop identical (cf. Striped Attention,
+    arXiv:2311.09431): per hop exactly one of the (early-half, incoming-early)
+    / (late-half, incoming-late) pairs is live, plus the always-visible
+    (late-half, incoming-early) pair.
+
+    The zigzag redistribution happens INSIDE the shard_map as half-block
+    ``ppermute``s (O(S/P) comm, ~one extra ring hop each way) — a global
+    gather on the sp-sharded axis would lower to full-S all-gathers. The
+    live-pair choice is made by SELECTING the pair's inputs/accumulators with
+    the ring-position predicate (``lax.cond`` with a device-varying predicate
+    under scan+shard_map+grad aborts the XLA CPU runtime).
+    """
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    Sb = S // (2 * P_ring)
+
+    def z(b):  # zigzag owner of global block b
+        return b if b < P_ring else 2 * P_ring - 1 - b
+
+    # contiguous device d holds blocks (2d, 2d+1); both maps are bijections
+    perm0 = [(d, z(2 * d)) for d in range(P_ring)]
+    perm1 = [(d, z(2 * d + 1)) for d in range(P_ring)]
+    inv0 = [(z(2 * d), d) for d in range(P_ring)]
+    inv1 = [(z(2 * d + 1), d) for d in range(P_ring)]
+
+    def to_zigzag(x, idx):
+        """[B, 2Sb(contiguous), ...] -> (early block idx, late block 2P-1-idx)."""
+        got0 = jax.lax.ppermute(x[:, :Sb], axis, perm0)  # the even block of the pair
+        got1 = jax.lax.ppermute(x[:, Sb:], axis, perm1)  # the odd block
+        even_is_early = (idx % 2 == 0)  # pair {i, 2P-1-i}: i is the even one iff i even
+        early = jnp.where(even_is_early, got0, got1)
+        late = jnp.where(even_is_early, got1, got0)
+        return jnp.concatenate([early, late], axis=1)
+
+    def from_zigzag(x, idx):
+        even_is_early = (idx % 2 == 0)
+        send0 = jnp.where(even_is_early, x[:, :Sb], x[:, Sb:])  # the even block
+        send1 = jnp.where(even_is_early, x[:, Sb:], x[:, :Sb])
+        slot0 = jax.lax.ppermute(send0, axis, inv0)
+        slot1 = jax.lax.ppermute(send1, axis, inv1)
+        return jnp.concatenate([slot0, slot1], axis=1)
+
+    def local(qb, kb, vb):
+        B_loc = qb.shape[0]
+        idx = jax.lax.axis_index(axis)
+        qb = to_zigzag(qb, idx)
+        kb = to_zigzag(kb, idx)
+        vb = to_zigzag(vb, idx)
+        qg = (qb.reshape(B_loc, 2 * Sb, Hkv, G, D).astype(jnp.float32)) * (D ** -0.5)
+        qa, qz = qg[:, :Sb], qg[:, Sb:]  # early block i, late block 2P-1-i
+        a_start = idx * Sb
+        z_start = (2 * P_ring - 1 - idx) * Sb
+
+        def fresh(qh):
+            o = jnp.zeros_like(qh)
+            m = o[..., 0].transpose(0, 2, 3, 1) + _NEG_INF  # [B, Hkv, G, Sb]
+            return m, o[..., 0].transpose(0, 2, 3, 1), o
+
+        ma, la, oa = fresh(qa)
+        mz, lz, oz = fresh(qz)
+
+        # hop 0: resident halves. (a,a) and (z,z) are diagonal; (z,a) is
+        # fully visible (late rows always see early keys); (a,z) fully masked.
+        kc, kd = kb[:, :Sb], kb[:, Sb:]
+        vc, vd = vb[:, :Sb], vb[:, Sb:]
+        ma, la, oa = _block_attend(qa, kc, vc, ma, la, oa, a_start, a_start, True)
+        mz, lz, oz = _block_attend(qz, kd, vd, mz, lz, oz, z_start, z_start, True)
+        mz, lz, oz = _block_attend(qz, kc, vc, mz, lz, oz, z_start, a_start, False)
+
+        ring = [(i, (i + 1) % P_ring) for i in range(P_ring)]
+
+        def body(carry, hop):
+            kb, vb, ma, la, oa, mz, lz, oz = carry
+            kb = jax.lax.ppermute(kb, axis, ring)
+            vb = jax.lax.ppermute(vb, axis, ring)
+            src = (idx - hop) % P_ring
+            kc, kd = kb[:, :Sb], kb[:, Sb:]
+            vc, vd = vb[:, :Sb], vb[:, Sb:]
+
+            # late half vs incoming early block: always fully visible
+            mz, lz, oz = _block_attend(qz, kc, vc, mz, lz, oz, z_start, src * Sb, False)
+
+            # exactly one of (early-half, incoming-early) / (late-half,
+            # incoming-late) is visible, decided by ring position — select the
+            # live pair's inputs and accumulators (all same-shaped), attend
+            # once, and scatter the result back into the live accumulator
+            pred = idx > src
+            q_sel = jnp.where(pred, qa, qz)
+            k_sel = jnp.where(pred, kc, kd)
+            v_sel = jnp.where(pred, vc, vd)
+            m_sel = jnp.where(pred, ma, mz)
+            l_sel = jnp.where(pred, la, lz)
+            o_sel = jnp.where(pred, oa, oz)
+            m2, l2, o2 = _block_attend(q_sel, k_sel, v_sel, m_sel, l_sel, o_sel, 0, 0, False)
+            ma = jnp.where(pred, m2, ma)
+            la = jnp.where(pred, l2, la)
+            oa = jnp.where(pred, o2, oa)
+            mz = jnp.where(pred, mz, m2)
+            lz = jnp.where(pred, lz, l2)
+            oz = jnp.where(pred, oz, o2)
+            return (kb, vb, ma, la, oa, mz, lz, oz), None
+
+        (kb, vb, ma, la, oa, mz, lz, oz), _ = jax.lax.scan(
+            body, (kb, vb, ma, la, oa, mz, lz, oz), jnp.arange(1, P_ring)
+        )
+
+        def norm(o, l):
+            return o / jnp.maximum(l.transpose(0, 3, 1, 2)[..., None], 1e-30)
+
+        out = jnp.concatenate([norm(oa, la), norm(oz, lz)], axis=1)
+        out = out.reshape(B_loc, 2 * Sb, H, D).astype(q.dtype)
+        return from_zigzag(out, idx)
+
+    from deepspeed_tpu.parallel.ulysses import _live_batch_axes
+
+    batch_axes = _live_batch_axes(mesh)
+    spec = P(batch_axes, axis, None, None)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

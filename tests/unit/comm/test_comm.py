@@ -140,6 +140,7 @@ def test_collective_bench_rows(devices):
         rows = run_collective_bench(op, sizes_mb=[0.05], axis="dp", iters=2, warmup=1)
         (row,) = rows
         assert row["world"] == 8 and row["latency_ms"] > 0
-        factor = row["busbw_gbps"] / max(row["algbw_gbps"], 1e-9)
         want = 2 * 7 / 8 if op == "all_reduce" else 7 / 8
-        assert abs(factor - want) < 0.05, (op, factor)  # rows are rounded to 3dp
+        # both gbps fields are rounded to 3dp, so compare within that grain
+        # (a loaded CI box can produce sub-0.01 gbps rows)
+        assert abs(row["busbw_gbps"] - row["algbw_gbps"] * want) <= 1.5e-3, (op, row)

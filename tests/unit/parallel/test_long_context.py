@@ -29,6 +29,24 @@ def test_ring_attention_matches_dense(devices):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
+def test_ring_attention_contiguous_fallback(devices):
+    """S divisible by P but not 2P routes through the contiguous (non-zigzag)
+    causal path with the fully-masked-hop skip — keep it covered."""
+    mesh = build_mesh(axis_sizes={"sp": 4, "dp": 2})
+    set_mesh(mesh)
+    q, k, v = make_qkv(S=36)
+    ref = causal_attention(q, k, v, impl="xla")
+    got = ring_attention(q, k, v, mesh=mesh, axis="sp")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    # grad through this path too (the masked-hop lax.cond under
+    # scan+shard_map+grad is exactly the composition that has aborted the
+    # XLA CPU runtime before — keep it pinned)
+    g = jax.jit(jax.grad(lambda q: ring_attention(q, k, v, mesh=mesh).sum()))(q)
+    ref_g = jax.grad(lambda q: causal_attention(q, k, v, impl="xla").sum())(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(ref_g), rtol=2e-4, atol=2e-4)
+
+
 def test_ring_attention_jits_in_train_context(devices):
     """ring_attention must compose under jit + grad (training usage)."""
     mesh = build_mesh(axis_sizes={"sp": 4, "dp": 2})
