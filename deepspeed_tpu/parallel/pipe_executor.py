@@ -1,0 +1,181 @@
+"""Instruction-stream executor for the pipeline schedules (simulation).
+
+Reference analog: ``runtime/pipe/engine.py:1396`` ``_exec_schedule`` — the
+instruction interpreter that walks a :class:`PipeSchedule`'s per-tick command
+lists and dispatches ``_exec_*`` handlers, with ``pipe/p2p.py`` blocking
+sends/recvs between stage ranks.
+
+On TPU the production path is the compiled SPMD pipeline
+(``pipeline_spmd.spmd_pipeline``): one XLA program, ppermute between stages.
+This executor interprets the SAME instruction streams single-process — every
+stage's generator advanced in lockstep, Send/Recv as queues, BackwardPass via
+``jax.vjp`` residuals — so schedules are executable and checkable:
+
+- parity: executing ``TrainSchedule`` must reproduce the unpipelined model's
+  loss and gradients exactly (pinned in tests against ``spmd_pipeline`` too);
+- buffer safety: a ``ForwardPass`` into a buffer whose previous microbatch
+  has not completed its ``BackwardPass`` raises — validating
+  ``num_pipe_buffers`` (the reference's in-flight memory contract);
+- deadlock detection: a ``Recv*`` whose peer never sent raises instead of
+  hanging the way real p2p would.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel.pipe_schedule import (
+    BackwardPass,
+    ForwardPass,
+    LoadMicroBatch,
+    OptimizerStep,
+    RecvActivation,
+    RecvGrad,
+    ReduceGrads,
+    ReduceTiedGrads,
+    SendActivation,
+    SendGrad,
+)
+
+
+class ScheduleExecutor:
+    """Execute a schedule class across all stages of a staged model.
+
+    Args:
+      stage_fns: one ``fn(params, x) -> y`` per stage.
+      stage_params: one params pytree per stage.
+      loss_fn: ``loss_fn(last_stage_output, microbatch_target) -> scalar``;
+        the per-microbatch losses are averaged (grad seeds are scaled by 1/M,
+        matching the reference's gas-style loss scaling).
+    """
+
+    def __init__(self, stage_fns: Sequence[Callable], stage_params: Sequence[Any],
+                 loss_fn: Callable):
+        assert len(stage_fns) == len(stage_params)
+        self.stage_fns = list(stage_fns)
+        self.stage_params = list(stage_params)
+        self.loss_fn = loss_fn
+        self.stages = len(stage_fns)
+
+    def run(self, schedule_cls, inputs: Sequence[Any], targets: Sequence[Any]):
+        """Interpret ``schedule_cls(M, S, stage_id)`` for every stage.
+
+        Returns ``(mean_loss, per_stage_param_grads)``.
+        """
+        S, M = self.stages, len(inputs)
+        scheds = [iter(schedule_cls(micro_batches=M, stages=S, stage_id=s).steps())
+                  for s in range(S)]
+        # p2p queues between neighbors; (kind, from_stage) -> FIFO of (mb, value)
+        act_q: List[deque] = [deque() for _ in range(S)]   # act_q[s]: s-1 -> s
+        grad_q: List[deque] = [deque() for _ in range(S)]  # grad_q[s]: s+1 -> s
+        # per-stage buffer slots: buffer_id -> microbatch occupying it
+        buffers: List[Dict[int, int]] = [dict() for _ in range(S)]
+        # saved forward state per (stage, microbatch)
+        vjps: Dict[Tuple[int, int], Any] = {}
+        # pending outbound value per (s, mb): the stage's forward OUTPUT —
+        # consumed by SendActivation; on the last stage it is replaced by the
+        # loss-gradient seed that BackwardPass consumes
+        outbox: Dict[Tuple[int, int], Any] = {}
+        out_grads: List[Any] = [jax.tree.map(jnp.zeros_like, p) for p in self.stage_params]
+        losses: List[Any] = []
+        fwd_count = [0] * S
+        bwd_count = [0] * S
+        optimizer_stepped = [False] * S
+
+        def fwd(s: int, mb: int, buf: int, x: Any):
+            prev = buffers[s].get(buf)
+            if prev is not None:
+                raise RuntimeError(
+                    f"stage {s}: ForwardPass(mb={mb}) into buffer {buf} still "
+                    f"holding microbatch {prev} (backward not yet run) — "
+                    f"schedule violates num_pipe_buffers")
+            buffers[s][buf] = mb
+            y, vjp = jax.vjp(self.stage_fns[s], self.stage_params[s], x)
+            vjps[(s, mb)] = vjp
+            fwd_count[s] += 1
+            return y
+
+        def bwd(s: int, mb: int, buf: int, gy: Any):
+            if buffers[s].get(buf) != mb:
+                raise RuntimeError(
+                    f"stage {s}: BackwardPass(mb={mb}) buffer {buf} holds "
+                    f"{buffers[s].get(buf)}")
+            del buffers[s][buf]
+            gparams, gx = vjps.pop((s, mb))(gy)
+            out_grads[s] = jax.tree.map(jnp.add, out_grads[s], gparams)
+            bwd_count[s] += 1
+            return gx
+
+        tick = 0
+        done = [False] * S
+        while not all(done):
+            tick += 1
+            if tick > 4 * (M + S) + 8:
+                raise RuntimeError("schedule did not terminate (deadlock?)")
+            for s in range(S):
+                if done[s]:
+                    continue
+                try:
+                    cmds = next(scheds[s])
+                except StopIteration:
+                    done[s] = True
+                    continue
+                # track the microbatch flowing through this tick's cmd list
+                cur_mb = None
+                cur_x = None
+                cur_g = None
+                for cmd in cmds:
+                    if isinstance(cmd, LoadMicroBatch):
+                        cur_mb = fwd_count[s]
+                        cur_x = inputs[cur_mb]
+                    elif isinstance(cmd, RecvActivation):
+                        if not act_q[s]:
+                            raise RuntimeError(
+                                f"stage {s} tick {tick}: RecvActivation on an "
+                                f"empty queue — peer never sent (deadlock)")
+                        cur_mb, cur_x = act_q[s].popleft()
+                    elif isinstance(cmd, ForwardPass):
+                        y = fwd(s, cur_mb, cmd.buffer_id, cur_x)
+                        outbox[(s, cur_mb)] = y
+                        if s == S - 1:
+                            # last stage: loss + immediate grad seed
+                            loss, loss_vjp = jax.vjp(
+                                lambda o: self.loss_fn(o, targets[cur_mb]), y)
+                            losses.append(loss)
+                            (seed,) = loss_vjp(jnp.ones_like(loss) / M)
+                            outbox[(s, cur_mb)] = seed
+                    elif isinstance(cmd, SendActivation):
+                        mb = buffers[s].get(cmd.buffer_id)
+                        act_q[s + 1].append((mb, outbox.pop((s, mb))))
+                    elif isinstance(cmd, RecvGrad):
+                        if not grad_q[s]:
+                            raise RuntimeError(
+                                f"stage {s} tick {tick}: RecvGrad on an empty "
+                                f"queue — peer never sent (deadlock)")
+                        cur_mb, cur_g = grad_q[s].popleft()
+                    elif isinstance(cmd, BackwardPass):
+                        if s == S - 1:
+                            cur_mb = buffers[s].get(cmd.buffer_id)
+                            cur_g = outbox.pop((s, cur_mb))
+                        gx = bwd(s, cur_mb, cmd.buffer_id, cur_g)
+                        cur_g = gx
+                    elif isinstance(cmd, SendGrad):
+                        grad_q[s - 1].append((cur_mb, cur_g))
+                    elif isinstance(cmd, (ReduceGrads, ReduceTiedGrads)):
+                        pass  # dp reduction — single-replica simulation
+                    elif isinstance(cmd, OptimizerStep):
+                        optimizer_stepped[s] = True
+                    else:
+                        raise RuntimeError(f"unknown instruction {cmd!r}")
+
+        if any(c != M for c in fwd_count) or any(c != M for c in bwd_count):
+            raise RuntimeError(
+                f"schedule incomplete: fwd {fwd_count} bwd {bwd_count} (want {M})")
+        if not all(optimizer_stepped):
+            raise RuntimeError("schedule never issued OptimizerStep on some stage")
+        mean_loss = jnp.mean(jnp.stack(losses))
+        return mean_loss, out_grads

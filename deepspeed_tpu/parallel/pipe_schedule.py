@@ -140,13 +140,18 @@ class TrainSchedule(PipeSchedule):
     """
 
     def steps(self):
-        total_steps = 2 * (self.micro_batches + self.stages - 1)
-        fwd_id, bwd_id = 0, 0
+        # 1F1B tick mapping (reference ``_step_to_micro_batch`` schedule.py:262):
+        # stage s runs FORWARD of microbatch m at tick s + 2m (activations
+        # arrive one tick after the upstream send) and BACKWARD of m at tick
+        # 2S - 1 + 2m - s (one tick after the downstream stage's backward).
+        # Forward ticks have parity s, backward ticks parity s+1 — never both.
+        S, M, s = self.stages, self.micro_batches, self.stage_id
+        total_steps = 2 * (M + S - 1)
         for step_id in range(total_steps):
             cmds: List[PipeInstruction] = []
-            is_fwd = self._is_forward_tick(step_id)
-            if is_fwd and fwd_id < self.micro_batches:
-                buf = fwd_id % self.num_pipe_buffers
+            fwd_mb, rem = divmod(step_id - s, 2)
+            if rem == 0 and 0 <= fwd_mb < M:
+                buf = fwd_mb % self.num_pipe_buffers
                 if self.is_first_stage:
                     cmds.append(LoadMicroBatch(buffer_id=buf))
                 else:
@@ -154,23 +159,16 @@ class TrainSchedule(PipeSchedule):
                 cmds.append(ForwardPass(buffer_id=buf))
                 if not self.is_last_stage:
                     cmds.append(SendActivation(buffer_id=buf))
-                fwd_id += 1
-            elif (not is_fwd) and bwd_id < fwd_id:
-                buf = bwd_id % self.num_pipe_buffers
+            bwd_mb, rem = divmod(step_id - (2 * S - 1 - s), 2)
+            if rem == 0 and 0 <= bwd_mb < M:
+                buf = bwd_mb % self.num_pipe_buffers
                 if not self.is_last_stage:
                     cmds.append(RecvGrad(buffer_id=buf))
                 cmds.append(BackwardPass(buffer_id=buf))
                 if not self.is_first_stage:
                     cmds.append(SendGrad(buffer_id=buf))
-                bwd_id += 1
             yield cmds
         yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
-
-    def _is_forward_tick(self, step_id: int) -> bool:
-        # Offset by stage depth so forwards/backwards interleave 1F1B-style
-        # (reference ``_step_to_micro_batch`` even/odd logic, schedule.py:262).
-        offset = self.stages - self.stage_id - 1
-        return ((step_id + offset) % 2) == 0
 
     @property
     def num_pipe_buffers(self) -> int:

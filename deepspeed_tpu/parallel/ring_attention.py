@@ -174,8 +174,12 @@ def _ring_zigzag(q, k, v, mesh, axis: str, P_ring: int):
     ``ppermute``s (O(S/P) comm, ~one extra ring hop each way) — a global
     gather on the sp-sharded axis would lower to full-S all-gathers. The
     live-pair choice is made by SELECTING the pair's inputs/accumulators with
-    the ring-position predicate (``lax.cond`` with a device-varying predicate
-    under scan+shard_map+grad aborts the XLA CPU runtime).
+    the ring-position predicate rather than ``lax.cond``: an earlier
+    cond-based zigzag intermittently hard-aborted the XLA CPU runtime under
+    scan+shard_map+grad, and selects cost the same here since both branches'
+    operands are resident. (The contiguous fallback still uses a cond, where
+    the false branch genuinely skips work; its grad path is pinned by
+    ``test_ring_attention_contiguous_fallback``.)
     """
     B, S, H, D = q.shape
     Hkv = k.shape[2]
