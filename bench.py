@@ -215,6 +215,47 @@ def bench_inference():
             "decode_tokens_per_sec": round(decode_tok_s, 1)}
 
 
+def bench_train_long_context(peak_flops):
+    """Long-sequence training on one chip: seq 8k, flash kernel + remat.
+
+    The BASELINE-tracked long-context config (8B @ 32k Ulysses) needs a pod;
+    this measures the single-chip building block it is made of — the causal
+    flash kernel's triangle grid at long S, where attention grows to ~half
+    the model flops."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    seq = 8192
+    cfg = TransformerConfig(
+        vocab_size=32000, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, max_seq_len=seq,
+        norm="rmsnorm", activation="silu_glu", position="rope",
+        remat=True, dtype=jax.numpy.bfloat16, scan_layers=False, fused_ce=False,
+    )
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+        },
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    tok_per_sec = _train_tokens_per_sec(engine, batch, steps=5, warmup=2)
+    return {
+        "seq_len": seq,
+        "tokens_per_sec_per_chip": round(tok_per_sec, 1),
+        "mfu": round(tok_per_sec * cfg.flops_per_token(seq) / peak_flops, 4),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -229,6 +270,7 @@ def main() -> None:
         for name, fn in (
             ("llama_550m_zero3_remat", lambda: bench_train_llama_z3(peak_flops)),
             ("mixtral_style_moe", lambda: bench_train_moe(peak_flops)),
+            ("long_context_8k", lambda: bench_train_long_context(peak_flops)),
             ("inference_v1_gpt2_125m", bench_inference),
         ):
             try:

@@ -42,6 +42,14 @@ from jax.experimental.pallas import tpu as pltpu
 from deepspeed_tpu.ops.registry import register
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
+# The kernels run the softmax in BASE 2: XLA/Mosaic lower exp(x) as
+# exp2(x * log2(e)), so folding log2(e) into the query pre-scale removes one
+# full [block_q, block_k] VPU multiply per exp site (fwd + both backwards).
+# The ln2 factor that base-2 softmax gradients pick up is applied exactly on
+# the wrapper side: dq's ln2*log2e cancels to 1, dk gets one fp32 multiply
+# (see _flash_vjp_bwd) — no extra in-kernel passes, no bf16 rounding bias.
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
 
 DEFAULT_BLOCK_Q = 512
 _LANES = 8  # lse/delta lane width in HBM (block last dim == array last dim satisfies Mosaic tiling); m/l scratch pad internally
@@ -83,18 +91,77 @@ def _causal_keep(qi, ki, shape, block_q, block_k):
     return cols <= rows
 
 
+def _tri_maps(n: int):
+    """Row-major lower-triangle enumeration: for each query row qi, the active
+    key columns ki in [0, qi]. The causal grid runs ONLY these n(n+1)/2 cells
+    (vs n^2): above-diagonal cells would DMA K/V and then skip all compute."""
+    qs, ks = zip(*[(qi, ki) for qi in range(n) for ki in range(qi + 1)])
+    return jnp.asarray(qs, jnp.int32), jnp.asarray(ks, jnp.int32)
+
+
+def _wedge_maps(n: int):
+    """Column-major enumeration of the same triangle: for each key column ki,
+    the query rows qi in [ki, n-1] contiguously (dk/dv accumulate per column)."""
+    qs, ks = zip(*[(qi, ki) for ki in range(n) for qi in range(ki, n)])
+    return jnp.asarray(qs, jnp.int32), jnp.asarray(ks, jnp.int32)
+
+
+# Grid-argument decoders: every BlockSpec index map below is written against
+# canonical (b, h, qi, ki) and composed with the decoder for the grid in use,
+# so the squashed (scalar-prefetch) and dense variants share one spec list.
+_DEC_SQUASHED = lambda b, h, t, qm, km: (b, h, qm[t], km[t])  # noqa: E731
+_DEC_DENSE = lambda b, h, qi, ki: (b, h, qi, ki)  # noqa: E731
+_DEC_DENSE_KQ = lambda b, h, ki, qi: (b, h, qi, ki)  # noqa: E731  (dkv grid order)
+
+
+def _spec(shape, f, dec):
+    return pl.BlockSpec(shape, lambda *a: f(*dec(*a)))
+
+
+def _qkv_in_specs(dec, block_q, block_k, D, G):
+    """mask, q, k, v input specs (shared by fwd and both backward kernels)."""
+    return [
+        _spec((1, 1, block_k), lambda b, h, qi, ki: (b, 0, ki), dec),
+        _spec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0), dec),
+        _spec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0), dec),
+        _spec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0), dec),
+    ]
+
+
+def _qrow_specs(dec, block_q, D):
+    """do, lse, delta input specs (backward) / o, lse output specs (forward)
+    — everything blocked along the query row."""
+    qrow = lambda b, h, qi, ki: (b, h, qi, 0)  # noqa: E731
+    return {
+        "qD": _spec((1, 1, block_q, D), qrow, dec),
+        "qL": _spec((1, 1, block_q, _LANES), qrow, dec),
+    }
+
+
+def _kcol_spec(dec, block_k, D):
+    """dk/dv output spec — blocked along the key column."""
+    return _spec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0), dec)
+
+
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                block_q, block_k, causal, masked):
-    qi = pl.program_id(2)
-    ki = pl.program_id(3)
-    nk = pl.num_programs(3)
+def _fwd_kernel(*refs, block_q, block_k, causal, masked, squashed):
+    if squashed:
+        (qm_ref, km_ref, mask_ref, q_ref, k_ref, v_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+        t = pl.program_id(2)
+        qi, ki = qm_ref[t], km_ref[t]
+        first, last = ki == 0, ki == qi
+    else:
+        (mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         acc_ref, m_ref, l_ref) = refs
+        qi, ki = pl.program_id(2), pl.program_id(3)
+        first, last = ki == 0, ki == pl.num_programs(3) - 1
 
-    @pl.when(ki == 0)
+    @pl.when(first)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
@@ -120,9 +187,9 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # All-masked rows keep m at -inf; guard exp against (-inf) - (-inf).
         m_safe = jnp.where(m_cur == _NEG_INF, 0.0, m_cur)
-        p = jnp.exp(s - m_safe)  # masked entries: exp(NEG_INF - finite) == 0
+        p = jnp.exp2(s - m_safe)  # masked entries: exp2(NEG_INF - finite) == 0
 
-        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - m_safe))
+        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp2(m_prev - m_safe))
         l_prev = jnp.max(l_ref[:], axis=-1, keepdims=True)
         l_cur = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
@@ -131,21 +198,25 @@ def _fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l
             p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    if causal:
+    if causal and squashed:
+        # the grid enumerates only ki <= qi; the diagonal cell masks in-block
+        pl.when(ki < qi)(lambda: _compute(False))
+        pl.when(ki == qi)(lambda: _compute(True))
+    elif causal:
         full_below, diag = _block_classes(qi, ki, block_q, block_k)
         pl.when(full_below)(lambda: _compute(False))
         pl.when(diag)(lambda: _compute(True))
     else:
         _compute(False)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(last)
     def _finalize():
         l = jnp.max(l_ref[:], axis=-1, keepdims=True)
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
         m = jnp.max(m_ref[:], axis=-1, keepdims=True)
-        # logsumexp per row (lane-broadcast); fully-masked rows get -inf.
-        lse = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))
+        # base-2 logsumexp per row (lane-broadcast); fully-masked rows get -inf.
+        lse = jnp.where(l == 0.0, _NEG_INF, m + jnp.log2(l_safe))
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
@@ -158,30 +229,49 @@ def _flash_fwd(q, k, v, mask, block_q: int, block_k: int, causal: bool, masked: 
     Hkv = k.shape[1]
     G = H // Hkv
     nq, nk = _cdiv(S, block_q), _cdiv(S, block_k)
+    squashed = causal and block_q == block_k and nq == nk
 
-    grid = (B, H, nq, nk)
+    out_shape = [
+        jax.ShapeDtypeStruct((B, H, S, D), q.dtype, vma=_vma(q, k, v, mask)),
+        jax.ShapeDtypeStruct((B, H, S, _LANES), jnp.float32, vma=_vma(q, k, v, mask)),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((block_q, D), jnp.float32),
+        pltpu.VMEM((block_q, _LANES), jnp.float32),
+        pltpu.VMEM((block_q, _LANES), jnp.float32),
+    ]
+    kernel = functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
+                               causal=causal, masked=masked, squashed=squashed)
+    dec = _DEC_SQUASHED if squashed else _DEC_DENSE
+    in_specs = _qkv_in_specs(dec, block_q, block_k, D, G)
+    qrow = _qrow_specs(dec, block_q, D)
+    out_specs = [qrow["qD"], qrow["qL"]]
+
+    if squashed:
+        qm, km = _tri_maps(nq)
+        out, lse = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,  # qmap, kmap
+                grid=(B, H, qm.shape[0]),
+                in_specs=in_specs,
+                out_specs=out_specs,
+                scratch_shapes=scratch_shapes,
+            ),
+            out_shape=out_shape,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=_interpret(),
+        )(qm, km, mask, q, k, v)
+        return out, lse
+
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k, causal=causal, masked=masked),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_k), lambda b, h, qi, ki: (b, 0, ki)),  # mask
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, S, D), q.dtype, vma=_vma(q, k, v, mask)),
-            jax.ShapeDtypeStruct((B, H, S, _LANES), jnp.float32, vma=_vma(q, k, v, mask)),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, D), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-        ],
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch_shapes,
         compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL_SEMANTICS),
         interpret=_interpret(),
     )(mask, q, k, v)
@@ -193,13 +283,20 @@ def _flash_fwd(q, k, v, mask, block_q: int, block_k: int, causal: bool, masked: 
 # --------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref, *,
-                   block_q, block_k, causal, masked):
-    qi = pl.program_id(2)
-    ki = pl.program_id(3)
-    nk = pl.num_programs(3)
+def _bwd_dq_kernel(*refs, block_q, block_k, causal, masked, squashed):
+    if squashed:
+        (qm_ref, km_ref, mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+         delta_ref, dq_ref, acc_ref) = refs
+        t = pl.program_id(2)
+        qi, ki = qm_ref[t], km_ref[t]
+        first, last = ki == 0, ki == qi
+    else:
+        (mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+         delta_ref, dq_ref, acc_ref) = refs
+        qi, ki = pl.program_id(2), pl.program_id(3)
+        first, last = ki == 0, ki == pl.num_programs(3) - 1
 
-    @pl.when(ki == 0)
+    @pl.when(first)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
@@ -218,7 +315,7 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq
             s = jnp.where(keep, s, _NEG_INF)
 
         lse = jnp.max(lse_ref[0, 0], axis=-1, keepdims=True)  # [block_q, 1]
-        p = jnp.exp(s - jnp.where(lse == _NEG_INF, 0.0, lse))
+        p = jnp.exp2(s - jnp.where(lse == _NEG_INF, 0.0, lse))
         # bf16 x bf16 matmul with fp32 accumulation: fp32 operands would run the
         # MXU at a fraction of its bf16 rate (measured 4x slower on v5e).
         dp = jax.lax.dot_general(
@@ -230,25 +327,35 @@ def _bwd_dq_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    if causal:
+    if causal and squashed:
+        pl.when(ki < qi)(lambda: _compute(False))
+        pl.when(ki == qi)(lambda: _compute(True))
+    elif causal:
         full_below, diag = _block_classes(qi, ki, block_q, block_k)
         pl.when(full_below)(lambda: _compute(False))
         pl.when(diag)(lambda: _compute(True))
     else:
         _compute(False)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(last)
     def _finalize():
         dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    block_q, block_k, causal, masked):
-    ki = pl.program_id(2)
-    qi = pl.program_id(3)
-    nq = pl.num_programs(3)
+def _bwd_dkv_kernel(*refs, block_q, block_k, causal, masked, squashed, nq_total):
+    if squashed:
+        (qm_ref, km_ref, mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+         delta_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        t = pl.program_id(2)
+        qi, ki = qm_ref[t], km_ref[t]
+        first, last = qi == ki, qi == nq_total - 1
+    else:
+        (mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+         delta_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        ki, qi = pl.program_id(2), pl.program_id(3)
+        first, last = qi == 0, qi == pl.num_programs(3) - 1
 
-    @pl.when(qi == 0)
+    @pl.when(first)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -268,7 +375,7 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, d
             s = jnp.where(keep, s, _NEG_INF)
 
         lse = jnp.max(lse_ref[0, 0], axis=-1, keepdims=True)
-        p = jnp.exp(s - jnp.where(lse == _NEG_INF, 0.0, lse))
+        p = jnp.exp2(s - jnp.where(lse == _NEG_INF, 0.0, lse))
         # keep every matmul in the input dtype (bf16) with fp32 accumulation —
         # fp32 operands would cut the MXU rate ~4x (see _bwd_dq_kernel note)
         do = do_ref[0, 0]
@@ -281,14 +388,17 @@ def _bwd_dkv_kernel(mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, d
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    if causal:
+    if causal and squashed:
+        pl.when(qi > ki)(lambda: _compute(False))
+        pl.when(qi == ki)(lambda: _compute(True))
+    elif causal:
         full_below, diag = _block_classes(qi, ki, block_q, block_k)
         pl.when(full_below)(lambda: _compute(False))
         pl.when(diag)(lambda: _compute(True))
     else:
         _compute(False)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(last)
     def _finalize():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
@@ -299,57 +409,84 @@ def _flash_bwd(q, k, v, mask, out, lse, do, block_q: int, block_k: int, causal: 
     Hkv = k.shape[1]
     G = H // Hkv
     nq, nk = _cdiv(S, block_q), _cdiv(S, block_k)
+    squashed = causal and block_q == block_k and nq == nk
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,S]
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k, causal=causal, masked=masked),
-        grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_k), lambda b, h, qi, ki: (b, 0, ki)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, qi, ki: (b, h, qi, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, D), jnp.float32, vma=_vma(q, k, v, mask, do)),
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL_SEMANTICS),
-        interpret=_interpret(),
-    )(mask, q, k, v, do, lse, delta)
+    grad_vma = _vma(q, k, v, mask, do)
+    dq_kernel = functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                                  causal=causal, masked=masked, squashed=squashed)
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                                   causal=causal, masked=masked, squashed=squashed,
+                                   nq_total=nq)
+    dq_scratch = [pltpu.VMEM((block_q, D), jnp.float32)]
+    dkv_scratch = [pltpu.VMEM((block_k, D), jnp.float32),
+                   pltpu.VMEM((block_k, D), jnp.float32)]
+    dq_shape = jax.ShapeDtypeStruct((B, H, S, D), jnp.float32, vma=grad_vma)
+    dkv_shape = [dq_shape, dq_shape]
 
-    # dk/dv are per *query* head here; grouped heads are summed below.
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k, causal=causal, masked=masked),
-        grid=(B, H, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_k), lambda b, h, ki, qi: (b, 0, ki)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h // G, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h // G, ki, 0)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, ki, qi: (b, h, qi, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, ki, qi: (b, h, ki, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, H, S, D), jnp.float32, vma=_vma(q, k, v, mask, do)),
-            jax.ShapeDtypeStruct((B, H, S, D), jnp.float32, vma=_vma(q, k, v, mask, do)),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, D), jnp.float32),
-            pltpu.VMEM((block_k, D), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL_SEMANTICS),
-        interpret=_interpret(),
-    )(mask, q, k, v, do, lse, delta)
+    def bwd_in_specs(dec):
+        qrow = _qrow_specs(dec, block_q, D)
+        return _qkv_in_specs(dec, block_q, block_k, D, G) + [qrow["qD"], qrow["qL"], qrow["qL"]]
+
+    if squashed:
+        arb = pltpu.CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary"))
+        qm, km = _tri_maps(nq)
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(B, H, qm.shape[0]),
+                in_specs=bwd_in_specs(_DEC_SQUASHED),
+                out_specs=_qrow_specs(_DEC_SQUASHED, block_q, D)["qD"],
+                scratch_shapes=dq_scratch,
+            ),
+            out_shape=dq_shape,
+            compiler_params=arb,
+            interpret=_interpret(),
+        )(qm, km, mask, q, k, v, do, lse, delta)
+
+        # dk/dv are per *query* head here; grouped heads are summed below.
+        wqm, wkm = _wedge_maps(nk)
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(B, H, wqm.shape[0]),
+                in_specs=bwd_in_specs(_DEC_SQUASHED),
+                out_specs=[_kcol_spec(_DEC_SQUASHED, block_k, D)] * 2,
+                scratch_shapes=dkv_scratch,
+            ),
+            out_shape=dkv_shape,
+            compiler_params=arb,
+            interpret=_interpret(),
+        )(wqm, wkm, mask, q, k, v, do, lse, delta)
+    else:
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=(B, H, nq, nk),
+            in_specs=bwd_in_specs(_DEC_DENSE),
+            out_specs=_qrow_specs(_DEC_DENSE, block_q, D)["qD"],
+            out_shape=dq_shape,
+            scratch_shapes=dq_scratch,
+            compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL_SEMANTICS),
+            interpret=_interpret(),
+        )(mask, q, k, v, do, lse, delta)
+
+        # dk/dv are per *query* head here; grouped heads are summed below. The
+        # dense dkv grid iterates (ki outer, qi inner) — _DEC_DENSE_KQ restores
+        # the canonical (qi, ki) order for the shared specs.
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid=(B, H, nk, nq),
+            in_specs=bwd_in_specs(_DEC_DENSE_KQ),
+            out_specs=[_kcol_spec(_DEC_DENSE_KQ, block_k, D)] * 2,
+            out_shape=dkv_shape,
+            scratch_shapes=dkv_scratch,
+            compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL_SEMANTICS),
+            interpret=_interpret(),
+        )(mask, q, k, v, do, lse, delta)
 
     if G > 1:
         dk = dk.reshape(B, Hkv, G, S, D).sum(axis=2)
@@ -369,7 +506,7 @@ def _flash_attention(q, k, v, mask, block_q, block_k, causal, masked):
 
 
 def _flash_core(q, k, v, mask, block_q, block_k, causal, masked):
-    scale = q.shape[-1] ** -0.5
+    scale = q.shape[-1] ** -0.5 * _LOG2E  # base-2 softmax (see module header)
     qs = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)  # [B,H,S,D]
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
@@ -386,9 +523,13 @@ def _flash_vjp_bwd(block_q, block_k, causal, masked, res, g):
     qs, kt, vt, mask, lse, out_bhsd = res
     do = g.transpose(0, 2, 1, 3)
     dq, dk, dv = _flash_bwd(qs, kt, vt, mask, out_bhsd, lse, do, block_q, block_k, causal, masked)
+    # Base-2 gradient bookkeeping (kernels compute the base-e ds = p*(dp-δ)):
+    # dq needs scale*log2e*ln2 == plain scale (exact — no ln2 rounding), and
+    # dk, accumulated against the log2e-pre-scaled q, needs ln2 applied here
+    # in fp32 before the downcast.
     scale = qs.shape[-1] ** -0.5
     dq = (dq * scale).transpose(0, 2, 1, 3).astype(qs.dtype)
-    dk = dk.transpose(0, 2, 1, 3).astype(kt.dtype)
+    dk = (dk * _LN2).transpose(0, 2, 1, 3).astype(kt.dtype)
     dv = dv.transpose(0, 2, 1, 3).astype(vt.dtype)
     return dq, dk, dv, None
 

@@ -66,6 +66,29 @@ class TestFlashAttention:
         for rg, pg in zip(ref_grads, pl_grads):
             np.testing.assert_allclose(np.asarray(pg), np.asarray(rg), atol=5e-5, rtol=5e-5)
 
+    def test_unequal_blocks_dense_grid(self):
+        """block_q != block_k routes through the dense (non-squashed) causal
+        grid — keep that branch covered: fwd + all three gradients."""
+        B, S, H, D = 2, 32, 2, 8
+        q, k, v = _rand(0, (B, S, H, D)), _rand(1, (B, S, H, D)), _rand(2, (B, S, H, D))
+
+        def f(fn):
+            def g(q, k, v):
+                out = fn(q, k, v)
+                return jnp.sum(out * jnp.cos(out.astype(jnp.float32)))
+            return g
+
+        pallas = ops.dispatch("causal_attention", "pallas")
+        ref = ops.causal_attention(q, k, v, impl="xla")
+        out = pallas(q, k, v, block_q=16, block_k=8)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+        ref_grads = jax.grad(f(lambda q, k, v: ops.causal_attention(q, k, v, impl="xla")),
+                             argnums=(0, 1, 2))(q, k, v)
+        pl_grads = jax.grad(f(lambda q, k, v: pallas(q, k, v, block_q=16, block_k=8)),
+                            argnums=(0, 1, 2))(q, k, v)
+        for rg, pg in zip(ref_grads, pl_grads):
+            np.testing.assert_allclose(np.asarray(pg), np.asarray(rg), atol=5e-5, rtol=5e-5)
+
 
 class TestNorms:
     def test_rms_norm(self):
