@@ -6,8 +6,9 @@ memory, skip configs that cannot fit) and experiment runner
 (``scheduler.py``). TPU differences: experiments run in-process (no
 multi-node job launches — one SPMD program per candidate), the memory model
 uses the real param count + XLA's compiled peak-memory when available, and
-the search space is (zero stage, micro batch, remat) — the knobs that exist
-here.
+the search space is (zero stage, micro batch, remat), optionally crossed with
+model-level overrides via ``model_factory`` (e.g. ``scan_layers``/``fused_ce``
+on a ``TransformerConfig`` — the knobs PERF.md round 3 measured to dominate).
 """
 
 from __future__ import annotations
@@ -64,7 +65,15 @@ class Autotuner:
         remat_candidates: Sequence[bool] = (False, True),
         memory_budget_bytes: Optional[int] = None,
         metric: str = "throughput",
+        model_factory=None,
+        model_override_candidates: Sequence[Dict] = ({},),
     ):
+        """``model_factory(**overrides) -> model_spec`` extends the search to
+        MODEL-level knobs the engine config cannot reach (e.g. a
+        ``TransformerConfig``'s ``scan_layers``/``fused_ce`` — PERF.md round 3
+        measured a ~25% wall-clock swing on scan_layers alone). Each dict in
+        ``model_override_candidates`` multiplies the config space; with no
+        factory, ``model_spec`` is used as-is."""
         self.model_spec = model_spec
         self.base_config = dict(base_config)
         self.micro_batch_candidates = list(micro_batch_candidates)
@@ -72,7 +81,17 @@ class Autotuner:
         self.remat_candidates = list(remat_candidates)
         self.memory_budget = memory_budget_bytes
         self.metric = metric
+        self.model_factory = model_factory
+        self.model_override_candidates = list(model_override_candidates)
+        if not self.model_override_candidates:
+            raise ValueError("model_override_candidates must not be empty (use ({},))")
+        if model_factory is None and self.model_override_candidates != [{}]:
+            raise ValueError("model_override_candidates needs model_factory")
+        if not (self.micro_batch_candidates and self.stage_candidates and self.remat_candidates):
+            raise ValueError("candidate lists must not be empty")
         self.results: List[ExperimentResult] = []
+        self.best_overrides: Optional[Dict] = None
+        self.best_model_spec = None
 
     # ------------------------------------------------------------ space
     def _candidates(self) -> List[Dict]:
@@ -80,18 +99,22 @@ class Autotuner:
         for stage in self.stage_candidates:
             for mb in self.micro_batch_candidates:
                 for remat in self.remat_candidates:
-                    cfg = dict(self.base_config)
-                    cfg.pop("train_batch_size", None)  # re-derived from micro
-                    cfg["train_micro_batch_size_per_gpu"] = mb
-                    zo = dict(cfg.get("zero_optimization", {}))
-                    zo["stage"] = stage
-                    cfg["zero_optimization"] = zo
-                    ac = dict(cfg.get("activation_checkpointing", {}))
-                    ac["enabled"] = remat  # remat=False must really disable it
-                    if remat:
-                        ac.setdefault("policy", "dots")  # keep a user's policy
-                    cfg["activation_checkpointing"] = ac
-                    out.append(cfg)
+                    for overrides in self.model_override_candidates:
+                        cfg = dict(self.base_config)
+                        cfg.pop("train_batch_size", None)  # re-derived from micro
+                        cfg["train_micro_batch_size_per_gpu"] = mb
+                        zo = dict(cfg.get("zero_optimization", {}))
+                        zo["stage"] = stage
+                        cfg["zero_optimization"] = zo
+                        ac = dict(cfg.get("activation_checkpointing", {}))
+                        ac["enabled"] = remat  # remat=False must really disable it
+                        if remat:
+                            ac.setdefault("policy", "dots")  # keep a user's policy
+                        cfg["activation_checkpointing"] = ac
+                        if overrides:
+                            # engine-config-invisible; popped before initialize
+                            cfg["_model_overrides"] = dict(overrides)
+                        out.append(cfg)
         return out
 
     def _prune_by_memory(self, cfgs: List[Dict], n_params: int, dp_world: int) -> List[Dict]:
@@ -116,7 +139,10 @@ class Autotuner:
         import deepspeed_tpu
 
         try:
-            engine, *_ = deepspeed_tpu.initialize(model=self.model_spec, config=config, seed=seed)
+            overrides = config.get("_model_overrides")
+            model = self.model_factory(**overrides) if overrides else self.model_spec
+            engine_cfg = {k: v for k, v in config.items() if k != "_model_overrides"}
+            engine, *_ = deepspeed_tpu.initialize(model=model, config=engine_cfg, seed=seed)
             bs = engine.train_batch_size
             make = batch_fn or (lambda s: self._default_batch(bs, s))
             for i in range(warmup):
@@ -134,21 +160,47 @@ class Autotuner:
         raise ValueError("pass batch_fn= to tune()/run_experiment() — the autotuner "
                          "does not know your model's input schema")
 
+    def _n_params_for(self, overrides: Optional[Dict]) -> int:
+        """Parameter count for a candidate's model, shape-only (no compute)."""
+        from deepspeed_tpu.runtime.model import as_model_spec
+
+        spec = as_model_spec(self.model_factory(**overrides) if overrides else self.model_spec)
+        shapes = jax.eval_shape(spec.init_fn, jax.random.PRNGKey(0))
+        return int(sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(shapes)))
+
     def tune(self, steps: int = 5, batch_fn=None, seed: int = 0) -> Tuple[Dict, List[ExperimentResult]]:
         """Run the sweep, return (best_config, all_results) (reference
-        ``tune()`` autotuner.py:404 + ``get_best_space_config``)."""
+        ``tune()`` autotuner.py:404 + ``get_best_space_config``).
+
+        The returned config is directly consumable by ``initialize``. When the
+        winner used model overrides, ``self.best_overrides`` records them and
+        ``self.best_model_spec`` is the rebuilt spec — pass THAT as ``model=``
+        (the engine config cannot carry model-level knobs)."""
         import deepspeed_tpu
         from deepspeed_tpu.topology.mesh import get_data_parallel_world_size
 
-        # probe: param count + dp world from a throwaway engine on the base config
+        # probe: dp world from a throwaway engine on the base config
         probe_cfg = dict(self.base_config)
         probe_cfg.setdefault("train_micro_batch_size_per_gpu", self.micro_batch_candidates[0])
         engine, *_ = deepspeed_tpu.initialize(model=self.model_spec, config=probe_cfg, seed=seed)
-        n_params = int(sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(engine.state.params)))
         dp_world = get_data_parallel_world_size(engine.mesh)
         del engine
+        if self.memory_budget is None:
+            cfgs = self._candidates()
+        else:
+            # per-override param counts (overrides may resize the model);
+            # repr-canonicalized keys tolerate unhashable override values
+            n_params = {"": self._n_params_for(None)}
+            for ov in self.model_override_candidates:
+                if ov:
+                    n_params[repr(sorted(ov.items()))] = self._n_params_for(ov)
 
-        cfgs = self._prune_by_memory(self._candidates(), n_params, dp_world)
+            def params_of(cfg):
+                ov = cfg.get("_model_overrides")
+                return n_params[repr(sorted(ov.items())) if ov else ""]
+
+            cfgs = [c for c in self._candidates()
+                    if self._prune_by_memory([c], params_of(c), dp_world)]
         if not cfgs:
             raise RuntimeError("autotuner: every candidate exceeds the memory budget")
         self.results = [self.run_experiment(c, steps=steps, batch_fn=batch_fn, seed=seed) for c in cfgs]
@@ -158,10 +210,16 @@ class Autotuner:
                 "autotuner: all experiments failed; first error: " + self.results[0].error
             )
         best = max(ok, key=lambda r: r.throughput)
+        self.best_overrides = best.config.get("_model_overrides")
+        self.best_model_spec = (
+            self.model_factory(**self.best_overrides) if self.best_overrides else self.model_spec
+        )
+        best_config = {k: v for k, v in best.config.items() if k != "_model_overrides"}
         log_dist(
             f"autotuner: best stage={best.config['zero_optimization']['stage']} "
             f"micro={best.config['train_micro_batch_size_per_gpu']} "
-            f"({best.throughput:.1f} samples/s over {len(ok)}/{len(self.results)} viable)",
+            + (f"model_overrides={self.best_overrides} " if self.best_overrides else "")
+            + f"({best.throughput:.1f} samples/s over {len(ok)}/{len(self.results)} viable)",
             ranks=[0],
         )
-        return best.config, self.results
+        return best_config, self.results

@@ -227,6 +227,45 @@ def test_autotuner_picks_viable_config(devices):
     assert any(r.config.get("activation_checkpointing", {}).get("enabled") for r in results)
 
 
+def test_autotuner_model_factory_overrides(devices):
+    """The autotuner can search MODEL-level knobs (scan_layers/fused_ce)
+    through model_factory — the dimension PERF.md round 3 showed dominates."""
+    import deepspeed_tpu
+    from deepspeed_tpu.autotuning import Autotuner
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    tiny = dict(vocab_size=128, hidden_size=32, intermediate_size=64,
+                num_layers=2, num_heads=4, max_seq_len=32)
+
+    def factory(**overrides):
+        return causal_lm_spec(TransformerConfig(**tiny, **overrides), example_seq_len=16)
+
+    def batch_fn(seed):
+        import numpy as np
+        rng = np.random.default_rng(seed)
+        # micro=2 x dp_world=8 devices -> global batch 16
+        return {"input_ids": rng.integers(0, 128, (16, 16), dtype=np.int32)}
+
+    base = {"optimizer": {"type": "Adam", "params": {"lr": 1e-3}}, "steps_per_print": 1000}
+    tuner = Autotuner(
+        factory(), base,
+        micro_batch_candidates=(2,), stage_candidates=(1,), remat_candidates=(False,),
+        model_factory=factory,
+        model_override_candidates=({}, {"scan_layers": False}),
+    )
+    best, results = tuner.tune(steps=2, batch_fn=batch_fn)
+    assert len(results) == 2 and all(r.ok for r in results)
+    # both variants actually ran
+    assert any(r.config.get("_model_overrides") == {"scan_layers": False} for r in results)
+    # the returned config is initialize-consumable (no private keys), and the
+    # winning model lives in best_model_spec / best_overrides
+    assert "_model_overrides" not in best
+    assert tuner.best_model_spec is not None
+    assert tuner.best_overrides in (None, {"scan_layers": False})
+    engine, *_ = deepspeed_tpu.initialize(model=tuner.best_model_spec, config=best)
+    assert engine.train_batch_size == 16
+
+
 def test_data_sampler_epoch_is_one_pass():
     """Regression: epoch N must serve exactly one pass, not N+1 passes."""
     from deepspeed_tpu.runtime.data_pipeline import DeepSpeedDataSampler

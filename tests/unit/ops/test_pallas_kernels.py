@@ -66,6 +66,30 @@ class TestFlashAttention:
         for rg, pg in zip(ref_grads, pl_grads):
             np.testing.assert_allclose(np.asarray(pg), np.asarray(rg), atol=5e-5, rtol=5e-5)
 
+    @pytest.mark.parametrize("bq,bk", [(8, 8), (16, 8)])  # squashed + dense grids
+    def test_masked_grads_match_xla(self, bq, bk):
+        """Backward with a padding mask (the masked branches of both bwd
+        kernels). The loss reads only kept-query outputs so masked rows are
+        genuinely don't-care and gradients must match everywhere."""
+        B, S, H, D = 2, 24, 2, 8
+        q, k, v = _rand(0, (B, S, H, D)), _rand(1, (B, S, H, D)), _rand(2, (B, S, H, D))
+        mask = jnp.asarray(np.random.default_rng(1).integers(0, 2, (B, S)), jnp.int32).at[:, 0].set(1)
+        keep = mask.astype(jnp.float32)[:, :, None, None]
+
+        def loss(fn):
+            def f(q, k, v):
+                out = fn(q, k, v)
+                return jnp.sum(keep * out * jnp.cos(out.astype(jnp.float32)))
+            return f
+
+        ref_fn = loss(lambda q, k, v: ops.causal_attention(q, k, v, mask=mask, impl="xla"))
+        pl_fn = loss(lambda q, k, v: ops.dispatch("causal_attention", "pallas")(
+            q, k, v, mask=mask, block_q=bq, block_k=bk))
+        ref_grads = jax.grad(ref_fn, argnums=(0, 1, 2))(q, k, v)
+        pl_grads = jax.grad(pl_fn, argnums=(0, 1, 2))(q, k, v)
+        for rg, pg in zip(ref_grads, pl_grads):
+            np.testing.assert_allclose(np.asarray(pg), np.asarray(rg), atol=5e-5, rtol=5e-5)
+
     def test_unequal_blocks_dense_grid(self):
         """block_q != block_k routes through the dense (non-squashed) causal
         grid — keep that branch covered: fwd + all three gradients."""
