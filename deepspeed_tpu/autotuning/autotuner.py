@@ -117,21 +117,16 @@ class Autotuner:
                         out.append(cfg)
         return out
 
-    def _prune_by_memory(self, cfgs: List[Dict], n_params: int, dp_world: int) -> List[Dict]:
-        if self.memory_budget is None:
-            return cfgs
-        kept = []
-        for cfg in cfgs:
-            need = estimate_state_memory(n_params, cfg["zero_optimization"]["stage"], dp_world)
-            if need <= self.memory_budget:
-                kept.append(cfg)
-            else:
-                logger.info(
-                    f"autotuner: prune stage={cfg['zero_optimization']['stage']} "
-                    f"micro={cfg['train_micro_batch_size_per_gpu']} "
-                    f"(est {need/1e9:.2f} GB > budget {self.memory_budget/1e9:.2f} GB)"
-                )
-        return kept
+    def _fits_memory(self, cfg: Dict, n_params: int, dp_world: int) -> bool:
+        need = estimate_state_memory(n_params, cfg["zero_optimization"]["stage"], dp_world)
+        if need <= self.memory_budget:
+            return True
+        logger.info(
+            f"autotuner: prune stage={cfg['zero_optimization']['stage']} "
+            f"micro={cfg['train_micro_batch_size_per_gpu']} "
+            f"(est {need/1e9:.2f} GB > budget {self.memory_budget/1e9:.2f} GB)"
+        )
+        return False
 
     # ------------------------------------------------------------ experiments
     def run_experiment(self, config: Dict, steps: int = 5, warmup: int = 2,
@@ -177,17 +172,18 @@ class Autotuner:
         ``self.best_model_spec`` is the rebuilt spec — pass THAT as ``model=``
         (the engine config cannot carry model-level knobs)."""
         import deepspeed_tpu
-        from deepspeed_tpu.topology.mesh import get_data_parallel_world_size
 
-        # probe: dp world from a throwaway engine on the base config
-        probe_cfg = dict(self.base_config)
-        probe_cfg.setdefault("train_micro_batch_size_per_gpu", self.micro_batch_candidates[0])
-        engine, *_ = deepspeed_tpu.initialize(model=self.model_spec, config=probe_cfg, seed=seed)
-        dp_world = get_data_parallel_world_size(engine.mesh)
-        del engine
         if self.memory_budget is None:
             cfgs = self._candidates()
         else:
+            from deepspeed_tpu.topology.mesh import get_data_parallel_world_size
+
+            # probe: dp world from a throwaway engine on the base config
+            probe_cfg = dict(self.base_config)
+            probe_cfg.setdefault("train_micro_batch_size_per_gpu", self.micro_batch_candidates[0])
+            engine, *_ = deepspeed_tpu.initialize(model=self.model_spec, config=probe_cfg, seed=seed)
+            dp_world = get_data_parallel_world_size(engine.mesh)
+            del engine
             # per-override param counts (overrides may resize the model);
             # repr-canonicalized keys tolerate unhashable override values
             n_params = {"": self._n_params_for(None)}
@@ -200,7 +196,7 @@ class Autotuner:
                 return n_params[repr(sorted(ov.items())) if ov else ""]
 
             cfgs = [c for c in self._candidates()
-                    if self._prune_by_memory([c], params_of(c), dp_world)]
+                    if self._fits_memory(c, params_of(c), dp_world)]
         if not cfgs:
             raise RuntimeError("autotuner: every candidate exceeds the memory budget")
         self.results = [self.run_experiment(c, steps=steps, batch_fn=batch_fn, seed=seed) for c in cfgs]

@@ -9,7 +9,8 @@ surgically rewriting torch modules, we translate the HF state dict into the
 framework's stacked-scan param tree once; AutoTP placement then shards it over
 the mesh (``parallel/autotp.place_parameters``).
 
-Supported families: llama (incl. mistral — same graph), gpt2, mixtral.
+Supported families: llama (incl. mistral — same graph), qwen2 (llama graph
++ qkv biases), gpt2, mixtral.
 Sharded checkpoints (``model.safetensors.index.json``) are read shard-by-shard
 into one host dict before conversion — peak host memory is the full fp* model
 plus the stacked copy being built. A per-layer streaming path (convert and
@@ -79,7 +80,7 @@ def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
             position="learned",
             tie_embeddings=True,
         )
-    if mt in ("llama", "mistral", "mixtral"):
+    if mt in ("llama", "mistral", "mixtral", "qwen2"):
         kw = dict(
             vocab_size=hf_config["vocab_size"],
             hidden_size=hf_config["hidden_size"],
@@ -101,14 +102,20 @@ def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
                 num_experts=hf_config["num_local_experts"],
                 moe_top_k=hf_config.get("num_experts_per_tok", 2),
             )
+        # HF llama-format configs may carry qkv biases (attention_bias);
+        # qwen2 always does
+        kw["qkv_bias"] = True if mt == "qwen2" else bool(hf_config.get("attention_bias", False))
         return TransformerConfig(**kw)
-    raise ValueError(f"unsupported HF model_type {mt!r} (supported: llama/mistral/mixtral/gpt2)")
+    raise ValueError(
+        f"unsupported HF model_type {mt!r} (supported: llama/mistral/mixtral/qwen2/gpt2)")
 
 
 def detect_family(state: Dict[str, np.ndarray]) -> str:
     keys = state.keys()
     if any("block_sparse_moe" in k for k in keys):
         return "mixtral"
+    if any("self_attn.q_proj.bias" in k for k in keys):
+        return "qwen2"
     if any("self_attn.q_proj" in k for k in keys):
         return "llama"
     if any(k.endswith("attn.c_attn.weight") for k in keys):
@@ -135,17 +142,22 @@ def _convert_llama(state, cfg: TransformerConfig) -> Dict[str, Any]:
 
     def layer(i):
         p = f"model.layers.{i}."
+        attn = {
+            # torch Linear stores [out, in]; flax DenseGeneral wants
+            # [in, heads, head_dim]
+            "wq": {"kernel": g(p + "self_attn.q_proj.weight").T.reshape(h, H, hd)},
+            "wk": {"kernel": g(p + "self_attn.k_proj.weight").T.reshape(h, Hkv, hd)},
+            "wv": {"kernel": g(p + "self_attn.v_proj.weight").T.reshape(h, Hkv, hd)},
+            "wo": {"kernel": g(p + "self_attn.o_proj.weight").T.reshape(H, hd, h)},
+        }
+        if p + "self_attn.q_proj.bias" in state:  # qwen2-style qkv biases
+            attn["wq"]["bias"] = g(p + "self_attn.q_proj.bias").reshape(H, hd)
+            attn["wk"]["bias"] = g(p + "self_attn.k_proj.bias").reshape(Hkv, hd)
+            attn["wv"]["bias"] = g(p + "self_attn.v_proj.bias").reshape(Hkv, hd)
         blk = {
             "attn_norm": {"scale": g(p + "input_layernorm.weight")},
             "mlp_norm": {"scale": g(p + "post_attention_layernorm.weight")},
-            "attn": {
-                # torch Linear stores [out, in]; flax DenseGeneral wants
-                # [in, heads, head_dim]
-                "wq": {"kernel": g(p + "self_attn.q_proj.weight").T.reshape(h, H, hd)},
-                "wk": {"kernel": g(p + "self_attn.k_proj.weight").T.reshape(h, Hkv, hd)},
-                "wv": {"kernel": g(p + "self_attn.v_proj.weight").T.reshape(h, Hkv, hd)},
-                "wo": {"kernel": g(p + "self_attn.o_proj.weight").T.reshape(H, hd, h)},
-            },
+            "attn": attn,
         }
         if cfg.num_experts > 0:
             ex = p + "block_sparse_moe."
@@ -219,6 +231,7 @@ _CONVERTERS = {
     "llama": _convert_llama,
     "mistral": _convert_llama,
     "mixtral": _convert_llama,
+    "qwen2": _convert_llama,  # llama graph + qkv biases (handled by presence)
     "gpt2": _convert_gpt2,
 }
 

@@ -42,6 +42,9 @@ class TransformerConfig:
     max_seq_len: int = 2048
     norm: str = "rmsnorm"  # rmsnorm | layernorm
     activation: str = "silu_glu"  # silu_glu | gelu
+    # QKV-projection bias override (qwen2-style: rmsnorm model WITH qkv bias).
+    # None keeps the norm-derived default (layernorm models carry biases).
+    qkv_bias: Optional[bool] = None
     position: str = "rope"  # rope | learned
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
@@ -194,11 +197,12 @@ class Attention(nn.Module):
     def __call__(self, x, mask, positions, train: bool):
         cfg = self.config
         hd = cfg.dims_per_head
-        q = nn.DenseGeneral((cfg.num_heads, hd), use_bias=cfg.norm == "layernorm",
+        qkv_bias = cfg.qkv_bias if cfg.qkv_bias is not None else cfg.norm == "layernorm"
+        q = nn.DenseGeneral((cfg.num_heads, hd), use_bias=qkv_bias,
                             dtype=cfg.dtype, name="wq")(x)
-        k = nn.DenseGeneral((cfg.kv_heads, hd), use_bias=cfg.norm == "layernorm",
+        k = nn.DenseGeneral((cfg.kv_heads, hd), use_bias=qkv_bias,
                             dtype=cfg.dtype, name="wk")(x)
-        v = nn.DenseGeneral((cfg.kv_heads, hd), use_bias=cfg.norm == "layernorm",
+        v = nn.DenseGeneral((cfg.kv_heads, hd), use_bias=qkv_bias,
                             dtype=cfg.dtype, name="wv")(x)
 
         if cfg.position == "rope":
