@@ -10,7 +10,7 @@ framework's stacked-scan param tree once; AutoTP placement then shards it over
 the mesh (``parallel/autotp.place_parameters``).
 
 Supported families: llama (incl. mistral — same graph), qwen2 (llama graph
-+ qkv biases), gpt2, mixtral.
++ qkv biases), gpt2, opt, mixtral.
 Sharded checkpoints (``model.safetensors.index.json``) are read shard-by-shard
 into one host dict before conversion — peak host memory is the full fp* model
 plus the stacked copy being built. A per-layer streaming path (convert and
@@ -106,14 +106,37 @@ def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
         # qwen2 always does
         kw["qkv_bias"] = True if mt == "qwen2" else bool(hf_config.get("attention_bias", False))
         return TransformerConfig(**kw)
+    if mt == "opt":
+        if not hf_config.get("do_layer_norm_before", True):
+            raise ValueError("OPT post-layernorm variants (do_layer_norm_before=false) are unsupported")
+        h = hf_config["hidden_size"]
+        if hf_config.get("word_embed_proj_dim", h) != h:
+            raise ValueError("OPT word_embed_proj_dim != hidden_size (e.g. opt-350m) is unsupported")
+        act = hf_config.get("activation_function", "relu")
+        if act not in ("relu", "gelu", "gelu_new"):
+            raise ValueError(f"unsupported OPT activation_function {act!r}")
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config["ffn_dim"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            max_seq_len=hf_config.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation="relu" if act == "relu" else "gelu",
+            position="learned",
+            tie_embeddings=bool(hf_config.get("tie_word_embeddings", True)),
+        )
     raise ValueError(
-        f"unsupported HF model_type {mt!r} (supported: llama/mistral/mixtral/qwen2/gpt2)")
+        f"unsupported HF model_type {mt!r} (supported: llama/mistral/mixtral/qwen2/gpt2/opt)")
 
 
 def detect_family(state: Dict[str, np.ndarray]) -> str:
     keys = state.keys()
     if any("block_sparse_moe" in k for k in keys):
         return "mixtral"
+    if any("decoder.embed_positions" in k for k in keys) and not any("encoder." in k for k in keys):
+        return "opt"
     if any("self_attn.q_proj.bias" in k for k in keys):
         return "qwen2"
     if any("self_attn.q_proj" in k for k in keys):
@@ -124,6 +147,17 @@ def detect_family(state: Dict[str, np.ndarray]) -> str:
 
 
 # ------------------------------------------------------------------ convert
+
+def _getter(state: Dict[str, np.ndarray], prefixes: Tuple[str, ...]):
+    """Tensor lookup tolerant of checkpoint-dependent top-level prefixes."""
+    def g(name):
+        for pre in prefixes:
+            if pre + name in state:
+                return np.asarray(state[pre + name])
+        tried = ", ".join(repr(pre + name) for pre in prefixes)
+        raise KeyError(f"checkpoint is missing tensor (tried {tried})")
+    return g
+
 
 def _stack(fn: Callable[[int], Dict[str, Any]], L: int) -> Dict[str, Any]:
     """Per-layer subtree -> stacked [L, ...] leaves (the nn.scan layout)."""
@@ -190,12 +224,8 @@ def _convert_llama(state, cfg: TransformerConfig) -> Dict[str, Any]:
 def _convert_gpt2(state, cfg: TransformerConfig) -> Dict[str, Any]:
     h, hd, H = cfg.hidden_size, cfg.dims_per_head, cfg.num_heads
 
-    def g(name):
-        # HF sometimes prefixes with "transformer."
-        for key in (name, "transformer." + name):
-            if key in state:
-                return np.asarray(state[key])
-        raise KeyError(f"checkpoint is missing tensor {name!r} (also tried 'transformer.{name}')")
+    # HF sometimes prefixes with "transformer."
+    g = _getter(state, ("", "transformer."))
 
     def layer(i):
         p = f"h.{i}."
@@ -227,12 +257,55 @@ def _convert_gpt2(state, cfg: TransformerConfig) -> Dict[str, Any]:
     }
 
 
+def _convert_opt(state, cfg: TransformerConfig) -> Dict[str, Any]:
+    h, hd, H = cfg.hidden_size, cfg.dims_per_head, cfg.num_heads
+
+    # checkpoints may or may not carry the top-level "model." prefix
+    g = _getter(state, ("model.", ""))
+
+    def layer(i):
+        p = f"decoder.layers.{i}."
+        return {
+            "attn_norm": {"scale": g(p + "self_attn_layer_norm.weight"),
+                          "bias": g(p + "self_attn_layer_norm.bias")},
+            "mlp_norm": {"scale": g(p + "final_layer_norm.weight"),
+                         "bias": g(p + "final_layer_norm.bias")},
+            "attn": {
+                "wq": {"kernel": g(p + "self_attn.q_proj.weight").T.reshape(h, H, hd),
+                       "bias": g(p + "self_attn.q_proj.bias").reshape(H, hd)},
+                "wk": {"kernel": g(p + "self_attn.k_proj.weight").T.reshape(h, H, hd),
+                       "bias": g(p + "self_attn.k_proj.bias").reshape(H, hd)},
+                "wv": {"kernel": g(p + "self_attn.v_proj.weight").T.reshape(h, H, hd),
+                       "bias": g(p + "self_attn.v_proj.bias").reshape(H, hd)},
+                "wo": {"kernel": g(p + "self_attn.out_proj.weight").T.reshape(H, hd, h),
+                       "bias": g(p + "self_attn.out_proj.bias")},
+            },
+            "mlp": {
+                "w_up": {"kernel": g(p + "fc1.weight").T, "bias": g(p + "fc1.bias")},
+                "w_down": {"kernel": g(p + "fc2.weight").T, "bias": g(p + "fc2.bias")},
+            },
+        }
+
+    params: Dict[str, Any] = {
+        "embed": {"embedding": g("decoder.embed_tokens.weight")},
+        # OPT's learned positions carry a legacy offset of 2 rows
+        "pos_embed": g("decoder.embed_positions.weight")[2:],
+        "final_norm": {"scale": g("decoder.final_layer_norm.weight"),
+                       "bias": g("decoder.final_layer_norm.bias")},
+        "layers": _stack(layer, cfg.num_layers),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": np.asarray(state["lm_head.weight"]).T}
+    return params
+
+
 _CONVERTERS = {
     "llama": _convert_llama,
     "mistral": _convert_llama,
     "mixtral": _convert_llama,
     "qwen2": _convert_llama,  # llama graph + qkv biases (handled by presence)
     "gpt2": _convert_gpt2,
+    "opt": _convert_opt,
 }
 
 
