@@ -123,7 +123,8 @@ def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
             num_heads=hf_config["num_attention_heads"],
             max_seq_len=hf_config.get("max_position_embeddings", 2048),
             norm="layernorm",
-            activation="relu" if act == "relu" else "gelu",
+            # HF 'gelu' is exact erf-gelu; 'gelu_new' is the tanh approx
+            activation={"relu": "relu", "gelu": "gelu_exact", "gelu_new": "gelu"}[act],
             position="learned",
             tie_embeddings=bool(hf_config.get("tie_word_embeddings", True)),
         )

@@ -106,7 +106,13 @@ def _mlp(lp, cfg: TransformerConfig, x):
     if cfg.activation == "silu_glu":
         h = jax.nn.silu(dense(lp["w_gate"], x)) * dense(lp["w_up"], x)
     else:
-        h = jax.nn.gelu(dense(lp["w_up"], x))
+        h = dense(lp["w_up"], x)
+        if cfg.activation == "relu":
+            h = jax.nn.relu(h)
+        elif cfg.activation == "gelu_exact":  # HF 'gelu' is the erf form
+            h = jax.nn.gelu(h, approximate=False)
+        else:
+            h = jax.nn.gelu(h)
     return dense(lp["w_down"], h)
 
 

@@ -41,7 +41,7 @@ class TransformerConfig:
     head_dim: Optional[int] = None  # None => hidden // heads
     max_seq_len: int = 2048
     norm: str = "rmsnorm"  # rmsnorm | layernorm
-    activation: str = "silu_glu"  # silu_glu | gelu | relu
+    activation: str = "silu_glu"  # silu_glu | gelu (tanh approx) | gelu_exact | relu
     # QKV-projection bias override (qwen2-style: rmsnorm model WITH qkv bias).
     # None keeps the norm-derived default (layernorm models carry biases).
     qkv_bias: Optional[bool] = None
@@ -245,7 +245,12 @@ class MLP(nn.Module):
             h = nn.silu(gate) * up
         else:
             h = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype, name="w_up")(x)
-            h = nn.relu(h) if cfg.activation == "relu" else nn.gelu(h)
+            if cfg.activation == "relu":
+                h = nn.relu(h)
+            elif cfg.activation == "gelu_exact":  # HF 'gelu' is the erf form
+                h = nn.gelu(h, approximate=False)
+            else:
+                h = nn.gelu(h)
         out = nn.Dense(cfg.hidden_size, use_bias=bias, dtype=cfg.dtype, name="w_down")(h)
         if cfg.dropout > 0:
             out = nn.Dropout(cfg.dropout, deterministic=not train)(out)

@@ -90,19 +90,20 @@ def test_qwen2_ingestion_logits_parity(tmp_path):
     np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3, atol=2e-4)
 
 
-def test_opt_ingestion_logits_parity(tmp_path):
-    """OPT: layernorm + relu + learned positions (legacy offset-2 rows)."""
+@pytest.mark.parametrize("act,want_act", [("relu", "relu"), ("gelu", "gelu_exact")])
+def test_opt_ingestion_logits_parity(tmp_path, act, want_act):
+    """OPT: layernorm + relu/exact-gelu + learned positions (offset-2 rows)."""
     cfg_hf = transformers.OPTConfig(
         vocab_size=128, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
         num_attention_heads=4, max_position_embeddings=64,
-        word_embed_proj_dim=32, activation_function="relu",
+        word_embed_proj_dim=32, activation_function=act,
     )
     hf_model = transformers.OPTForCausalLM(cfg_hf)
     hf_model.eval()
     hf_model.save_pretrained(tmp_path, safe_serialization=True)
 
     cfg, params = load_hf_checkpoint(str(tmp_path))
-    assert cfg.activation == "relu" and cfg.position == "learned"
+    assert cfg.activation == want_act and cfg.position == "learned"
     assert params["pos_embed"].shape == (64, 32)  # offset rows stripped
 
     ids = np.random.default_rng(0).integers(0, 128, (2, 12))

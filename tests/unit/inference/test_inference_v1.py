@@ -45,6 +45,9 @@ def full_forward_greedy(module, params, ids, steps):
     {},  # llama-style: rmsnorm + rope + GQA + swiglu
     {"norm": "layernorm", "activation": "gelu", "position": "learned",
      "num_kv_heads": None, "tie_embeddings": True},  # gpt2-style
+    {"qkv_bias": True},  # qwen2-style: rmsnorm + rope + qkv biases
+    {"norm": "layernorm", "activation": "relu", "position": "learned",
+     "num_kv_heads": None, "tie_embeddings": True},  # opt-style
 ])
 def test_cached_decode_matches_full_forward(overrides):
     cfg, module, params = make_model(**overrides)
