@@ -10,7 +10,7 @@ framework's stacked-scan param tree once; AutoTP placement then shards it over
 the mesh (``parallel/autotp.place_parameters``).
 
 Supported families: llama (incl. mistral — same graph), qwen2 (llama graph
-+ qkv biases), gpt2, opt, mixtral.
++ qkv biases), gpt2, opt, falcon (7b-style parallel block, MQA), mixtral.
 Sharded checkpoints (``model.safetensors.index.json``) are read shard-by-shard
 into one host dict before conversion — peak host memory is the full fp* model
 plus the stacked copy being built. A per-layer streaming path (convert and
@@ -128,8 +128,41 @@ def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
             position="learned",
             tie_embeddings=bool(hf_config.get("tie_word_embeddings", True)),
         )
+    if mt == "falcon":
+        if hf_config.get("new_decoder_architecture", False):
+            raise ValueError("falcon new_decoder_architecture (40b/180b) is unsupported")
+        if not hf_config.get("parallel_attn", True):
+            raise ValueError("falcon without parallel_attn is unsupported")
+        if hf_config.get("alibi", False):
+            raise ValueError("falcon alibi position biases are unsupported (rope only)")
+        if not hf_config.get("multi_query", True):
+            raise ValueError(
+                "falcon multi_query=False is unsupported (HF interleaves q/k/v per "
+                "head in the fused projection for that variant)")
+        if hf_config.get("bias", False):
+            raise ValueError("falcon bias=True variants are unsupported")
+        h = hf_config["hidden_size"]
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=4 * h,
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            num_kv_heads=1 if hf_config.get("multi_query", True) else None,
+            max_seq_len=hf_config.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation="gelu_exact",
+            position="rope",
+            rope_theta=float(hf_config.get("rope_theta", 10000.0)),
+            norm_eps=float(hf_config.get("layer_norm_epsilon", 1e-5)),
+            qkv_bias=bool(hf_config.get("bias", False)),
+            dense_bias=bool(hf_config.get("bias", False)),
+            parallel_block=True,
+            # falcon ties by default (FalconConfig.tie_word_embeddings=True)
+            tie_embeddings=bool(hf_config.get("tie_word_embeddings", True)),
+        )
     raise ValueError(
-        f"unsupported HF model_type {mt!r} (supported: llama/mistral/mixtral/qwen2/gpt2/opt)")
+        f"unsupported HF model_type {mt!r} (supported: llama/mistral/mixtral/qwen2/gpt2/opt/falcon)")
 
 
 def detect_family(state: Dict[str, np.ndarray]) -> str:
@@ -138,6 +171,8 @@ def detect_family(state: Dict[str, np.ndarray]) -> str:
         return "mixtral"
     if any("decoder.embed_positions" in k for k in keys) and not any("encoder." in k for k in keys):
         return "opt"
+    if any("self_attention.query_key_value" in k for k in keys):
+        return "falcon"
     if any("self_attn.q_proj.bias" in k for k in keys):
         return "qwen2"
     if any("self_attn.q_proj" in k for k in keys):
@@ -300,6 +335,44 @@ def _convert_opt(state, cfg: TransformerConfig) -> Dict[str, Any]:
     return params
 
 
+def _convert_falcon(state, cfg: TransformerConfig) -> Dict[str, Any]:
+    h, hd, H, Hkv = cfg.hidden_size, cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
+    g = _getter(state, ("transformer.", ""))
+
+    def layer(i):
+        p = f"h.{i}."
+        # fused qkv rows: H query heads, then Hkv key heads, then Hkv value
+        qkv = g(p + "self_attention.query_key_value.weight")  # [(H+2Hkv)*hd, h]
+        wq = qkv[: H * hd]
+        wk = qkv[H * hd: (H + Hkv) * hd]
+        wv = qkv[(H + Hkv) * hd:]
+        attn = {
+            "wq": {"kernel": wq.T.reshape(h, H, hd)},
+            "wk": {"kernel": wk.T.reshape(h, Hkv, hd)},
+            "wv": {"kernel": wv.T.reshape(h, Hkv, hd)},
+            "wo": {"kernel": g(p + "self_attention.dense.weight").T.reshape(H, hd, h)},
+        }
+        return {
+            # parallel block: ONE shared input layernorm (no mlp_norm)
+            "attn_norm": {"scale": g(p + "input_layernorm.weight"),
+                          "bias": g(p + "input_layernorm.bias")},
+            "attn": attn,
+            "mlp": {
+                "w_up": {"kernel": g(p + "mlp.dense_h_to_4h.weight").T},
+                "w_down": {"kernel": g(p + "mlp.dense_4h_to_h.weight").T},
+            },
+        }
+
+    params: Dict[str, Any] = {
+        "embed": {"embedding": g("word_embeddings.weight")},
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+        "layers": _stack(layer, cfg.num_layers),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": np.asarray(state["lm_head.weight"]).T}
+    return params
+
+
 _CONVERTERS = {
     "llama": _convert_llama,
     "mistral": _convert_llama,
@@ -307,6 +380,7 @@ _CONVERTERS = {
     "qwen2": _convert_llama,  # llama graph + qkv biases (handled by presence)
     "gpt2": _convert_gpt2,
     "opt": _convert_opt,
+    "falcon": _convert_falcon,
 }
 
 

@@ -184,8 +184,13 @@ def _block_step(lp, cfg: TransformerConfig, x, ck, cv, kv_mask, positions, write
     ck = _write_cache(ck, k.astype(ck.dtype), write_start)
     cv = _write_cache(cv, v.astype(cv.dtype), write_start)
     ctx = _cached_attention(q, ck, cv, kv_mask, positions)
-    x = x + _attn_out(lp["attn"], cfg, ctx)
+    attn_out = _attn_out(lp["attn"], cfg, ctx)
 
+    if cfg.parallel_block:
+        # falcon-style: attn and FFN both read the shared input norm `h`
+        ffn = _moe(lp["moe"], cfg, h) if cfg.num_experts > 0 else _mlp(lp["mlp"], cfg, h)
+        return x + attn_out + ffn, ck, cv
+    x = x + attn_out
     h = _apply_norm(lp["mlp_norm"], cfg, x)
     if cfg.num_experts > 0:
         x = x + _moe(lp["moe"], cfg, h)

@@ -45,6 +45,12 @@ class TransformerConfig:
     # QKV-projection bias override (qwen2-style: rmsnorm model WITH qkv bias).
     # None keeps the norm-derived default (layernorm models carry biases).
     qkv_bias: Optional[bool] = None
+    # Output/MLP projection bias override (falcon-style: layernorm model with
+    # bias-free dense layers). None keeps the norm-derived default.
+    dense_bias: Optional[bool] = None
+    # Falcon-7B-style parallel residual: attn and MLP both read ONE shared
+    # input layernorm and add into the residual in parallel.
+    parallel_block: bool = False
     position: str = "rope"  # rope | learned
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
@@ -127,7 +133,7 @@ class TransformerConfig:
                     layer_mlp += mlp + 2 * h + 2  # residual MLP + coefficient gate
             else:
                 layer_mlp = mlp
-            total += qkv + layer_mlp + 2 * h
+            total += qkv + layer_mlp + (h if self.parallel_block else 2 * h)
         return total
 
     def num_active_params(self) -> int:
@@ -225,7 +231,8 @@ class Attention(nn.Module):
             q, k, v = ulysses_shard(q), ulysses_shard(k), ulysses_shard(v)
             out = causal_attention(q, k, v, mask=mask, impl=cfg.attn_impl)  # [B,S,H,hd]
             out = ulysses_unshard(out)
-        out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), use_bias=cfg.norm == "layernorm",
+        dense_bias = cfg.dense_bias if cfg.dense_bias is not None else cfg.norm == "layernorm"
+        out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), use_bias=dense_bias,
                               dtype=cfg.dtype, name="wo")(out)
         if cfg.dropout > 0:
             out = nn.Dropout(cfg.dropout, deterministic=not train)(out)
@@ -238,7 +245,7 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool):
         cfg = self.config
-        bias = cfg.norm == "layernorm"
+        bias = cfg.dense_bias if cfg.dense_bias is not None else cfg.norm == "layernorm"
         if cfg.activation == "silu_glu":
             gate = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype, name="w_gate")(x)
             up = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype, name="w_up")(x)
@@ -268,10 +275,15 @@ class Block(nn.Module):
     def __call__(self, carry, _=None):
         x, mask, positions, aux = carry
         cfg = self.config
-        x = x + Attention(cfg, name="attn")(
-            _norm(cfg, "attn_norm")(x), mask, positions, self.train
-        )
-        h = _norm(cfg, "mlp_norm")(x)
+        if cfg.parallel_block:
+            # x = x + attn(ln(x)) + mlp(ln(x)) — one shared norm
+            h = _norm(cfg, "attn_norm")(x)
+            x = x + Attention(cfg, name="attn")(h, mask, positions, self.train)
+        else:
+            x = x + Attention(cfg, name="attn")(
+                _norm(cfg, "attn_norm")(x), mask, positions, self.train
+            )
+            h = _norm(cfg, "mlp_norm")(x)
         n_exp = cfg.experts_for_layer(self.layer_idx)
         if n_exp > 0:
             from deepspeed_tpu.parallel.moe import MoEConfig, MoELayer

@@ -48,6 +48,9 @@ def full_forward_greedy(module, params, ids, steps):
     {"qkv_bias": True},  # qwen2-style: rmsnorm + rope + qkv biases
     {"norm": "layernorm", "activation": "relu", "position": "learned",
      "num_kv_heads": None, "tie_embeddings": True},  # opt-style
+    {"norm": "layernorm", "activation": "gelu_exact", "num_kv_heads": 1,
+     "qkv_bias": False, "dense_bias": False, "parallel_block": True,
+     "tie_embeddings": True},  # falcon-style: parallel block + MQA
 ])
 def test_cached_decode_matches_full_forward(overrides):
     cfg, module, params = make_model(**overrides)
