@@ -10,7 +10,8 @@ framework's stacked-scan param tree once; AutoTP placement then shards it over
 the mesh (``parallel/autotp.place_parameters``).
 
 Supported families: llama (incl. mistral — same graph), qwen2 (llama graph
-+ qkv biases), gpt2, opt, falcon (7b-style parallel block, MQA), mixtral.
++ qkv biases), gpt2, opt, falcon (7b-style parallel block, MQA), phi (parallel
+block + partial rotary), mixtral.
 Sharded checkpoints (``model.safetensors.index.json``) are read shard-by-shard
 into one host dict before conversion — peak host memory is the full fp* model
 plus the stacked copy being built. A per-layer streaming path (convert and
@@ -145,24 +146,55 @@ def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
         return TransformerConfig(
             vocab_size=hf_config["vocab_size"],
             hidden_size=h,
-            intermediate_size=4 * h,
+            intermediate_size=hf_config.get("ffn_hidden_size") or 4 * h,
             num_layers=hf_config["num_hidden_layers"],
             num_heads=hf_config["num_attention_heads"],
-            num_kv_heads=1 if hf_config.get("multi_query", True) else None,
+            num_kv_heads=1,  # multi_query guaranteed by the guard above
             max_seq_len=hf_config.get("max_position_embeddings", 2048),
             norm="layernorm",
             activation="gelu_exact",
             position="rope",
             rope_theta=float(hf_config.get("rope_theta", 10000.0)),
             norm_eps=float(hf_config.get("layer_norm_epsilon", 1e-5)),
-            qkv_bias=bool(hf_config.get("bias", False)),
-            dense_bias=bool(hf_config.get("bias", False)),
+            qkv_bias=False,  # bias=True rejected above
+            dense_bias=False,
             parallel_block=True,
             # falcon ties by default (FalconConfig.tie_word_embeddings=True)
             tie_embeddings=bool(hf_config.get("tie_word_embeddings", True)),
         )
+    if mt == "phi":
+        if hf_config.get("qk_layernorm", False):
+            raise ValueError("phi qk_layernorm=True is unsupported")
+        h = hf_config["hidden_size"]
+        heads = hf_config["num_attention_heads"]
+        kvh = hf_config.get("num_key_value_heads") or heads
+        if kvh != heads:
+            raise ValueError("phi with GQA (num_key_value_heads != num_attention_heads) is unsupported")
+        act = hf_config.get("hidden_act", "gelu_new")
+        if act not in ("gelu_new", "gelu", "relu"):
+            raise ValueError(f"unsupported phi hidden_act {act!r}")
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=heads,
+            max_seq_len=hf_config.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation={"gelu_new": "gelu", "gelu": "gelu_exact", "relu": "relu"}[act],
+            position="rope",
+            rope_theta=float(hf_config.get("rope_theta", 10000.0)),
+            rotary_dim=int(hf_config.get("partial_rotary_factor", 0.5) * (h // heads)),
+            norm_eps=float(hf_config.get("layer_norm_eps", 1e-5)),
+            qkv_bias=True,
+            dense_bias=True,
+            lm_head_bias=True,
+            parallel_block=True,
+            tie_embeddings=bool(hf_config.get("tie_word_embeddings", False)),
+        )
     raise ValueError(
-        f"unsupported HF model_type {mt!r} (supported: llama/mistral/mixtral/qwen2/gpt2/opt/falcon)")
+        f"unsupported HF model_type {mt!r} "
+        "(supported: llama/mistral/mixtral/qwen2/gpt2/opt/falcon/phi)")
 
 
 def detect_family(state: Dict[str, np.ndarray]) -> str:
@@ -173,6 +205,8 @@ def detect_family(state: Dict[str, np.ndarray]) -> str:
         return "opt"
     if any("self_attention.query_key_value" in k for k in keys):
         return "falcon"
+    if any("self_attn.dense.weight" in k for k in keys):
+        return "phi"
     if any("self_attn.q_proj.bias" in k for k in keys):
         return "qwen2"
     if any("self_attn.q_proj" in k for k in keys):
@@ -373,6 +407,44 @@ def _convert_falcon(state, cfg: TransformerConfig) -> Dict[str, Any]:
     return params
 
 
+def _convert_phi(state, cfg: TransformerConfig) -> Dict[str, Any]:
+    h, hd, H = cfg.hidden_size, cfg.dims_per_head, cfg.num_heads
+    g = _getter(state, ("model.", ""))
+
+    def layer(i):
+        p = f"layers.{i}."
+        return {
+            # parallel block: ONE shared input layernorm
+            "attn_norm": {"scale": g(p + "input_layernorm.weight"),
+                          "bias": g(p + "input_layernorm.bias")},
+            "attn": {
+                "wq": {"kernel": g(p + "self_attn.q_proj.weight").T.reshape(h, H, hd),
+                       "bias": g(p + "self_attn.q_proj.bias").reshape(H, hd)},
+                "wk": {"kernel": g(p + "self_attn.k_proj.weight").T.reshape(h, H, hd),
+                       "bias": g(p + "self_attn.k_proj.bias").reshape(H, hd)},
+                "wv": {"kernel": g(p + "self_attn.v_proj.weight").T.reshape(h, H, hd),
+                       "bias": g(p + "self_attn.v_proj.bias").reshape(H, hd)},
+                "wo": {"kernel": g(p + "self_attn.dense.weight").T.reshape(H, hd, h),
+                       "bias": g(p + "self_attn.dense.bias")},
+            },
+            "mlp": {
+                "w_up": {"kernel": g(p + "mlp.fc1.weight").T, "bias": g(p + "mlp.fc1.bias")},
+                "w_down": {"kernel": g(p + "mlp.fc2.weight").T, "bias": g(p + "mlp.fc2.bias")},
+            },
+        }
+
+    params: Dict[str, Any] = {
+        "embed": {"embedding": g("embed_tokens.weight")},
+        "final_norm": {"scale": g("final_layernorm.weight"),
+                       "bias": g("final_layernorm.bias")},
+        "layers": _stack(layer, cfg.num_layers),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": np.asarray(state["lm_head.weight"]).T,
+                             "bias": np.asarray(state["lm_head.bias"])}
+    return params
+
+
 _CONVERTERS = {
     "llama": _convert_llama,
     "mistral": _convert_llama,
@@ -381,6 +453,7 @@ _CONVERTERS = {
     "gpt2": _convert_gpt2,
     "opt": _convert_opt,
     "falcon": _convert_falcon,
+    "phi": _convert_phi,
 }
 
 

@@ -176,9 +176,9 @@ def _block_step(lp, cfg: TransformerConfig, x, ck, cv, kv_mask, positions, write
     h = _apply_norm(lp["attn_norm"], cfg, x)
     q, k, v = _qkv(lp["attn"], cfg, h)
     if cfg.position == "rope":
-        cos, sin = rope_tables(cfg.max_seq_len, cfg.dims_per_head, cfg.rope_theta)
-        q = rope_op(q, cos, sin, positions)
-        k = rope_op(k, cos, sin, positions)
+        from deepspeed_tpu.models.transformer import apply_qk_rope
+
+        q, k = apply_qk_rope(cfg, q, k, positions)
 
     # merge new K/V into cache at per-row write offsets
     ck = _write_cache(ck, k.astype(ck.dtype), write_start)
@@ -228,7 +228,10 @@ def _logits(params, cfg: TransformerConfig, x):
     x = _apply_norm(params["final_norm"], cfg, x)
     if cfg.tie_embeddings:
         return x @ params["embed"]["embedding"].T.astype(cfg.dtype)
-    return x @ params["lm_head"]["kernel"].astype(cfg.dtype)
+    logits = x @ params["lm_head"]["kernel"].astype(cfg.dtype)
+    if "bias" in params["lm_head"]:
+        logits = logits + params["lm_head"]["bias"].astype(cfg.dtype)
+    return logits
 
 
 # ------------------------------------------------------------------ api

@@ -136,14 +136,19 @@ def ragged_forward(
         h = _apply_norm(lp["attn_norm"], cfg, x)
         q, k, v = _qkv(lp["attn"], cfg, h)
         if cfg.position == "rope":
-            cos, sin = rope_tables(cfg.max_seq_len, cfg.dims_per_head, cfg.rope_theta)
-            q = rope_op(q, cos, sin, positions)
-            k = rope_op(k, cos, sin, positions)
+            from deepspeed_tpu.models.transformer import apply_qk_rope
+
+            q, k = apply_qk_rope(cfg, q, k, positions)
         kvH, hd = k.shape[-2], k.shape[-1]
         pk = pk.at[flat_slot].set(k.astype(pk.dtype).reshape(-1, kvH, hd), mode="drop")
         pv = pv.at[flat_slot].set(v.astype(pv.dtype).reshape(-1, kvH, hd), mode="drop")
         ctx = paged_attention(q, pk, pv, block_tables, positions, bs, new_lens=new_lens)
-        x = x + _attn_out(lp["attn"], cfg, ctx)
+        attn_out = _attn_out(lp["attn"], cfg, ctx)
+        if cfg.parallel_block:
+            # falcon/phi-style: attn and FFN both read the shared input norm
+            ffn = _moe(lp["moe"], cfg, h) if cfg.num_experts > 0 else _mlp(lp["mlp"], cfg, h)
+            return x + attn_out + ffn, (pk, pv)
+        x = x + attn_out
         h = _apply_norm(lp["mlp_norm"], cfg, x)
         if cfg.num_experts > 0:
             x = x + _moe(lp["moe"], cfg, h)
@@ -162,4 +167,6 @@ def ragged_forward(
         logits = last @ params["embed"]["embedding"].T.astype(cfg.dtype)
     else:
         logits = last @ params["lm_head"]["kernel"].astype(cfg.dtype)
+        if "bias" in params["lm_head"]:
+            logits = logits + params["lm_head"]["bias"].astype(cfg.dtype)
     return logits, pool

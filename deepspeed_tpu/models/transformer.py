@@ -53,6 +53,10 @@ class TransformerConfig:
     parallel_block: bool = False
     position: str = "rope"  # rope | learned
     rope_theta: float = 500000.0
+    # Partial rotary (phi-style): rope only the first rotary_dim of head_dim.
+    rotary_dim: Optional[int] = None
+    # lm_head bias (phi-style untied head); disables the fused-CE path.
+    lm_head_bias: bool = False
     norm_eps: float = 1e-5
     dropout: float = 0.0
     tie_embeddings: bool = False
@@ -189,6 +193,22 @@ def rope_tables(seq_len: int, dim: int, theta: float) -> Tuple[jax.Array, jax.Ar
     return jnp.cos(angles), jnp.sin(angles)
 
 
+def apply_qk_rope(cfg: "TransformerConfig", q, k, positions):
+    """Apply (possibly partial) rotary embeddings per the config.
+
+    Phi-style partial rotary ropes only the first ``rotary_dim`` of head_dim;
+    the tail dims pass through. Shared by the training attention and both
+    inference decode paths so the three sites cannot drift."""
+    hd = q.shape[-1]
+    rd = cfg.rotary_dim or hd
+    cos, sin = rope_tables(cfg.max_seq_len, rd, cfg.rope_theta)
+    if rd < hd:
+        q = jnp.concatenate([apply_rope(q[..., :rd], cos, sin, positions), q[..., rd:]], -1)
+        k = jnp.concatenate([apply_rope(k[..., :rd], cos, sin, positions), k[..., rd:]], -1)
+        return q, k
+    return apply_rope(q, cos, sin, positions), apply_rope(k, cos, sin, positions)
+
+
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array) -> jax.Array:
     """x: [B, S, H, D]; cos/sin: [maxS, D/2]; positions: [B, S]."""
     from deepspeed_tpu.ops import rope as rope_op
@@ -212,9 +232,7 @@ class Attention(nn.Module):
                             dtype=cfg.dtype, name="wv")(x)
 
         if cfg.position == "rope":
-            cos, sin = rope_tables(cfg.max_seq_len, hd, cfg.rope_theta)
-            q = apply_rope(q, cos, sin, positions)
-            k = apply_rope(k, cos, sin, positions)
+            q, k = apply_qk_rope(cfg, q, k, positions)
 
         from deepspeed_tpu.ops import causal_attention
         from deepspeed_tpu.parallel.ulysses import sp_active, ulysses_shard, ulysses_unshard
@@ -377,7 +395,8 @@ class CausalLM(nn.Module):
         if labels is None:
             labels = jnp.concatenate([ids[:, 1:], jnp.full((B, 1), -100, dtype=ids.dtype)], axis=1)
 
-        use_fused = train and cfg.fused_ce and cfg.vocab_size >= cfg.fused_ce_min_vocab
+        use_fused = (train and cfg.fused_ce and cfg.vocab_size >= cfg.fused_ce_min_vocab
+                     and not cfg.lm_head_bias)
         if use_fused:
             # fused chunked-vocab LM head + CE: no [B,S,V] logits in HBM
             # (see ops/cross_entropy.py). Training returns logits=None.
@@ -394,7 +413,8 @@ class CausalLM(nn.Module):
                 embed = self.variables["params"]["embed"]["embedding"]
                 logits = x @ embed.T.astype(cfg.dtype)
             else:
-                logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype, name="lm_head")(x)
+                logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias,
+                                  dtype=cfg.dtype, name="lm_head")(x)
             loss = cross_entropy_loss(logits, labels, pad_mask)
         if cfg.has_moe:
             # aux is pre-weighted by MoELayer; average over layers
@@ -431,6 +451,8 @@ def _lm_head_and_loss(params, cfg: TransformerConfig, x, batch, aux):
         logits = x @ params["embed"]["embedding"].T.astype(cfg.dtype)
     else:
         logits = x @ params["lm_head"]["kernel"].astype(cfg.dtype)
+        if "bias" in params["lm_head"]:
+            logits = logits + params["lm_head"]["bias"].astype(cfg.dtype)
     ids = batch["input_ids"]
     labels = batch.get("labels")
     if labels is None:

@@ -144,6 +144,32 @@ def test_falcon_ingestion_logits_parity(tmp_path):
     np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3, atol=2e-4)
 
 
+def test_phi_ingestion_logits_parity(tmp_path):
+    """Phi: parallel block + partial rotary + biased head and projections."""
+    cfg_hf = transformers.PhiConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+    )
+    hf_model = transformers.PhiForCausalLM(cfg_hf)
+    hf_model.eval()
+    hf_model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg, params = load_hf_checkpoint(str(tmp_path))
+    assert cfg.parallel_block and cfg.rotary_dim == 4 and cfg.lm_head_bias
+    assert "bias" in params["lm_head"]
+
+    ids = np.random.default_rng(0).integers(0, 128, (2, 12))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+
+    module = CausalLM(cfg)
+    _, logits = module.apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray, params)},
+        {"input_ids": jnp.asarray(ids, jnp.int32)}, train=False)
+    np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3, atol=2e-4)
+
+
 def test_gpt2_ingestion_logits_parity(tmp_path):
     cfg_hf = transformers.GPT2Config(
         vocab_size=96, n_embd=32, n_layer=2, n_head=4, n_positions=64)
