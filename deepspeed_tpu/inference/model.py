@@ -29,6 +29,7 @@ from deepspeed_tpu.models.transformer import (
     TransformerConfig,
     _apply_norm,
     _embed_tokens,
+    act_fn,
     rope_tables,
 )
 from deepspeed_tpu.ops import rope as rope_op
@@ -106,13 +107,7 @@ def _mlp(lp, cfg: TransformerConfig, x):
     if cfg.activation == "silu_glu":
         h = jax.nn.silu(dense(lp["w_gate"], x)) * dense(lp["w_up"], x)
     else:
-        h = dense(lp["w_up"], x)
-        if cfg.activation == "relu":
-            h = jax.nn.relu(h)
-        elif cfg.activation == "gelu_exact":  # HF 'gelu' is the erf form
-            h = jax.nn.gelu(h, approximate=False)
-        else:
-            h = jax.nn.gelu(h)
+        h = act_fn(cfg.activation)(dense(lp["w_up"], x))
     return dense(lp["w_down"], h)
 
 
@@ -138,7 +133,7 @@ def _moe(lp, cfg: TransformerConfig, x):
     if cfg.activation == "silu_glu":
         h1 = jax.nn.silu(jnp.einsum("tm,emh->teh", tokens, ep["w_gate"].astype(cfg.dtype))) * h1
     else:
-        h1 = jax.nn.gelu(h1)
+        h1 = act_fn(cfg.activation)(h1)
     out_e = jnp.einsum("teh,ehm->tem", h1, ep["w_down"].astype(cfg.dtype))
     out = jnp.einsum("te,tem->tm", gate.astype(cfg.dtype), out_e)
     return out.reshape(B, S, M)

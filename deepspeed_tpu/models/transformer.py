@@ -169,6 +169,17 @@ PRESETS = {
 }
 
 
+def act_fn(name: str):
+    """Non-GLU activation by config name (shared by every MLP/expert site)."""
+    if name == "relu":
+        return jax.nn.relu
+    if name == "gelu_exact":  # HF 'gelu' is the erf form
+        return lambda x: jax.nn.gelu(x, approximate=False)
+    if name == "gelu":
+        return jax.nn.gelu
+    raise ValueError(f"unknown activation {name!r} (silu_glu | gelu | gelu_exact | relu)")
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-5
 
@@ -270,12 +281,7 @@ class MLP(nn.Module):
             h = nn.silu(gate) * up
         else:
             h = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype, name="w_up")(x)
-            if cfg.activation == "relu":
-                h = nn.relu(h)
-            elif cfg.activation == "gelu_exact":  # HF 'gelu' is the erf form
-                h = nn.gelu(h, approximate=False)
-            else:
-                h = nn.gelu(h)
+            h = act_fn(cfg.activation)(h)
         out = nn.Dense(cfg.hidden_size, use_bias=bias, dtype=cfg.dtype, name="w_down")(h)
         if cfg.dropout > 0:
             out = nn.Dropout(cfg.dropout, deterministic=not train)(out)

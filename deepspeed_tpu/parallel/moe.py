@@ -183,9 +183,11 @@ class Experts(nn.Module):
             h = jax.nn.silu(jnp.einsum("ecm,emh->ech", x, w_gate.astype(self.dtype)))
             h = h * jnp.einsum("ecm,emh->ech", x, w_up.astype(self.dtype))
         else:
+            from deepspeed_tpu.models.transformer import act_fn
+
             w_up = self.param("w_up", init, (E, M, H))
             w_down = self.param("w_down", init, (E, H, M))
-            h = jax.nn.gelu(jnp.einsum("ecm,emh->ech", x, w_up.astype(self.dtype)))
+            h = act_fn(self.activation)(jnp.einsum("ecm,emh->ech", x, w_up.astype(self.dtype)))
         return jnp.einsum("ech,ehm->ecm", h, w_down.astype(self.dtype))
 
 
