@@ -471,7 +471,8 @@ def _lm_head_and_loss(params, cfg: TransformerConfig, x, batch, aux):
 
 
 def pipelined_causal_lm_loss(params, batch, rng, *, config: TransformerConfig,
-                             num_microbatches: int, mesh, train: bool = True):
+                             num_microbatches: int, mesh, train: bool = True,
+                             virtual_stages: int = 1):
     """CausalLM forward+loss with the layer stack executed as an SPMD pipeline
     over the ``pp`` mesh axis (see ``parallel/pipeline_spmd.spmd_pipeline``).
 
@@ -483,7 +484,10 @@ def pipelined_causal_lm_loss(params, batch, rng, *, config: TransformerConfig,
     per-microbatch rather than over the full batch — the same per-microbatch
     routing semantics the reference has under gradient accumulation.
     """
-    from deepspeed_tpu.parallel.pipeline_spmd import spmd_pipeline
+    from deepspeed_tpu.parallel.pipeline_spmd import (
+        spmd_pipeline,
+        spmd_pipeline_interleaved,
+    )
 
     cfg = config
     if not cfg.scan_layers:
@@ -523,9 +527,15 @@ def pipelined_causal_lm_loss(params, batch, rng, *, config: TransformerConfig,
         (x, _, _, aux), _ = jax.lax.scan(body, (x, mask, pos, aux), (stage_layers, rngs))
         return (x, aux)
 
-    x_out, aux = spmd_pipeline(
-        stage_fn, params["layers"], stream, mesh=mesh, rng=rng, side_stream=side
-    )
+    if virtual_stages > 1:
+        x_out, aux = spmd_pipeline_interleaved(
+            stage_fn, params["layers"], stream, mesh=mesh, rng=rng,
+            side_stream=side, virtual=virtual_stages,
+        )
+    else:
+        x_out, aux = spmd_pipeline(
+            stage_fn, params["layers"], stream, mesh=mesh, rng=rng, side_stream=side
+        )
     x_full = x_out.reshape((B,) + x_out.shape[2:])
     # Equal-size microbatches: mean of per-microbatch means == full-batch mean.
     return _lm_head_and_loss(params, cfg, x_full, batch, aux.mean())
@@ -618,6 +628,7 @@ def causal_lm_spec(
     config: TransformerConfig,
     example_seq_len: int = 8,
     pipeline_microbatches: int = 0,
+    pipeline_virtual_stages: int = 1,
 ) -> ModelSpec:
     """Build the engine-facing ModelSpec for a CausalLM.
 
@@ -641,6 +652,7 @@ def causal_lm_spec(
                     params, batch, rng, config=config,
                     num_microbatches=pipeline_microbatches,
                     mesh=get_mesh(), train=True,
+                    virtual_stages=pipeline_virtual_stages,
                 )
         return module.apply({"params": params}, batch, train=True, rngs={"dropout": rng})
 

@@ -79,6 +79,7 @@ class PipelineModule:
         seed_layers: bool = False,
         example_input: Any = None,
         num_microbatches: Optional[int] = None,
+        virtual_stages: int = 1,
     ):
         self.layer_specs = [l if isinstance(l, LayerSpec) else LayerSpec(lambda l=l: l) for l in layers]
         self.num_stages = num_stages
@@ -93,6 +94,10 @@ class PipelineModule:
         self.example_input = example_input
         # pipeline microbatches per engine micro-batch (default: pp world).
         self.num_microbatches = num_microbatches
+        # Megatron-style interleaving: chunks per device; bubble shrinks by V
+        # (spmd_pipeline_interleaved). Requires stack % (pp*V) == 0 and
+        # microbatches % pp == 0.
+        self.virtual_stages = virtual_stages
 
     def __len__(self) -> int:
         return len(self.layer_specs)

@@ -32,6 +32,28 @@ from jax.sharding import Mesh, PartitionSpec as P
 StageFn = Callable[[Any, Any, jax.Array], Any]
 
 
+def _make_call_stage(stage_fn, side_stream):
+    def call_stage(params, carry, side, r):
+        if side_stream is None:
+            return stage_fn(params, carry, r)
+        return stage_fn(params, carry, side, r)
+    return call_stage
+
+
+def _make_side_at(M):
+    def side_at(side, idx):
+        return jax.tree_util.tree_map(lambda v: v[jnp.clip(idx, 0, M - 1)], side)
+    return side_at
+
+
+def _check_layer_dims(stage_params, div: int, what: str):
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] % div:
+            raise ValueError(
+                f"stacked layer dim {leaf.shape[0]} not divisible by {what}={div}; "
+                f"choose num_layers divisible by it")
+
+
 def spmd_pipeline(
     stage_fn: StageFn,
     stage_params: Any,
@@ -70,20 +92,9 @@ def spmd_pipeline(
     """
     S = mesh.shape["pp"]
     M = jax.tree_util.tree_leaves(stream)[0].shape[0]
-    for leaf in jax.tree_util.tree_leaves(stage_params):
-        if leaf.shape[0] % S:
-            raise ValueError(
-                f"stacked layer dim {leaf.shape[0]} not divisible by pp={S}; "
-                f"choose num_layers divisible by the pp mesh axis"
-            )
-
-    def call_stage(params, carry, side, r):
-        if side_stream is None:
-            return stage_fn(params, carry, r)
-        return stage_fn(params, carry, side, r)
-
-    def side_at(side, idx):
-        return jax.tree_util.tree_map(lambda v: v[jnp.clip(idx, 0, M - 1)], side)
+    _check_layer_dims(stage_params, S, "pp")
+    call_stage = _make_call_stage(stage_fn, side_stream)
+    side_at = _make_side_at(M)
 
     if S == 1:
         def body(_, xs):
@@ -153,3 +164,120 @@ def spmd_pipeline(
 def pipeline_bubble_fraction(num_microbatches: int, num_stages: int) -> float:
     """Idle fraction of the fill-and-drain schedule: (S-1)/(M+S-1)."""
     return (num_stages - 1) / (num_microbatches + num_stages - 1)
+
+
+def spmd_pipeline_interleaved(
+    stage_fn: StageFn,
+    stage_params: Any,
+    stream: Any,
+    *,
+    mesh: Mesh,
+    rng: jax.Array,
+    virtual: int,
+    side_stream: Any = None,
+) -> Any:
+    """Interleaved (virtual-stage) pipeline: bubble shrinks by ``virtual``.
+
+    Megatron-style interleaving the reference does NOT have (its
+    ``TrainSchedule`` is plain 1F1B): each device owns ``virtual`` chunks of
+    ``1/(S*virtual)`` of the layers, placed round-robin so virtual stage
+    ``j = c*S + i`` lives on device ``i``. Every j -> j+1 hop is a ring
+    neighbor, so ONE ppermute per tick still suffices.
+
+    The lockstep schedule is closed-form and conflict-free: microbatch ``m``
+    runs virtual stage ``j = c*S + i`` at tick
+
+        t(m, j) = (m // S) * S * V + c * S + (m % S) + i
+
+    (per device-tick the decomposition ``t - i = m' + S*(c + V*g)`` is a
+    base-S digit expansion, so at most one (m, c) is active, and consecutive
+    stages differ by exactly one tick — activations arrive exactly when
+    consumed, no buffering). Fill is ``S - 1`` CHUNK-ticks, i.e. ``(S-1)/V``
+    stage-times: bubble ``(S-1)/(M*V + S - 1)`` vs GPipe's ``(S-1)/(M+S-1)``.
+
+    Requires ``M % S == 0`` and ``L % (S * virtual) == 0``.
+    """
+    S = mesh.shape["pp"]
+    V = int(virtual)
+    if V <= 1:
+        return spmd_pipeline(stage_fn, stage_params, stream, mesh=mesh, rng=rng,
+                             side_stream=side_stream)
+    M = jax.tree_util.tree_leaves(stream)[0].shape[0]
+    L = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    _check_layer_dims(stage_params, S * V, "pp*virtual")
+    if M % S:
+        raise ValueError(f"microbatches {M} not divisible by pp={S} (interleaved schedule)")
+    Lc = L // (S * V)
+
+    # Reorder layers so device i's contiguous P("pp") shard holds its V chunks
+    # [c=0..V-1] stacked: global layer order = vstage (c*S + i) blocks.
+    order = jnp.asarray(
+        [(c * S + i) * Lc + l for i in range(S) for c in range(V) for l in range(Lc)],
+        jnp.int32,
+    )
+    params_z = jax.tree_util.tree_map(lambda p: jnp.take(p, order, axis=0), stage_params)
+
+    call_stage = _make_call_stage(stage_fn, side_stream)
+    side_at = _make_side_at(M)
+
+    T = M * V + S - 1
+    perm = [(j, (j + 1) % S) for j in range(S)]
+
+    def run(params, stream, side_stream, rng):
+        i = lax.axis_index("pp")
+        # local params: [S-shard of L] -> [V, Lc, ...]
+        local = jax.tree_util.tree_map(
+            lambda p: p.reshape((V, Lc) + p.shape[1:]), params)
+        zero_carry = jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape[1:], x.dtype), stream)
+        out_init = jax.tree_util.tree_map(jnp.zeros_like, stream)
+
+        def tick(carry, t):
+            recv, out_buf = carry
+            x_rel = t - i
+            g = x_rel // (S * V)
+            r = x_rel % (S * V)
+            c = r // S
+            m = g * S + (r % S)
+            valid = (x_rel >= 0) & (m >= 0) & (m < M)
+            ingest = valid & (i == 0) & (c == 0)
+            commit = valid & (i == S - 1) & (c == V - 1)
+
+            m_safe = jnp.clip(m, 0, M - 1)
+            mb = jax.tree_util.tree_map(lambda v: v[m_safe], stream)
+            x = jax.tree_util.tree_map(lambda a, b: jnp.where(ingest, a, b), mb, recv)
+            chunk = jax.tree_util.tree_map(
+                lambda p: lax.dynamic_index_in_dim(p, jnp.clip(c, 0, V - 1), 0,
+                                                   keepdims=False), local)
+            side = side_at(side_stream, m_safe) if side_stream is not None else None
+            y = call_stage(chunk, x, side, jax.random.fold_in(rng, t))
+            out_buf = jax.tree_util.tree_map(
+                lambda buf, yv: jnp.where(
+                    commit,
+                    lax.dynamic_update_slice_in_dim(buf, yv[None].astype(buf.dtype), m_safe, 0),
+                    buf,
+                ),
+                out_buf,
+                y,
+            )
+            recv = jax.tree_util.tree_map(lambda v: lax.ppermute(v, "pp", perm), y)
+            return (recv, out_buf), None
+
+        (_, out_buf), _ = lax.scan(tick, (zero_carry, out_init), jnp.arange(T))
+        return jax.tree_util.tree_map(
+            lambda v: lax.psum(jnp.where(i == S - 1, v, jnp.zeros_like(v)), "pp"), out_buf
+        )
+
+    return jax.shard_map(
+        run,
+        mesh=mesh,
+        axis_names={"pp"},
+        in_specs=(P("pp"), P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(params_z, stream, side_stream, rng)
+
+
+def pipeline_bubble_fraction_interleaved(num_microbatches: int, num_stages: int,
+                                         virtual: int) -> float:
+    """Idle fraction with virtual-stage interleaving: (S-1)/(M*V + S-1)."""
+    return (num_stages - 1) / (num_microbatches * virtual + num_stages - 1)

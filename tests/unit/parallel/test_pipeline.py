@@ -226,3 +226,95 @@ def test_schedule_executor_buffer_safety():
                           lambda y, t: jnp.mean((y - t) ** 2))
     with pytest.raises(RuntimeError, match="num_pipe_buffers|buffer"):
         ex.run(BadSchedule, xs, xs)
+
+
+def test_interleaved_pipeline_matches_sequential(devices):
+    """Virtual-stage pipeline == sequential chain (pp=4, V=2, M=4)."""
+    from deepspeed_tpu.parallel.pipeline_spmd import (
+        pipeline_bubble_fraction_interleaved,
+        spmd_pipeline_interleaved,
+    )
+
+    mesh = build_mesh(axis_sizes={"pp": 4, "dp": 2})
+    L, D, M, B = 16, 8, 4, 2
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (L, D, D)) * (0.5 / np.sqrt(D))
+    stream = jax.random.normal(jax.random.PRNGKey(1), (M, B, D))
+
+    def stage_fn(stage_w, x, rng):
+        c, _ = jax.lax.scan(lambda c, wi: (jnp.tanh(c @ wi), None), x, stage_w)
+        return c
+
+    out = jax.jit(lambda w, s: spmd_pipeline_interleaved(
+        stage_fn, w, s, mesh=mesh, rng=key, virtual=2))(w, stream)
+
+    def sequential(x):
+        for i in range(L):
+            x = jnp.tanh(x @ w[i])
+        return x
+
+    expected = jax.vmap(sequential)(stream)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5)
+    # the whole point: bubble shrinks by V
+    assert pipeline_bubble_fraction_interleaved(4, 4, 2) < pipeline_bubble_fraction(4, 4)
+
+
+def test_interleaved_pipeline_gradients(devices):
+    from deepspeed_tpu.parallel.pipeline_spmd import spmd_pipeline_interleaved
+
+    mesh = build_mesh(axis_sizes={"pp": 2, "dp": 4})
+    L, D, M, B = 8, 8, 2, 2
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (L, D, D)) * (0.5 / np.sqrt(D))
+    stream = jax.random.normal(jax.random.PRNGKey(1), (M, B, D))
+
+    def stage_fn(stage_w, x, rng):
+        c, _ = jax.lax.scan(lambda c, wi: (jnp.tanh(c @ wi), None), x, stage_w)
+        return c
+
+    def piped_loss(w):
+        out = spmd_pipeline_interleaved(stage_fn, w, stream, mesh=mesh, rng=key, virtual=2)
+        return (out ** 2).sum()
+
+    def seq_loss(w):
+        def one(x):
+            for i in range(L):
+                x = jnp.tanh(x @ w[i])
+            return x
+        return (jax.vmap(one)(stream) ** 2).sum()
+
+    g1 = jax.jit(jax.grad(piped_loss))(w)
+    g2 = jax.grad(seq_loss)(w)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=2e-4, atol=1e-5)
+
+
+def test_interleaved_causal_lm_trains(devices):
+    """Full engine train step with pp=2 x V=2 virtual stages: loss decreases
+    and matches the plain-pipeline loss on step 0 (same params, dropout 0)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import TransformerConfig, causal_lm_spec
+
+    cfg = TransformerConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=4,
+        num_heads=4, num_kv_heads=2, max_seq_len=32,
+    )
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"pp": 2, "dp": 2, "tp": 2},
+        "steps_per_print": 1000,
+    }
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 128, (4, 16), dtype=np.int32)}
+
+    losses = {}
+    for v in (1, 2):
+        spec = causal_lm_spec(cfg, pipeline_microbatches=2, pipeline_virtual_stages=v)
+        engine, *_ = deepspeed_tpu.initialize(model=spec, config=config, seed=3)
+        assert engine.train_batch_size == 4
+        traj = [float(engine.train_batch(batch)["loss"]) for _ in range(4)]
+        assert traj[-1] < traj[0], f"V={v}: no learning {traj}"
+        losses[v] = traj
+
+    # same params/seed => identical first-step loss across schedules
+    np.testing.assert_allclose(losses[1][0], losses[2][0], rtol=1e-5)

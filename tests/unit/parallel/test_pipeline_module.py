@@ -117,3 +117,22 @@ def test_pipeline_module_too_few_blocks(devices):
     )
     with pytest.raises(ValueError, match="contiguous run"):
         deepspeed_tpu.initialize(model=mod, config=_config(2))
+
+
+def test_pipeline_module_interleaved_matches_pp1(devices):
+    """LayerSpec API with virtual_stages=2 on pp=2 matches the pp=1 trajectory."""
+    base = _run(pp=1)
+
+    def module_v():
+        m = _module()
+        m.virtual_stages = 2
+        return m
+
+    engine, *_ = deepspeed_tpu.initialize(model=module_v(), config=_config(2))
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(4):
+        ids = rng.integers(0, V, (engine.train_batch_size, S), dtype=np.int64)
+        labels = rng.integers(0, V, (engine.train_batch_size, S), dtype=np.int64)
+        losses.append(float(engine.train_batch({"input_ids": ids, "labels": labels})["loss"]))
+    np.testing.assert_allclose(losses, base, rtol=2e-4)
