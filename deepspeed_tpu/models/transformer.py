@@ -484,10 +484,7 @@ def pipelined_causal_lm_loss(params, batch, rng, *, config: TransformerConfig,
     per-microbatch rather than over the full batch — the same per-microbatch
     routing semantics the reference has under gradient accumulation.
     """
-    from deepspeed_tpu.parallel.pipeline_spmd import (
-        spmd_pipeline,
-        spmd_pipeline_interleaved,
-    )
+    from deepspeed_tpu.parallel.pipeline_spmd import spmd_pipeline_interleaved
 
     cfg = config
     if not cfg.scan_layers:
@@ -527,15 +524,11 @@ def pipelined_causal_lm_loss(params, batch, rng, *, config: TransformerConfig,
         (x, _, _, aux), _ = jax.lax.scan(body, (x, mask, pos, aux), (stage_layers, rngs))
         return (x, aux)
 
-    if virtual_stages > 1:
-        x_out, aux = spmd_pipeline_interleaved(
-            stage_fn, params["layers"], stream, mesh=mesh, rng=rng,
-            side_stream=side, virtual=virtual_stages,
-        )
-    else:
-        x_out, aux = spmd_pipeline(
-            stage_fn, params["layers"], stream, mesh=mesh, rng=rng, side_stream=side
-        )
+    # virtual <= 1 delegates to the plain fill-and-drain pipeline
+    x_out, aux = spmd_pipeline_interleaved(
+        stage_fn, params["layers"], stream, mesh=mesh, rng=rng,
+        side_stream=side, virtual=virtual_stages,
+    )
     x_full = x_out.reshape((B,) + x_out.shape[2:])
     # Equal-size microbatches: mean of per-microbatch means == full-batch mean.
     return _lm_head_and_loss(params, cfg, x_full, batch, aux.mean())

@@ -320,17 +320,11 @@ def spec_from_pipeline_module(module: PipelineModule, pp: int, seed: int = 0) ->
         def stage_fn(stage_stack, carry, srng):
             return _apply_stack(stage_stack, carry, srng, apply_mid)
 
-        from deepspeed_tpu.parallel.pipeline_spmd import (
-            spmd_pipeline,
-            spmd_pipeline_interleaved,
-        )
+        from deepspeed_tpu.parallel.pipeline_spmd import spmd_pipeline_interleaved
 
-        V = getattr(module, "virtual_stages", 1)
-        if V > 1:
-            h = spmd_pipeline_interleaved(
-                stage_fn, params["stack"], stream, mesh=mesh, rng=rng, virtual=V)
-        else:
-            h = spmd_pipeline(stage_fn, params["stack"], stream, mesh=mesh, rng=rng)
+        h = spmd_pipeline_interleaved(
+            stage_fn, params["stack"], stream, mesh=mesh, rng=rng,
+            virtual=getattr(module, "virtual_stages", 1))
         h = jax.tree_util.tree_map(lambda v: v.reshape((B,) + v.shape[2:]), h)
 
         for i in range(hi, len(layers)):

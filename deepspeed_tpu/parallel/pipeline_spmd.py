@@ -196,6 +196,13 @@ def spmd_pipeline_interleaved(
     stage-times: bubble ``(S-1)/(M*V + S - 1)`` vs GPipe's ``(S-1)/(M+S-1)``.
 
     Requires ``M % S == 0`` and ``L % (S * virtual) == 0``.
+
+    PERF NOTE: the round-robin layer permutation below runs per call on the
+    pp-sharded stack, so XLA reshards O(param bytes) over the pp axis each
+    step (plus the transposed scatter in backward) — comparable to one
+    ZeRO-3-style allgather. Storing the engine's stacked params pre-permuted
+    (and adjusting checkpoint canonicalization) would eliminate it; measure
+    on real hardware before taking that complexity.
     """
     S = mesh.shape["pp"]
     V = int(virtual)
