@@ -30,9 +30,7 @@ from deepspeed_tpu.models.transformer import (
     _apply_norm,
     _embed_tokens,
     act_fn,
-    rope_tables,
 )
-from deepspeed_tpu.ops import rope as rope_op
 
 
 class KVCache(NamedTuple):

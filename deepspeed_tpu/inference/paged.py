@@ -26,8 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference.model import _apply_norm, _attn_out, _mlp, _moe, _qkv
-from deepspeed_tpu.models.transformer import TransformerConfig, rope_tables
-from deepspeed_tpu.ops import rope as rope_op
+from deepspeed_tpu.models.transformer import TransformerConfig
 
 
 class PagedKVPool(NamedTuple):
