@@ -72,9 +72,15 @@ def test_build_ragged_batch_shapes():
 
 
 # ----------------------------------------------------------- device parity
-def test_paged_matches_dense_v1():
+@pytest.mark.parametrize("overrides", [
+    {},
+    {"norm": "layernorm", "activation": "gelu_exact", "num_kv_heads": 1,
+     "qkv_bias": False, "dense_bias": False, "parallel_block": True,
+     "tie_embeddings": True},  # falcon-style: parallel block through ragged
+])
+def test_paged_matches_dense_v1(overrides):
     """Staggered prefill+decance through v2 == per-prompt v1 greedy decode."""
-    cfg, module, params = make_model()
+    cfg, module, params = make_model(**overrides)
     eng = InferenceEngineV2(cfg, params, {"dtype": "fp32", "kv_block_size": 4,
                                           "num_kv_blocks": 64, "chunk_bucket": 8})
     v1 = init_inference(model=cfg, params=params, config={"dtype": "fp32", "seq_bucket": 8})
