@@ -1,0 +1,49 @@
+"""Parallel ssh over a hostfile (reference ``bin/ds_ssh``)."""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+
+def run_on_hosts(hosts: List[str], command: List[str], max_workers: int = 32) -> int:
+    """Run ``command`` on every host via ssh; per-host-prefixed output.
+
+    Remote args are shlex-quoted (the repo-wide convention,
+    ``launcher/runner.py``) so spaces/metacharacters survive the remote shell.
+    Returns the max exit code.
+    """
+    remote = " ".join(map(shlex.quote, command))
+
+    def run(host: str) -> int:
+        r = subprocess.run(
+            ["ssh", "-o", "StrictHostKeyChecking=no", host, remote],
+            capture_output=True, text=True,
+        )
+        # one write per host: concurrent prints cannot interleave mid-line
+        block = "".join(f"[{host}] {line}\n"
+                        for line in (r.stdout + r.stderr).splitlines())
+        sys.stdout.write(block)
+        sys.stdout.flush()
+        return r.returncode
+
+    with ThreadPoolExecutor(max_workers=min(len(hosts), max_workers)) as ex:
+        codes = list(ex.map(run, hosts))
+    return max(codes) if codes else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    from deepspeed_tpu.launcher.runner import parse_hostfile
+
+    p = argparse.ArgumentParser(description="run a command on every hostfile host")
+    p.add_argument("--hostfile", default="/job/hostfile")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    a = p.parse_args(argv)
+    if not a.command:
+        p.error("no command given")
+    return run_on_hosts(list(parse_hostfile(a.hostfile)), a.command)

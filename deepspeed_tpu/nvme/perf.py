@@ -79,5 +79,18 @@ def main():  # pragma: no cover - CLI shim (bin/ds_io)
         print(json.dumps(sweep_io_config(a.folder, a.size_mb)))
 
 
+def main_tune():  # pragma: no cover - CLI shim (bin/ds_nvme_tune)
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(description="deepspeed_tpu NVMe tuner (ds_nvme_tune analog)")
+    p.add_argument("folder", help="directory on the device to tune")
+    p.add_argument("--size-mb", type=int, default=256)
+    p.add_argument("--threads", type=int, nargs="*", default=None,
+                   help="candidate thread counts (default 1 2 4 8)")
+    a = p.parse_args()
+    print(json.dumps(sweep_io_config(a.folder, a.size_mb, a.threads)))
+
+
 if __name__ == "__main__":  # pragma: no cover
     main()

@@ -105,3 +105,18 @@ def test_flat_torch_state_dict_keys_shard():
 
     assert infer_tp_spec("['self_attn.q_proj.weight']", (64, 32)) == P("tp", None)
     assert infer_tp_spec("['model.embed_tokens.weight']", (256, 32)) == P("tp", None)
+
+
+def test_ds_ssh_quotes_remote_command():
+    """ds_ssh must shlex-quote remote args (spaces/metacharacters survive)."""
+    import subprocess
+    import unittest.mock as mock
+
+    from deepspeed_tpu.launcher.ssh import run_on_hosts
+
+    with mock.patch("subprocess.run") as r:
+        r.return_value = subprocess.CompletedProcess([], 3, "a\nb\n", "")
+        code = run_on_hosts(["h1"], ["ls", "my dir", "a;b"])
+    assert code == 3
+    argv = r.call_args[0][0]
+    assert argv[:2] == ["ssh", "-o"] and argv[-1] == "ls 'my dir' 'a;b'"
