@@ -162,3 +162,21 @@ def compute_elastic_config(
         + (f" micro_batch={micro}" if micro else "")
     )
     return final_batch, sorted(valid), valid, micro
+
+
+def main():  # pragma: no cover - CLI shim (bin/ds_elastic)
+    """Elastic config checker (reference ``bin/ds_elastic``)."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(description="deepspeed_tpu elastic config checker")
+    p.add_argument("-c", "--config", required=True,
+                   help="ds config json with an 'elasticity' section")
+    p.add_argument("-w", "--world-size", type=int, default=0)
+    a = p.parse_args()
+    with open(a.config) as f:
+        cfg = json.load(f)
+    batch, worlds, _table, micro = compute_elastic_config(
+        cfg.get("elasticity", cfg), world_size=a.world_size)
+    print(json.dumps({"train_batch_size": batch, "valid_world_sizes": worlds,
+                      "micro_batch": micro}))

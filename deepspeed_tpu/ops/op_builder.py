@@ -47,11 +47,18 @@ class NativeOpBuilder:
     EXTRA_FLAGS: List[str] = []
 
     def __init__(self, build_dir: Optional[str] = None):
-        self.build_dir = Path(build_dir or os.environ.get("DS_TPU_BUILD_DIR", _DEFAULT_BUILD_DIR))
+        explicit = build_dir or os.environ.get("DS_TPU_BUILD_DIR")
+        self.build_dir = Path(explicit) if explicit else Path(_DEFAULT_BUILD_DIR)
+        # An explicitly requested dir must never be silently redirected — a
+        # misconfiguration should surface, not land .so files in ~/.cache.
+        self._explicit_build_dir = explicit is not None
         self._lib: Optional[ctypes.CDLL] = None
 
     def absolute_sources(self) -> List[Path]:
-        return [_REPO_ROOT / s for s in self.SOURCES]
+        # DS_TPU_CSRC_DIR lets a non-editable install (no csrc/ next to the
+        # package) point at an unpacked source tree.
+        root = Path(os.environ.get("DS_TPU_CSRC_DIR", _REPO_ROOT))
+        return [root / s for s in self.SOURCES]
 
     def is_compatible(self) -> bool:
         """Reference ``is_compatible``: do we have a toolchain + sources?"""
@@ -80,7 +87,29 @@ class NativeOpBuilder:
         h.update(_compiler_fingerprint(self._cxx()).encode())
         return self.build_dir / f"lib_{self.NAME}_{h.hexdigest()[:12]}.so"
 
+    @staticmethod
+    def _writable_dir(d: Path) -> bool:
+        try:
+            d.mkdir(parents=True, exist_ok=True)
+        except OSError:
+            return False
+        return os.access(d, os.W_OK)
+
     def build(self) -> Path:
+        missing = [str(p) for p in self.absolute_sources() if not p.exists()]
+        if missing:
+            raise RuntimeError(
+                f"native op '{self.NAME}' sources not found: {missing}. "
+                "Non-editable installs do not ship csrc/ — install with "
+                "'pip install -e .' or set DS_TPU_CSRC_DIR to an unpacked "
+                "source tree."
+            )
+        if not self._explicit_build_dir and not self._writable_dir(self.build_dir):
+            # Default build dir can be read-only (checkout owned by another
+            # user / read-only editable install) — fall back to a user cache
+            # the way the reference falls back to TORCH_EXTENSIONS_DIR. An
+            # EXPLICIT dir (arg or DS_TPU_BUILD_DIR) is honored or errors.
+            self.build_dir = Path.home() / ".cache" / "deepspeed_tpu" / "ops"
         so = self._so_path()
         if so.exists():
             return so
