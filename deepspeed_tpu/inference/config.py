@@ -37,6 +37,7 @@ class QuantConfig(DeepSpeedConfigModel):
     bits: int = 8
     group_size: int = 128
     qtype: str = "int"  # 'int' (int8/int4 by bits) | 'fp' (fp8)
+    min_leaf_size: int = 1 << 16  # kernels smaller than this stay dense
 
 
 class ZeroInferenceConfig(DeepSpeedConfigModel):
@@ -44,8 +45,10 @@ class ZeroInferenceConfig(DeepSpeedConfigModel):
     forward (reference stage-3-for-inference + AIO, blogs/deepspeed-gds)."""
 
     enabled: bool = False
-    offload: str = "cpu"  # 'cpu' (pinned host memory) — nvme via swap_tensor
-    min_leaf_size: int = 1 << 16  # leaves smaller than this stay on device
+    offload: str = "cpu"  # 'cpu' (pinned host memory) | 'nvme' (AIO-streamed layers)
+    min_leaf_size: int = 1 << 16  # leaves smaller than this stay on device (cpu mode)
+    nvme_path: Optional[str] = None  # required for offload='nvme'
+    num_buffers: int = 2  # layers resident at once in nvme mode (double buffer)
 
 
 class InferenceConfig(DeepSpeedConfigModel):

@@ -228,6 +228,28 @@ def _logits(params, cfg: TransformerConfig, x):
 
 
 # ------------------------------------------------------------------ api
+def prefill_inputs(params, cfg: TransformerConfig, input_ids, prompt_mask):
+    """Shared pre-layer computation of the prefill path: embeddings, per-row
+    positions, and lengths (used by both the scan forward below and the
+    NVMe layer-streamed forward — one definition, no drift)."""
+    prompt_mask = prompt_mask.astype(jnp.bool_)
+    lengths = prompt_mask.sum(axis=1).astype(jnp.int32)
+    positions = jnp.where(prompt_mask, jnp.cumsum(prompt_mask, axis=1) - 1, 0).astype(jnp.int32)
+    x = _embed_tokens(params, cfg, input_ids)
+    return x, positions, lengths
+
+
+def decode_inputs(params, cfg: TransformerConfig, cache: KVCache, tokens):
+    """Shared pre-layer computation of the decode path: next-token embedding
+    (in cfg.dtype), positions, and the kv_mask with the new slot marked."""
+    positions = cache.lengths[:, None]  # [B,1]
+    x = jnp.take(params["embed"]["embedding"], tokens[:, None], axis=0).astype(cfg.dtype)
+    if cfg.position == "learned":
+        x = x + jnp.take(params["pos_embed"], positions, axis=0).astype(cfg.dtype)
+    kv_mask = jax.vmap(lambda m, i: m.at[i].set(True))(cache.kv_mask, cache.lengths)
+    return x, positions, kv_mask
+
+
 def prefill(
     params,
     cfg: TransformerConfig,
@@ -244,11 +266,8 @@ def prefill(
     if prompt_mask is None:
         prompt_mask = jnp.ones((B, S), jnp.bool_)
     prompt_mask = prompt_mask.astype(jnp.bool_)
-    lengths = prompt_mask.sum(axis=1).astype(jnp.int32)
-    positions = jnp.where(prompt_mask, jnp.cumsum(prompt_mask, axis=1) - 1, 0).astype(jnp.int32)
-
+    x, positions, lengths = prefill_inputs(params, cfg, input_ids, prompt_mask)
     kv_mask = jnp.zeros((B, cache.max_len), jnp.bool_).at[:, :S].set(prompt_mask)
-    x = _embed_tokens(params, cfg, input_ids)
     write_start = jnp.zeros((B,), jnp.int32)
     x, cache = _layer_stack(params, cfg, x, cache, positions, write_start, kv_mask)
     cache = cache._replace(kv_mask=kv_mask, lengths=lengths)
@@ -265,12 +284,7 @@ def decode_step(
 
     The generated token's position is ``cache.lengths`` (per row).
     """
-    B = tokens.shape[0]
-    positions = cache.lengths[:, None]  # [B,1]
-    x = jnp.take(params["embed"]["embedding"], tokens[:, None], axis=0).astype(cfg.dtype)
-    if cfg.position == "learned":
-        x = x + jnp.take(params["pos_embed"], positions, axis=0).astype(cfg.dtype)
-    kv_mask = jax.vmap(lambda m, i: m.at[i].set(True))(cache.kv_mask, cache.lengths)
+    x, positions, kv_mask = decode_inputs(params, cfg, cache, tokens)
     x, cache = _layer_stack(params, cfg, x, cache, positions, cache.lengths, kv_mask)
     cache = cache._replace(kv_mask=kv_mask, lengths=cache.lengths + 1)
     return _logits(params, cfg, x)[:, 0], cache

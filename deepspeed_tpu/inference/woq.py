@@ -57,23 +57,30 @@ class WOQTensor:
     ``dev_sharding`` (set when pinned-host resident) makes ``astype`` stream
     the (small) quantized bytes to device memory before dequantizing — the
     ZeRO-Inference + WOQ composition.
+
+    ``stacked`` marks a leaf quantized PER LEADING SLICE (the scan-layers
+    ``[L, ...]`` stack): quantization blocks never cross layer boundaries,
+    so ``lax.scan`` can slice the wrapper per layer (pytree children lose
+    the leading dim; the static ``_shape`` aux stays the full stacked
+    shape). ``astype`` tells the two states apart by the scale's rank.
     """
 
     def __init__(self, q: jax.Array, scale: jax.Array, fmt: str, shape: tuple,
-                 dev_sharding=None):
+                 dev_sharding=None, stacked: bool = False):
         self.q = q
         self.scale = scale
         self.fmt = fmt
         self._shape = tuple(shape)
         self.dev_sharding = dev_sharding
+        self.stacked = stacked
 
     # --- pytree protocol -------------------------------------------------
     def tree_flatten(self):
-        return (self.q, self.scale), (self.fmt, self._shape, self.dev_sharding)
+        return (self.q, self.scale), (self.fmt, self._shape, self.dev_sharding, self.stacked)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux[0], aux[1], aux[2])
+        return cls(children[0], children[1], aux[0], aux[1], aux[2], aux[3])
 
     # --- array-like surface the model reads ------------------------------
     @property
@@ -87,21 +94,32 @@ class WOQTensor:
             n *= d
         return n
 
+    def _dequant(self, q, scale, shape, dtype):
+        if self.fmt == "int8":
+            return dequantize_int8(q, scale, shape, dtype=dtype, block_size=_BLOCK)
+        if self.fmt == "int4":
+            return dequantize_int4(q, scale, dtype=dtype, block_size=_BLOCK).reshape(shape)
+        if self.fmt == "fp8":
+            return dequantize_fp8(q, scale, dtype=dtype, block_size=_BLOCK)
+        raise ValueError(f"unknown WOQ format {self.fmt!r}")
+
     def astype(self, dtype):
         q, scale = self.q, self.scale
         if self.dev_sharding is not None:
             q = _to_device(q, self.dev_sharding[0])
             scale = _to_device(scale, self.dev_sharding[1])
-        if self.fmt == "int8":
-            return dequantize_int8(q, scale, self._shape, dtype=dtype, block_size=_BLOCK)
-        if self.fmt == "int4":
-            return dequantize_int4(q, scale, dtype=dtype, block_size=_BLOCK).reshape(self._shape)
-        if self.fmt == "fp8":
-            return dequantize_fp8(q, scale, dtype=dtype, block_size=_BLOCK)
-        raise ValueError(f"unknown WOQ format {self.fmt!r}")
+        if not self.stacked:
+            return self._dequant(q, scale, self._shape, dtype)
+        per_shape = self._shape[1:]
+        if scale.ndim >= 2:
+            # full stacked read (dequantize_params / teacher-forcing path)
+            return jax.vmap(lambda qq, ss: self._dequant(qq, ss, per_shape, dtype))(q, scale)
+        # inside lax.scan: the wrapper was sliced to one layer
+        return self._dequant(q, scale, per_shape, dtype)
 
     def __repr__(self):
-        return f"WOQTensor({self.fmt}, shape={self._shape}, offloaded={self.dev_sharding is not None})"
+        return (f"WOQTensor({self.fmt}, shape={self._shape}, "
+                f"stacked={self.stacked}, offloaded={self.dev_sharding is not None})")
 
 
 @jax.tree_util.register_pytree_node_class
@@ -139,17 +157,22 @@ class OffloadedTensor:
         return f"OffloadedTensor(shape={self.x.shape})"
 
 
-def _quantize_leaf(x: jax.Array, fmt: str) -> WOQTensor:
+def _quantize_leaf(x: jax.Array, fmt: str, stacked: bool = False) -> WOQTensor:
     if fmt == "int8":
-        q, s = quantize_int8(x, block_size=_BLOCK)
-        return WOQTensor(q, s, "int8", x.shape)
-    if fmt == "int4":
-        q, s = quantize_int4(x, block_size=_BLOCK)
-        return WOQTensor(q, s, "int4", x.shape)
-    if fmt == "fp8":
-        q, s = quantize_fp8(x, block_size=_BLOCK)
-        return WOQTensor(q, s, "fp8", x.shape)
-    raise ValueError(f"unknown WOQ format {fmt!r} (int8/int4/fp8)")
+        fn = lambda v: quantize_int8(v, block_size=_BLOCK)  # noqa: E731
+    elif fmt == "int4":
+        fn = lambda v: quantize_int4(v, block_size=_BLOCK)  # noqa: E731
+    elif fmt == "fp8":
+        fn = lambda v: quantize_fp8(v, block_size=_BLOCK)  # noqa: E731
+    else:
+        raise ValueError(f"unknown WOQ format {fmt!r} (int8/int4/fp8)")
+    if stacked:
+        # per-layer quantization of a [L, ...] stack: blocks never span
+        # layers, so scan slicing stays valid (see WOQTensor.stacked)
+        q, s = jax.vmap(fn)(x)
+    else:
+        q, s = fn(x)
+    return WOQTensor(q, s, fmt, x.shape, stacked=stacked)
 
 
 def woq_format(quant_cfg) -> str:
@@ -172,18 +195,25 @@ def quantize_params(params: Any, fmt: str, min_size: int = 1 << 16) -> Any:
     reference WOQ also only swaps the large linears). Embeddings stay dense:
     the token-lookup (``jnp.take``) and tied-head (``.T``) sites consume the
     raw array, and the reference WOQ leaves nn.Embedding alone too.
+
+    Leaves under a stacked ``'layers'`` subtree (scan_layers layout) are
+    quantized per leading slice so ``lax.scan`` over the stack stays valid.
     """
 
     def leaf(path, x):
         if not isinstance(x, jax.Array) or not jnp.issubdtype(x.dtype, jnp.floating):
             return x
-        if "embed" in jax.tree_util.keystr(path):
+        key = jax.tree_util.keystr(path)
+        if "embed" in key:
             return x
         if x.ndim < 2 or x.size < min_size:
             return x
         if x.shape[-1] % 2 and fmt == "int4":
             return x  # odd trailing dim: leave dense
-        return _quantize_leaf(x, fmt)
+        stacked = "'layers'" in key
+        if stacked and x.ndim < 3:
+            return x  # a [L, n] stack quantizes per-row poorly; leave dense
+        return _quantize_leaf(x, fmt, stacked=stacked)
 
     return jax.tree_util.tree_map_with_path(leaf, params)
 
@@ -210,7 +240,8 @@ def offload_params(params: Any, min_size: int = 1 << 16) -> Any:
         if isinstance(x, WOQTensor):
             dev = (x.q.sharding.with_memory_kind("device"),
                    x.scale.sharding.with_memory_kind("device"))
-            return WOQTensor(host(x.q), host(x.scale), x.fmt, x.shape, dev_sharding=dev)
+            return WOQTensor(host(x.q), host(x.scale), x.fmt, x.shape,
+                             dev_sharding=dev, stacked=x.stacked)
         key = jax.tree_util.keystr(path)
         # only the matmul weights go behind the stream-on-read wrapper: norm
         # scales/biases are consumed raw (no .astype read site) and embeddings

@@ -66,9 +66,11 @@ class AsyncTensorSwapper:
             self.wait(tag)
 
     # ------------------------------------------------------------ swap in
-    def swap_in(self, tag: str, like: Any = None, device_put: bool = True) -> Any:
-        """Read the pytree stored under ``tag``; shardings taken from ``like``
-        when given (reference swap-in re-pins to the gpu buffers)."""
+    def swap_in_begin(self, tag: str) -> Any:
+        """Issue the async reads for ``tag``; returns an opaque token for
+        ``swap_in_end``. The double-buffered prefetch primitive (reference
+        ``partitioned_param_swapper`` prefetch path): begin layer l+1's reads
+        while the device computes layer l."""
         if tag not in self._meta:
             raise KeyError(f"no swapped state under tag {tag!r}")
         self.wait(tag)  # writes must be durable before reading
@@ -78,6 +80,12 @@ class AsyncTensorSwapper:
             buf = np.empty(shape, dtype=dtype)
             reqs.append(self.handle.async_pread(buf, fpath))
             bufs.append(buf)
+        return (treedef, bufs, reqs)
+
+    def swap_in_end(self, token: Any, like: Any = None, device_put: bool = True) -> Any:
+        """Block until the reads issued by ``swap_in_begin`` complete; returns
+        the pytree (device-placed per ``like``/``device_put``)."""
+        treedef, bufs, reqs = token
         for r in reqs:
             self.handle.wait(r)
         tree = jax.tree_util.tree_unflatten(treedef, bufs)
@@ -90,6 +98,11 @@ class AsyncTensorSwapper:
         elif device_put:
             tree = jax.tree_util.tree_map(jnp.asarray, tree)
         return tree
+
+    def swap_in(self, tag: str, like: Any = None, device_put: bool = True) -> Any:
+        """Read the pytree stored under ``tag``; shardings taken from ``like``
+        when given (reference swap-in re-pins to the gpu buffers)."""
+        return self.swap_in_end(self.swap_in_begin(tag), like=like, device_put=device_put)
 
     def wait(self, tag: str) -> None:
         for r in self._pending.pop(tag, []):

@@ -63,13 +63,16 @@ def test_int4_odd_dim_rejected():
 @pytest.mark.parametrize("quant", [{"bits": 8}, {"bits": 4}, {"qtype": "fp"}])
 def test_woq_generate_close_to_dense(quant, devices):
     dense = _engine()
-    woq = _engine(quant={"enabled": True, **quant})
+    woq = _engine(quant={"enabled": True, "min_leaf_size": 0, **quant})
     prompt = np.asarray([[7, 8, 9, 10]])
     ld = np.asarray(dense.forward(prompt), np.float32)
     lq = np.asarray(woq.forward(prompt), np.float32)
-    # logits drift bounded by quantization noise
+    # logits drift bounded by quantization noise. min_leaf_size=0 quantizes
+    # EVERY kernel of this tiny random-init model (2048-elem blocks over
+    # 64-wide layers), so the bound is loose; exact-token parity of the
+    # quantized path is pinned in test_zero_inference_nvme.py.
     denom = np.abs(ld).max()
-    tol = 0.25 if quant.get("bits") == 4 else 0.1
+    tol = 0.5 if quant.get("bits") == 4 else 0.2
     assert np.abs(lq - ld).max() / denom < tol
     out = woq.generate(prompt, max_new_tokens=4, do_sample=False)
     assert out.shape == (1, 8)
@@ -82,6 +85,21 @@ def test_woq_memory_shrinks(devices):
     dense_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params))
     q4 = quantize_params(params, "int4", min_size=0)
     assert woq_bytes(q4) < 0.45 * dense_bytes  # ~4x on the kernels, embed dense
+
+
+def test_woq_stacked_layers_survive_scan(devices):
+    """Real models quantize their stacked [L, ...] layer kernels: blocks must
+    not cross layer boundaries or lax.scan slicing breaks (engine generate
+    runs prefill/decode scans directly over the quantized tree)."""
+    from deepspeed_tpu.inference.woq import WOQTensor
+
+    woq = _engine(quant={"enabled": True, "bits": 8, "min_leaf_size": 0})
+    wq = woq.params["layers"]["attn"]["wq"]["kernel"]
+    assert isinstance(wq, WOQTensor) and wq.stacked
+    assert wq.q.shape[0] == CFG.num_layers  # scan-sliceable leading dim
+    assert wq.scale.ndim == 2
+    out = woq.generate(np.asarray([[7, 8, 9, 10]]), max_new_tokens=4, do_sample=False)
+    assert out.shape == (1, 8)
 
 
 def test_woq_tensor_is_pytree(devices):
@@ -113,7 +131,7 @@ def test_zero_inference_offload_generate(devices):
 
 
 def test_zero_inference_composes_with_woq(devices):
-    eng = _engine(quant={"enabled": True, "bits": 8},
+    eng = _engine(quant={"enabled": True, "bits": 8, "min_leaf_size": 0},
                   zero_inference={"enabled": True, "min_leaf_size": 0})
     out = eng.generate(np.asarray([[3, 4, 5]]), max_new_tokens=3, do_sample=False)
     assert out.shape == (1, 6)

@@ -1,0 +1,96 @@
+"""ZeRO-Inference NVMe weight streaming (round-3 verdict item 5).
+
+Reference: ZeRO-Inference stage-3 + AIO path
+(``runtime/swap_tensor/partitioned_param_swapper.py:37``,
+``inference/config.py``) — serve models larger than host RAM by streaming
+layer weights from disk through the decode loop.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+CFG = TransformerConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_layers=4, num_heads=4, max_seq_len=128, dtype=jnp.float32)
+
+
+def _params():
+    module = CausalLM(CFG)
+    batch = {"input_ids": jnp.zeros((1, 8), jnp.int32)}
+    return module.init({"params": jax.random.PRNGKey(0)}, batch, train=False)["params"]
+
+
+def _engine(**cfg_over):
+    cfg = {"dtype": "float32", "seq_bucket": 16, "max_out_tokens": 64, **cfg_over}
+    return deepspeed_tpu.init_inference(CFG, params=_params(), config=cfg)
+
+
+def _nvme_engine(tmp_path, **extra):
+    return _engine(zero_inference={"enabled": True, "offload": "nvme",
+                                   "nvme_path": str(tmp_path)}, **extra)
+
+
+def test_nvme_generate_matches_resident(tmp_path, devices):
+    """Greedy generation through disk-streamed layers == fully resident."""
+    dense = _engine()
+    nvme = _nvme_engine(tmp_path)
+    prompt = np.arange(1, 13, dtype=np.int32)[None, :]
+    want = dense.generate(prompt, max_new_tokens=8, do_sample=False)
+    got = nvme.generate(prompt, max_new_tokens=8, do_sample=False)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_nvme_generate_matches_resident_sampled_eos(tmp_path, devices):
+    """Same rng path: sampled tokens + eos early-stop behave identically."""
+    dense = _engine()
+    nvme = _nvme_engine(tmp_path)
+    prompt = np.arange(3, 11, dtype=np.int32)[None, :].repeat(2, 0)
+    kw = dict(max_new_tokens=6, do_sample=True, temperature=0.8, top_k=20,
+              eos_token_id=5, pad_token_id=0, seed=7)
+    np.testing.assert_array_equal(nvme.generate(prompt, **kw),
+                                  dense.generate(prompt, **kw))
+
+
+def test_nvme_ram_budget_is_num_buffers_layers(tmp_path, devices):
+    """At most num_buffers layer trees are materialized at once — the whole
+    point of the mode (weights bigger than host RAM)."""
+    nvme = _nvme_engine(tmp_path)
+    streamed = nvme._streamed.p
+    assert streamed.num_layers == CFG.num_layers
+    prompt = np.arange(1, 9, dtype=np.int32)[None, :]
+    nvme.generate(prompt, max_new_tokens=4, do_sample=False)
+    assert len(streamed._ready) <= streamed.num_buffers
+    assert not streamed._inflight or len(streamed._inflight) <= 1
+
+
+def test_nvme_composes_with_woq(tmp_path, devices):
+    """int8-quantized layer weights stream from disk (4x less disk traffic);
+    output matches the quant-only resident engine."""
+    woq = _engine(quant={"enabled": True, "bits": 8, "min_leaf_size": 0})
+    nvme = _nvme_engine(tmp_path, quant={"enabled": True, "bits": 8, "min_leaf_size": 0})
+    # the streamed layer files hold the QUANTIZED bytes
+    from deepspeed_tpu.inference.woq import WOQTensor
+
+    tok = nvme._streamed.p.swapper.swap_in("layer_0", device_put=False)
+    assert any(isinstance(x, WOQTensor)
+               for x in jax.tree_util.tree_leaves(
+                   tok, is_leaf=lambda x: isinstance(x, WOQTensor)))
+    prompt = np.arange(1, 9, dtype=np.int32)[None, :]
+    want = woq.generate(prompt, max_new_tokens=6, do_sample=False)
+    got = nvme.generate(prompt, max_new_tokens=6, do_sample=False)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_nvme_requires_path(devices):
+    with pytest.raises(ValueError, match="nvme_path"):
+        _engine(zero_inference={"enabled": True, "offload": "nvme"})
+
+
+def test_nvme_forward_raises_clearly(tmp_path, devices):
+    nvme = _nvme_engine(tmp_path)
+    with pytest.raises(NotImplementedError, match="generate"):
+        nvme.forward(np.ones((1, 8), np.int32))
