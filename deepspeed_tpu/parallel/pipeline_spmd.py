@@ -175,8 +175,17 @@ def spmd_pipeline_interleaved(
     rng: jax.Array,
     virtual: int,
     side_stream: Any = None,
+    chunk_remat: bool = True,
 ) -> Any:
     """Interleaved (virtual-stage) pipeline: bubble shrinks by ``virtual``.
+
+    ``chunk_remat`` (default on) wraps the per-tick (chunk-select + stage)
+    in ``jax.checkpoint``: the dynamic chunk gather would otherwise be a
+    per-tick residual — the FULL chunk's bytes saved every tick, O(T x
+    chunk) HBM — while under checkpoint the gather is recomputed in the
+    backward from the scan-invariant local stack (saved once). The cost is
+    one extra stage forward in the backward, i.e. exactly standard remat;
+    set False only for small models where activation memory is free.
 
     Megatron-style interleaving the reference does NOT have (its
     ``TrainSchedule`` is plain 1F1B): each device owns ``virtual`` chunks of
@@ -227,6 +236,16 @@ def spmd_pipeline_interleaved(
     call_stage = _make_call_stage(stage_fn, side_stream)
     side_at = _make_side_at(M)
 
+    def chunked_call(local, c, x, side, r):
+        chunk = jax.tree_util.tree_map(
+            lambda p: lax.dynamic_index_in_dim(p, c, 0, keepdims=False), local)
+        return call_stage(chunk, x, side, r)
+
+    if chunk_remat:
+        # See docstring: keeps the per-tick residual at the boundary carry
+        # (x) instead of the gathered chunk's full bytes.
+        chunked_call = jax.checkpoint(chunked_call, prevent_cse=False)
+
     T = M * V + S - 1
     perm = [(j, (j + 1) % S) for j in range(S)]
 
@@ -252,11 +271,9 @@ def spmd_pipeline_interleaved(
             m_safe = jnp.clip(m, 0, M - 1)
             mb = jax.tree_util.tree_map(lambda v: v[m_safe], stream)
             x = jax.tree_util.tree_map(lambda a, b: jnp.where(ingest, a, b), mb, recv)
-            chunk = jax.tree_util.tree_map(
-                lambda p: lax.dynamic_index_in_dim(p, jnp.clip(c, 0, V - 1), 0,
-                                                   keepdims=False), local)
             side = side_at(side_stream, m_safe) if side_stream is not None else None
-            y = call_stage(chunk, x, side, jax.random.fold_in(rng, t))
+            y = chunked_call(local, jnp.clip(c, 0, V - 1), x, side,
+                             jax.random.fold_in(rng, t))
             out_buf = jax.tree_util.tree_map(
                 lambda buf, yv: jnp.where(
                     commit,

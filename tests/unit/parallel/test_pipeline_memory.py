@@ -1,0 +1,88 @@
+"""Compiled pipeline memory contract (round-3 verdict item 6).
+
+``pipeline_spmd.py`` claims fill-drain + remat matches 1F1B's steady-state
+activation memory (reference ``runtime/pipe/schedule.py:189``
+``num_pipe_buffers``). The host-side simulator checks the 1F1B buffer bound;
+THIS test pins the production path: compile the full fwd+bwd at M >> S and
+assert the per-microbatch temp-memory slope tracks the O(1) boundary carry,
+not the O(layers x activations) internal state a scan that saved everything
+would keep.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.parallel.pipeline_spmd import (
+    spmd_pipeline,
+    spmd_pipeline_interleaved,
+)
+from deepspeed_tpu.topology.mesh import build_mesh
+
+H, L, B = 64, 8, 4
+PP, DP = 4, 2
+
+
+def _make_stage(remat):
+    def stage(params, x, rng):
+        def layer(x, w):
+            return jax.nn.gelu(x @ w), None
+
+        f = lambda x: jax.lax.scan(layer, x, params)[0]  # noqa: E731
+        return (jax.checkpoint(f) if remat else f)(x)
+
+    return stage
+
+
+def _temp_bytes(mesh, M, remat, virtual=1):
+    params = jax.random.normal(jax.random.PRNGKey(0), (L, H, H)) * 0.1
+    stream = jnp.ones((M, B, H))
+
+    def loss(p):
+        if virtual > 1:
+            out = spmd_pipeline_interleaved(
+                _make_stage(remat), p, stream, mesh=mesh,
+                rng=jax.random.PRNGKey(1), virtual=virtual)
+        else:
+            out = spmd_pipeline(_make_stage(remat), p, stream, mesh=mesh,
+                                rng=jax.random.PRNGKey(1))
+        return (out ** 2).sum()
+
+    comp = jax.jit(jax.grad(loss)).lower(params).compile()
+    return comp.memory_analysis().temp_size_in_bytes
+
+
+@pytest.mark.parametrize("virtual", [1, 2])
+def test_pipeline_activation_memory_is_o_of_stages_not_microbatches(devices, virtual):
+    """Slope of temp bytes per extra microbatch must be a small multiple of
+    the boundary carry (stream slice + ppermute buffers), NOT the per-tick
+    internal activations — rematerialization is what the 1F1B-parity memory
+    claim rests on, and a remat regression would only show up here."""
+    mesh = build_mesh(axis_sizes={"pp": PP, "dp": DP})
+    m_lo, m_hi = 8, 32
+    t_lo = _temp_bytes(mesh, m_lo, remat=True, virtual=virtual)
+    t_hi = _temp_bytes(mesh, m_hi, remat=True, virtual=virtual)
+    slope = (t_hi - t_lo) / (m_hi - m_lo)
+
+    # Boundary carry: one [B, H] fp32 slab (the stream rides the shard_map
+    # replicated — in_specs P() — so it is NOT dp-sharded). The slope budget
+    # allows the stream copies the schedule legitimately makes (padded input,
+    # output buffer, their cotangents, ppermute staging) but NOT the ~L/S
+    # layers' worth of saved intermediates per tick.
+    carry = B * H * 4
+    assert slope < 8 * carry, (
+        f"temp slope {slope:.0f} B/microbatch exceeds {8 * carry} — the scan "
+        "is holding per-tick internal activations (remat contract broken)")
+
+
+def test_pipeline_memory_positive_control_without_remat(devices):
+    """The measurement itself must be able to see the failure: without
+    jax.checkpoint the slope MUST blow past the rematted slope."""
+    mesh = build_mesh(axis_sizes={"pp": PP, "dp": DP})
+    m_lo, m_hi = 8, 32
+    slope_remat = (_temp_bytes(mesh, m_hi, True) - _temp_bytes(mesh, m_lo, True)) / (m_hi - m_lo)
+    slope_full = (_temp_bytes(mesh, m_hi, False) - _temp_bytes(mesh, m_lo, False)) / (m_hi - m_lo)
+    assert slope_full > 2 * slope_remat, (
+        f"positive control failed: no-remat slope {slope_full:.0f} should far "
+        f"exceed rematted slope {slope_remat:.0f} — memory_analysis may have "
+        "stopped reflecting live buffers")
