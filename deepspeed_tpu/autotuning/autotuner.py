@@ -211,6 +211,16 @@ class Autotuner:
             self.model_factory(**self.best_overrides) if self.best_overrides else self.model_spec
         )
         best_config = {k: v for k, v in best.config.items() if k != "_model_overrides"}
+        if self.best_overrides:
+            # The winning configuration includes MODEL-level overrides that the
+            # returned config cannot carry: a caller who re-initializes with
+            # their original model spec silently runs a non-winning model.
+            logger.warning(
+                "autotuner: best config includes model overrides %s — pass "
+                "tuner.best_model_spec (NOT your original model spec) to "
+                "initialize(), or the tuned model-level knobs are lost",
+                self.best_overrides,
+            )
         log_dist(
             f"autotuner: best stage={best.config['zero_optimization']['stage']} "
             f"micro={best.config['train_micro_batch_size_per_gpu']} "

@@ -9,6 +9,18 @@ kills the generation, drops the failed host, asks
 triple at the surviving world size, and relaunches — up to
 ``max_restarts`` generations. State continuity comes from the framework's
 checkpoint/resume (universal checkpoints load under any world size).
+
+Semantics gap vs the reference (deliberate, documented): torch-elastic's
+agent re-forms the process group IN PLACE via a rendezvous barrier — ranks
+of a surviving generation re-join without the script exiting. Here a
+generation change always goes through full process relaunch +
+checkpoint-resume, because a jax.distributed world (and every compiled
+program's mesh) is fixed at initialization: XLA binds collectives to the
+topology at compile time, so "the same training step at world-1" is a NEW
+program either way. Relaunch makes that explicit and keeps the recovery
+path identical to the cold-start path (one code path, always exercised).
+The cost is generation-restart latency = process spawn + resume, vs
+torch-elastic's in-process re-rendezvous.
 """
 
 from __future__ import annotations

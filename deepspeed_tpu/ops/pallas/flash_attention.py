@@ -91,18 +91,42 @@ def _causal_keep(qi, ki, shape, block_q, block_k):
     return cols <= rows
 
 
+# The squashed grids ship their (qi, ki) enumeration as scalar-prefetch SMEM
+# arrays of n(n+1)/2 entries. Past this cap the SMEM cost outweighs the
+# skipped above-diagonal DMAs and the wrappers fall back to the dense causal
+# grid (which skips the same compute via block classes, just not the DMAs).
+# At block 512 this covers sequences up to ~90k tokens per device.
+_MAX_SQUASHED_CELLS = 16384
+
+
+def _squash_ok(nq: int, nk: int, block_q: int, block_k: int, causal: bool) -> bool:
+    return (causal and block_q == block_k and nq == nk
+            and nq * (nq + 1) // 2 <= _MAX_SQUASHED_CELLS)
+
+
 def _tri_maps(n: int):
     """Row-major lower-triangle enumeration: for each query row qi, the active
     key columns ki in [0, qi]. The causal grid runs ONLY these n(n+1)/2 cells
-    (vs n^2): above-diagonal cells would DMA K/V and then skip all compute."""
-    qs, ks = zip(*[(qi, ki) for qi in range(n) for ki in range(qi + 1)])
+    (vs n^2): above-diagonal cells would DMA K/V and then skip all compute.
+    Pure arange arithmetic — no O(n^2) Python pair list at trace time."""
+    import numpy as np
+
+    counts = np.arange(1, n + 1)
+    qs = np.repeat(np.arange(n), counts)
+    starts = np.repeat(np.cumsum(counts) - counts, counts)
+    ks = np.arange(qs.size) - starts
     return jnp.asarray(qs, jnp.int32), jnp.asarray(ks, jnp.int32)
 
 
 def _wedge_maps(n: int):
     """Column-major enumeration of the same triangle: for each key column ki,
     the query rows qi in [ki, n-1] contiguously (dk/dv accumulate per column)."""
-    qs, ks = zip(*[(qi, ki) for ki in range(n) for qi in range(ki, n)])
+    import numpy as np
+
+    counts = np.arange(n, 0, -1)
+    ks = np.repeat(np.arange(n), counts)
+    starts = np.repeat(np.cumsum(counts) - counts, counts)
+    qs = (np.arange(ks.size) - starts) + ks
     return jnp.asarray(qs, jnp.int32), jnp.asarray(ks, jnp.int32)
 
 
@@ -229,7 +253,7 @@ def _flash_fwd(q, k, v, mask, block_q: int, block_k: int, causal: bool, masked: 
     Hkv = k.shape[1]
     G = H // Hkv
     nq, nk = _cdiv(S, block_q), _cdiv(S, block_k)
-    squashed = causal and block_q == block_k and nq == nk
+    squashed = _squash_ok(nq, nk, block_q, block_k, causal)
 
     out_shape = [
         jax.ShapeDtypeStruct((B, H, S, D), q.dtype, vma=_vma(q, k, v, mask)),
@@ -409,7 +433,7 @@ def _flash_bwd(q, k, v, mask, out, lse, do, block_q: int, block_k: int, causal: 
     Hkv = k.shape[1]
     G = H // Hkv
     nq, nk = _cdiv(S, block_q), _cdiv(S, block_k)
-    squashed = causal and block_q == block_k and nq == nk
+    squashed = _squash_ok(nq, nk, block_q, block_k, causal)
 
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,S]
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))

@@ -116,6 +116,13 @@ class ScheduleExecutor:
             tick += 1
             if tick > 4 * (M + S) + 8:
                 raise RuntimeError("schedule did not terminate (deadlock?)")
+            # Sends issued during this tick are buffered and published only
+            # after EVERY stage has processed the tick: with stages advanced
+            # in ascending order, stage s's send would otherwise be visible
+            # to stage s+1's recv within the SAME tick — laxer than real
+            # one-tick p2p latency, letting a schedule pass here yet deadlock
+            # on real async sends (round-3 advice, pipe_executor.py:120).
+            pending_sends: List[Tuple[deque, Tuple[int, Any]]] = []
             for s in range(S):
                 if done[s]:
                     continue
@@ -150,7 +157,7 @@ class ScheduleExecutor:
                             outbox[(s, cur_mb)] = seed
                     elif isinstance(cmd, SendActivation):
                         mb = buffers[s].get(cmd.buffer_id)
-                        act_q[s + 1].append((mb, outbox.pop((s, mb))))
+                        pending_sends.append((act_q[s + 1], (mb, outbox.pop((s, mb)))))
                     elif isinstance(cmd, RecvGrad):
                         if not grad_q[s]:
                             raise RuntimeError(
@@ -164,13 +171,15 @@ class ScheduleExecutor:
                         gx = bwd(s, cur_mb, cmd.buffer_id, cur_g)
                         cur_g = gx
                     elif isinstance(cmd, SendGrad):
-                        grad_q[s - 1].append((cur_mb, cur_g))
+                        pending_sends.append((grad_q[s - 1], (cur_mb, cur_g)))
                     elif isinstance(cmd, (ReduceGrads, ReduceTiedGrads)):
                         pass  # dp reduction — single-replica simulation
                     elif isinstance(cmd, OptimizerStep):
                         optimizer_stepped[s] = True
                     else:
                         raise RuntimeError(f"unknown instruction {cmd!r}")
+            for queue, item in pending_sends:
+                queue.append(item)
 
         if any(c != M for c in fwd_count) or any(c != M for c in bwd_count):
             raise RuntimeError(
