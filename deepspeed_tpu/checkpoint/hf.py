@@ -192,9 +192,55 @@ def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
             parallel_block=True,
             tie_embeddings=bool(hf_config.get("tie_word_embeddings", False)),
         )
+    if mt == "gpt_neox":
+        h = hf_config["hidden_size"]
+        heads = hf_config["num_attention_heads"]
+        act = hf_config.get("hidden_act", "gelu")
+        if act not in ("gelu", "gelu_new", "relu"):
+            raise ValueError(f"unsupported gpt_neox hidden_act {act!r}")
+        parallel = bool(hf_config.get("use_parallel_residual", True))
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=heads,
+            max_seq_len=hf_config.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            # HF ACT2FN 'gelu' is the exact erf gelu; 'gelu_new' the tanh form
+            activation={"gelu": "gelu_exact", "gelu_new": "gelu", "relu": "relu"}[act],
+            position="rope",
+            rope_theta=float(hf_config.get("rotary_emb_base", 10000.0)),
+            # neox ropes only the first rotary_pct of each head
+            rotary_dim=int(hf_config.get("rotary_pct", 0.25) * (h // heads)),
+            norm_eps=float(hf_config.get("layer_norm_eps", 1e-5)),
+            qkv_bias=True,
+            dense_bias=True,
+            parallel_block=parallel,
+            parallel_mlp_norm=parallel,  # neox parallel uses ln2 for the MLP
+            tie_embeddings=bool(hf_config.get("tie_word_embeddings", False)),
+        )
+    if mt == "bloom":
+        h = hf_config.get("hidden_size") or hf_config.get("n_embed")
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=4 * h,
+            num_layers=hf_config.get("num_hidden_layers") or hf_config.get("n_layer"),
+            num_heads=hf_config.get("num_attention_heads") or hf_config.get("n_head"),
+            max_seq_len=hf_config.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation="gelu",  # bloom uses the tanh-approx gelu
+            position="alibi",
+            norm_eps=float(hf_config.get("layer_norm_epsilon", 1e-5)),
+            qkv_bias=True,
+            dense_bias=True,
+            embed_norm=True,  # word_embeddings_layernorm
+            tie_embeddings=True,  # bloom always ties lm_head to embeddings
+        )
     raise ValueError(
         f"unsupported HF model_type {mt!r} "
-        "(supported: llama/mistral/mixtral/qwen2/gpt2/opt/falcon/phi)")
+        "(supported: llama/mistral/mixtral/qwen2/gpt2/opt/falcon/phi/gpt_neox/bloom)")
 
 
 def detect_family(state: Dict[str, np.ndarray]) -> str:
@@ -203,6 +249,10 @@ def detect_family(state: Dict[str, np.ndarray]) -> str:
         return "mixtral"
     if any("decoder.embed_positions" in k for k in keys) and not any("encoder." in k for k in keys):
         return "opt"
+    if any("word_embeddings_layernorm" in k for k in keys):
+        return "bloom"
+    if any("attention.query_key_value" in k and "self_attention" not in k for k in keys):
+        return "gpt_neox"
     if any("self_attention.query_key_value" in k for k in keys):
         return "falcon"
     if any("self_attn.dense.weight" in k for k in keys):
@@ -445,6 +495,82 @@ def _convert_phi(state, cfg: TransformerConfig) -> Dict[str, Any]:
     return params
 
 
+def _split_fused_qkv_per_head(w, b, H, Hkv, hd, h):
+    """Split a per-head-interleaved fused QKV (gpt-neox/bloom pattern —
+    reference ``module_inject/fusedqkv_utils.py:29`` ``prepare_tp_fused_qkvw``
+    'glmtype'/'bloomtype' orderings): rows are [head0: q,k,v | head1: ...].
+    Returns the attn param subtree in flax orientation."""
+    if H != Hkv:
+        raise ValueError("per-head fused QKV with GQA is not a pattern these families use")
+    wr = w.reshape(H, 3, hd, h)
+    attn = {
+        "wq": {"kernel": wr[:, 0].reshape(H * hd, h).T.reshape(h, H, hd)},
+        "wk": {"kernel": wr[:, 1].reshape(H * hd, h).T.reshape(h, H, hd)},
+        "wv": {"kernel": wr[:, 2].reshape(H * hd, h).T.reshape(h, H, hd)},
+    }
+    if b is not None:
+        br = b.reshape(H, 3, hd)
+        attn["wq"]["bias"] = br[:, 0]
+        attn["wk"]["bias"] = br[:, 1]
+        attn["wv"]["bias"] = br[:, 2]
+    return attn
+
+
+def _neox_style_layers(state, cfg: TransformerConfig, g, layer_prefix: str,
+                       attn_prefix: str) -> Dict[str, Any]:
+    """Shared layer conversion for the gpt-neox/bloom graph (per-head fused
+    QKV, biased dense/MLP, two layernorms); only key prefixes differ."""
+    h, hd, H = cfg.hidden_size, cfg.dims_per_head, cfg.num_heads
+
+    def layer(i):
+        p = layer_prefix.format(i)
+        a = p + attn_prefix
+        attn = _split_fused_qkv_per_head(
+            g(a + "query_key_value.weight"), g(a + "query_key_value.bias"),
+            H, H, hd, h)
+        attn["wo"] = {"kernel": g(a + "dense.weight").T.reshape(H, hd, h),
+                      "bias": g(a + "dense.bias")}
+        return {
+            "attn_norm": {"scale": g(p + "input_layernorm.weight"),
+                          "bias": g(p + "input_layernorm.bias")},
+            "mlp_norm": {"scale": g(p + "post_attention_layernorm.weight"),
+                         "bias": g(p + "post_attention_layernorm.bias")},
+            "attn": attn,
+            "mlp": {
+                "w_up": {"kernel": g(p + "mlp.dense_h_to_4h.weight").T,
+                         "bias": g(p + "mlp.dense_h_to_4h.bias")},
+                "w_down": {"kernel": g(p + "mlp.dense_4h_to_h.weight").T,
+                           "bias": g(p + "mlp.dense_4h_to_h.bias")},
+            },
+        }
+
+    return _stack(layer, cfg.num_layers)
+
+
+def _convert_gpt_neox(state, cfg: TransformerConfig) -> Dict[str, Any]:
+    g = _getter(state, ("gpt_neox.", ""))
+    params: Dict[str, Any] = {
+        "embed": {"embedding": g("embed_in.weight")},
+        "final_norm": {"scale": g("final_layer_norm.weight"),
+                       "bias": g("final_layer_norm.bias")},
+        "layers": _neox_style_layers(state, cfg, g, "layers.{}.", "attention."),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": np.asarray(state["embed_out.weight"]).T}
+    return params
+
+
+def _convert_bloom(state, cfg: TransformerConfig) -> Dict[str, Any]:
+    g = _getter(state, ("transformer.", ""))
+    return {
+        "embed": {"embedding": g("word_embeddings.weight")},
+        "embed_norm": {"scale": g("word_embeddings_layernorm.weight"),
+                       "bias": g("word_embeddings_layernorm.bias")},
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+        "layers": _neox_style_layers(state, cfg, g, "h.{}.", "self_attention."),
+    }
+
+
 _CONVERTERS = {
     "llama": _convert_llama,
     "mistral": _convert_llama,
@@ -454,6 +580,8 @@ _CONVERTERS = {
     "opt": _convert_opt,
     "falcon": _convert_falcon,
     "phi": _convert_phi,
+    "gpt_neox": _convert_gpt_neox,
+    "bloom": _convert_bloom,
 }
 
 

@@ -137,14 +137,16 @@ def _moe(lp, cfg: TransformerConfig, x):
     return out.reshape(B, S, M)
 
 
-def _cached_attention(q, ck, cv, kv_mask, q_positions):
+def _cached_attention(q, ck, cv, kv_mask, q_positions, alibi=None):
     """GQA attention of new queries against the full cache.
 
     q: [B,S,H,hd]; ck/cv: [B,maxS,kvH,hd]; kv_mask: [B,maxS] valid slots;
     q_positions: [B,S] global position of each query. Causality: query at
     position p sees cache slot t iff slot_pos(t) <= p; because slots are
     written in position order, slot index == position, so the mask is
-    ``t <= q_positions`` ∧ kv_mask.
+    ``t <= q_positions`` ∧ kv_mask. ``alibi``: per-head slopes [H]; slot
+    index == position, so the bias is slopes * t (HF bloom convention —
+    softmax cancels the per-row offset vs slopes*(t-p)).
     """
     B, S, H, hd = q.shape
     kvH = ck.shape[2]
@@ -153,6 +155,9 @@ def _cached_attention(q, ck, cv, kv_mask, q_positions):
     scores = jnp.einsum("bskgd,btkd->bkgst", qg, ck).astype(jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(hd))
     t_idx = jnp.arange(ck.shape[1])
+    if alibi is not None:
+        scores = scores + (alibi.reshape(kvH, G)[None, :, :, None, None]
+                           * t_idx.astype(jnp.float32)[None, None, None, None, :])
     ok = (t_idx[None, None, :] <= q_positions[:, :, None]) & kv_mask[:, None, :]
     scores = jnp.where(ok[:, None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
@@ -168,19 +173,27 @@ def _block_step(lp, cfg: TransformerConfig, x, ck, cv, kv_mask, positions, write
     """
     h = _apply_norm(lp["attn_norm"], cfg, x)
     q, k, v = _qkv(lp["attn"], cfg, h)
+    alibi = None
     if cfg.position == "rope":
         from deepspeed_tpu.models.transformer import apply_qk_rope
 
         q, k = apply_qk_rope(cfg, q, k, positions)
+    elif cfg.position == "alibi":
+        from deepspeed_tpu.models.transformer import alibi_slopes
+
+        alibi = alibi_slopes(cfg.num_heads)
 
     # merge new K/V into cache at per-row write offsets
     ck = _write_cache(ck, k.astype(ck.dtype), write_start)
     cv = _write_cache(cv, v.astype(cv.dtype), write_start)
-    ctx = _cached_attention(q, ck, cv, kv_mask, positions)
+    ctx = _cached_attention(q, ck, cv, kv_mask, positions, alibi=alibi)
     attn_out = _attn_out(lp["attn"], cfg, ctx)
 
     if cfg.parallel_block:
-        # falcon-style: attn and FFN both read the shared input norm `h`
+        # falcon-style: attn and FFN both read the shared input norm `h`;
+        # gpt-neox-style (parallel_mlp_norm): FFN reads its own norm of x
+        if cfg.parallel_mlp_norm:
+            h = _apply_norm(lp["mlp_norm"], cfg, x)
         ffn = _moe(lp["moe"], cfg, h) if cfg.num_experts > 0 else _mlp(lp["mlp"], cfg, h)
         return x + attn_out + ffn, ck, cv
     x = x + attn_out
@@ -244,6 +257,8 @@ def decode_inputs(params, cfg: TransformerConfig, cache: KVCache, tokens):
     (in cfg.dtype), positions, and the kv_mask with the new slot marked."""
     positions = cache.lengths[:, None]  # [B,1]
     x = jnp.take(params["embed"]["embedding"], tokens[:, None], axis=0).astype(cfg.dtype)
+    if cfg.embed_norm:
+        x = _apply_norm(params["embed_norm"], cfg, x)
     if cfg.position == "learned":
         x = x + jnp.take(params["pos_embed"], positions, axis=0).astype(cfg.dtype)
     kv_mask = jax.vmap(lambda m, i: m.at[i].set(True))(cache.kv_mask, cache.lengths)

@@ -64,7 +64,7 @@ from deepspeed_tpu.ops.registry import dispatch, register
 
 @register("paged_attention", "xla")
 def _xla_paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block_size,
-                         new_lens=None):
+                         new_lens=None, alibi_slopes=None):
     """Masked GQA attention of new queries against paged caches (dense-gather
     fallback; the Pallas flash-decode kernel in
     ``ops/pallas/paged_attention.py`` wins dispatch on TPU).
@@ -84,6 +84,11 @@ def _xla_paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block
     scores = jnp.einsum("nckgd,ntkd->nkgct", qg, ck).astype(jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(hd))
     t_idx = jnp.arange(P * block_size)
+    if alibi_slopes is not None:
+        # slot index within the gathered view == global position, so the
+        # bloom convention slopes * key-position applies directly
+        scores = scores + (alibi_slopes.reshape(kvH, G)[None, :, :, None, None]
+                           * t_idx.astype(jnp.float32)[None, None, None, None, :])
     ok = t_idx[None, None, :] <= q_positions[:, :, None]  # causal over positions
     scores = jnp.where(ok[:, None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
@@ -92,9 +97,15 @@ def _xla_paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block
 
 
 def paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block_size,
-                    new_lens=None, impl: str = "auto"):
+                    new_lens=None, impl: str = "auto", alibi_slopes=None):
     import deepspeed_tpu.ops.pallas.paged_attention  # noqa: F401  (registers the kernel)
 
+    if alibi_slopes is not None:
+        # the Pallas flash-decode kernel has no slope-bias path yet: alibi
+        # rides the XLA gather fallback (same routing as ops/attention.py)
+        return _xla_paged_attention(
+            q, pool_k_l, pool_v_l, block_tables, q_positions, block_size,
+            new_lens=new_lens, alibi_slopes=alibi_slopes)
     return dispatch("paged_attention", impl)(
         q, pool_k_l, pool_v_l, block_tables, q_positions, block_size, new_lens=new_lens
     )
@@ -124,8 +135,15 @@ def ragged_forward(
     flat_slot = slot.reshape(-1)
 
     x = jnp.take(params["embed"]["embedding"], tokens, axis=0).astype(cfg.dtype)
+    if cfg.embed_norm:
+        x = _apply_norm(params["embed_norm"], cfg, x)
     if cfg.position == "learned":
         x = x + jnp.take(params["pos_embed"], positions, axis=0).astype(cfg.dtype)
+    alibi = None
+    if cfg.position == "alibi":
+        from deepspeed_tpu.models.transformer import alibi_slopes
+
+        alibi = alibi_slopes(cfg.num_heads)
 
     if "layers" not in params:
         raise ValueError("ragged inference requires scan_layers=True stacked params")
@@ -141,11 +159,14 @@ def ragged_forward(
         kvH, hd = k.shape[-2], k.shape[-1]
         pk = pk.at[flat_slot].set(k.astype(pk.dtype).reshape(-1, kvH, hd), mode="drop")
         pv = pv.at[flat_slot].set(v.astype(pv.dtype).reshape(-1, kvH, hd), mode="drop")
-        ctx = paged_attention(q, pk, pv, block_tables, positions, bs, new_lens=new_lens)
+        ctx = paged_attention(q, pk, pv, block_tables, positions, bs,
+                              new_lens=new_lens, alibi_slopes=alibi)
         attn_out = _attn_out(lp["attn"], cfg, ctx)
         if cfg.parallel_block:
-            # falcon/phi-style: attn and FFN both read the shared input norm
-            ffn = _moe(lp["moe"], cfg, h) if cfg.num_experts > 0 else _mlp(lp["mlp"], cfg, h)
+            # falcon/phi-style: attn and FFN read the shared input norm;
+            # gpt-neox-style (parallel_mlp_norm): FFN reads its own ln2(x)
+            ffn_in = _apply_norm(lp["mlp_norm"], cfg, x) if cfg.parallel_mlp_norm else h
+            ffn = _moe(lp["moe"], cfg, ffn_in) if cfg.num_experts > 0 else _mlp(lp["mlp"], cfg, ffn_in)
             return x + attn_out + ffn, (pk, pv)
         x = x + attn_out
         h = _apply_norm(lp["mlp_norm"], cfg, x)

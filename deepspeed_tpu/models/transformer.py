@@ -51,8 +51,13 @@ class TransformerConfig:
     # Falcon-7B-style parallel residual: attn and MLP both read ONE shared
     # input layernorm and add into the residual in parallel.
     parallel_block: bool = False
-    position: str = "rope"  # rope | learned
+    # GPT-NeoX-style parallel residual: like parallel_block but the MLP reads
+    # its OWN norm of the block input (x + attn(ln1(x)) + mlp(ln2(x))).
+    parallel_mlp_norm: bool = False
+    position: str = "rope"  # rope | learned | alibi (bloom-style score biases)
     rope_theta: float = 500000.0
+    # Bloom-style LayerNorm applied to the token embeddings before layer 0.
+    embed_norm: bool = False
     # Partial rotary (phi-style): rope only the first rotary_dim of head_dim.
     rotary_dim: Optional[int] = None
     # lm_head bias (phi-style untied head); disables the fused-CE path.
@@ -220,6 +225,21 @@ def apply_qk_rope(cfg: "TransformerConfig", q, k, positions):
     return apply_rope(q, cos, sin, positions), apply_rope(k, cos, sin, positions)
 
 
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes (reference: the inference softmax kernels'
+    alibi path, ``csrc/transformer/inference/csrc/softmax.cu``; formula
+    matches HF ``build_alibi_tensor`` so bloom checkpoints reproduce)."""
+    import math
+
+    closest = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = [base ** p for p in range(1, closest + 1)]
+    if closest != num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        slopes += [extra_base ** p for p in range(1, 2 * (num_heads - closest), 2)]
+    return jnp.asarray(slopes, jnp.float32)
+
+
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array) -> jax.Array:
     """x: [B, S, H, D]; cos/sin: [maxS, D/2]; positions: [B, S]."""
     from deepspeed_tpu.ops import rope as rope_op
@@ -244,11 +264,12 @@ class Attention(nn.Module):
 
         if cfg.position == "rope":
             q, k = apply_qk_rope(cfg, q, k, positions)
+        slopes = alibi_slopes(cfg.num_heads) if cfg.position == "alibi" else None
 
         from deepspeed_tpu.ops import causal_attention
         from deepspeed_tpu.parallel.ulysses import sp_active, ulysses_shard, ulysses_unshard
 
-        if cfg.sp_impl == "ring" and sp_active() and mask is None:
+        if slopes is None and cfg.sp_impl == "ring" and sp_active() and mask is None:
             # ring attention: K/V rotate over the sp ring (ppermute), queries
             # stay seq-sharded — O(S/P) memory, neighbor-link comm
             from deepspeed_tpu.parallel.ring_attention import ring_attention
@@ -256,9 +277,14 @@ class Attention(nn.Module):
 
             out = ring_attention(q, k, v, mesh=get_mesh(), axis="sp")
         else:
+            if slopes is not None and sp_active():
+                raise NotImplementedError(
+                    "alibi under sequence parallelism: the all-to-all re-shards "
+                    "heads, so slopes must be sharded per head rank — not wired")
             # Ulysses SP: seq-shard -> head-shard all-to-all around exact attention
             q, k, v = ulysses_shard(q), ulysses_shard(k), ulysses_shard(v)
-            out = causal_attention(q, k, v, mask=mask, impl=cfg.attn_impl)  # [B,S,H,hd]
+            out = causal_attention(q, k, v, mask=mask, impl=cfg.attn_impl,
+                                   alibi_slopes=slopes)  # [B,S,H,hd]
             out = ulysses_unshard(out)
         dense_bias = cfg.dense_bias if cfg.dense_bias is not None else cfg.norm == "layernorm"
         out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), use_bias=dense_bias,
@@ -300,9 +326,13 @@ class Block(nn.Module):
         x, mask, positions, aux = carry
         cfg = self.config
         if cfg.parallel_block:
-            # x = x + attn(ln(x)) + mlp(ln(x)) — one shared norm
-            h = _norm(cfg, "attn_norm")(x)
+            # x = x + attn(ln1(x)) + mlp(h); h = ln1(x) shared (falcon) or a
+            # separate ln2(x) (gpt-neox parallel_mlp_norm)
+            x_in = x
+            h = _norm(cfg, "attn_norm")(x_in)
             x = x + Attention(cfg, name="attn")(h, mask, positions, self.train)
+            if cfg.parallel_mlp_norm:
+                h = _norm(cfg, "mlp_norm")(x_in)
         else:
             x = x + Attention(cfg, name="attn")(
                 _norm(cfg, "attn_norm")(x), mask, positions, self.train
@@ -367,6 +397,8 @@ class CausalLM(nn.Module):
         pad_mask = batch.get("attention_mask")  # [B, S] 1=keep
 
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="embed")(ids)
+        if cfg.embed_norm:
+            x = _norm(cfg, "embed_norm")(x)
         if cfg.position == "learned":
             pos_emb = self.param(
                 "pos_embed", nn.initializers.normal(0.02), (cfg.max_seq_len, cfg.hidden_size)
@@ -432,6 +464,8 @@ class CausalLM(nn.Module):
 def _embed_tokens(params, cfg: TransformerConfig, ids):
     """Functional twin of the embedding front-end of ``CausalLM.__call__``."""
     x = jnp.take(params["embed"]["embedding"], ids, axis=0).astype(cfg.dtype)
+    if cfg.embed_norm:
+        x = _apply_norm(params["embed_norm"], cfg, x)
     if cfg.position == "learned":
         x = x + params["pos_embed"][None, : ids.shape[1], :].astype(cfg.dtype)
     return x

@@ -25,6 +25,8 @@ def _xla_causal_attention(
     k: jax.Array,  # [B, S, Hkv, D]
     v: jax.Array,  # [B, S, Hkv, D]
     mask: Optional[jax.Array] = None,  # [B, S] 1=keep (padding mask)
+    alibi_slopes: Optional[jax.Array] = None,  # [H] bloom-style score biases
+    bias: Optional[jax.Array] = None,  # [H, S, S] or [B, H, S, S] additive
 ) -> jax.Array:
     B, S, H, D = q.shape
     Hkv = k.shape[2]
@@ -33,6 +35,20 @@ def _xla_causal_attention(
 
     qg = q.reshape(B, S, Hkv, G, D).astype(jnp.float32) * (D**-0.5)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k.astype(jnp.float32))
+
+    if alibi_slopes is not None:
+        # slopes * key-position; equal to slopes*(j-i) up to a per-row
+        # constant, which softmax cancels (same convention as HF bloom, so
+        # ingested checkpoints reproduce bit-comparable logits). XLA fuses
+        # this broadcast into the masked add — no [H,S,S] buffer.
+        kpos = jnp.arange(S, dtype=jnp.float32)
+        scores = scores + (alibi_slopes.reshape(Hkv, G)[None, :, :, None, None]
+                           * kpos[None, None, None, None, :])
+    if bias is not None:
+        # evoformer-style pair bias (reference csrc/deepspeed4science/
+        # evoformer_attn): broadcast [.., H, S, S] onto the grouped layout
+        b5 = bias if bias.ndim == 4 else bias[None]
+        scores = scores + b5.reshape(b5.shape[0], Hkv, G, S, S).astype(jnp.float32)
 
     causal = jnp.tril(jnp.ones((S, S), bool))
     keep = causal[None, None, None]
@@ -44,5 +60,14 @@ def _xla_causal_attention(
     return out.reshape(B, S, H, D)
 
 
-def causal_attention(q, k, v, mask=None, impl: str = "auto"):
+def causal_attention(q, k, v, mask=None, impl: str = "auto",
+                     alibi_slopes=None, bias=None):
+    """Grouped-query causal attention with optional ALiBi slopes and additive
+    pair bias. Score biases ride the XLA path (fully differentiable — the
+    evoformer training case needs d_bias); the Pallas flash kernel wins
+    dispatch only for the unbiased form. Fusing bias tiles into the flash
+    kernel is a further optimization once a workload demands it."""
+    if alibi_slopes is not None or bias is not None:
+        return _xla_causal_attention(q, k, v, mask=mask,
+                                     alibi_slopes=alibi_slopes, bias=bias)
     return dispatch("causal_attention", impl)(q, k, v, mask=mask)

@@ -258,3 +258,107 @@ def test_detect_family():
 def test_config_from_hf_rejects_unknown():
     with pytest.raises(ValueError, match="model_type"):
         config_from_hf({"model_type": "resnet"})
+
+
+def test_gpt_neox_ingestion_logits_parity(tmp_path):
+    """GPT-NeoX: per-head fused QKV (fusedqkv_utils 'glmtype' ordering),
+    partial rotary, parallel residual with SEPARATE mlp norm."""
+    cfg_hf = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.5, rotary_emb_base=10000,
+        use_parallel_residual=True, hidden_act="gelu",
+        tie_word_embeddings=False,
+    )
+    hf_model = transformers.GPTNeoXForCausalLM(cfg_hf)
+    hf_model.eval()
+    hf_model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg, params = load_hf_checkpoint(str(tmp_path))
+    assert cfg.parallel_block and cfg.parallel_mlp_norm
+    assert cfg.rotary_dim == 4  # 0.5 * head_dim(8)
+    assert "mlp_norm" in params["layers"]
+
+    ids = np.random.default_rng(0).integers(0, 128, (2, 12))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+
+    module = CausalLM(cfg)
+    _, logits = module.apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray, params)},
+        {"input_ids": jnp.asarray(ids, jnp.int32)}, train=False)
+    np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3, atol=2e-4)
+
+
+def test_gpt_neox_sequential_residual_parity(tmp_path):
+    cfg_hf = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=False, tie_word_embeddings=False,
+    )
+    hf_model = transformers.GPTNeoXForCausalLM(cfg_hf)
+    hf_model.eval()
+    hf_model.save_pretrained(tmp_path, safe_serialization=True)
+    cfg, params = load_hf_checkpoint(str(tmp_path))
+    assert not cfg.parallel_block
+
+    ids = np.random.default_rng(1).integers(0, 128, (1, 10))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+    _, logits = CausalLM(cfg).apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray, params)},
+        {"input_ids": jnp.asarray(ids, jnp.int32)}, train=False)
+    np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3, atol=2e-4)
+
+
+def test_bloom_ingestion_logits_parity(tmp_path):
+    """Bloom: ALiBi position biases, embedding layernorm, per-head fused QKV
+    ('bloomtype' ordering), tied head."""
+    cfg_hf = transformers.BloomConfig(
+        vocab_size=128, hidden_size=32, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-5, tie_word_embeddings=True,
+    )
+    hf_model = transformers.BloomForCausalLM(cfg_hf)
+    hf_model.eval()
+    hf_model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg, params = load_hf_checkpoint(str(tmp_path))
+    assert cfg.position == "alibi" and cfg.embed_norm and cfg.tie_embeddings
+    assert "embed_norm" in params
+
+    ids = np.random.default_rng(0).integers(0, 128, (2, 12))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+
+    module = CausalLM(cfg)
+    _, logits = module.apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray, params)},
+        {"input_ids": jnp.asarray(ids, jnp.int32)}, train=False)
+    np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3, atol=2e-4)
+
+
+def test_bloom_generate_matches_hf(tmp_path):
+    """The DECODE path's alibi (slopes * cache-slot position) must agree with
+    HF greedy generation, not just teacher-forcing logits."""
+    import deepspeed_tpu
+
+    cfg_hf = transformers.BloomConfig(
+        vocab_size=128, hidden_size=32, n_layer=2, n_head=4,
+        tie_word_embeddings=True,
+    )
+    hf_model = transformers.BloomForCausalLM(cfg_hf)
+    hf_model.eval()
+    hf_model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg, params = load_hf_checkpoint(str(tmp_path))
+    eng = deepspeed_tpu.init_inference(
+        cfg, params=params, config={"dtype": "float32", "seq_bucket": 8})
+
+    ids = np.random.default_rng(0).integers(5, 128, (1, 6))
+    with torch.no_grad():
+        want = hf_model.generate(
+            torch.tensor(ids), max_new_tokens=6, do_sample=False,
+            pad_token_id=0).numpy()
+    got = eng.generate(ids, max_new_tokens=6, do_sample=False)
+    np.testing.assert_array_equal(got, want)

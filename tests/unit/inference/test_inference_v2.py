@@ -77,6 +77,10 @@ def test_build_ragged_batch_shapes():
     {"norm": "layernorm", "activation": "gelu_exact", "num_kv_heads": 1,
      "qkv_bias": False, "dense_bias": False, "parallel_block": True,
      "tie_embeddings": True},  # falcon-style: parallel block through ragged
+    {"norm": "layernorm", "activation": "gelu", "position": "alibi",
+     "embed_norm": True, "tie_embeddings": True},  # bloom-style: alibi + embed norm
+    {"norm": "layernorm", "activation": "gelu_exact", "parallel_block": True,
+     "parallel_mlp_norm": True, "rotary_dim": 4},  # gpt-neox-style parallel ln2
 ])
 def test_paged_matches_dense_v1(overrides):
     """Staggered prefill+decance through v2 == per-prompt v1 greedy decode."""
