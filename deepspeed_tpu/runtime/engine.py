@@ -161,6 +161,26 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
 
         self.flops_profiler = FlopsProfiler(engine=self)
+        if self.config.model.sparse_gradients:
+            # Reference sparse-grad allreduce (runtime/sparse_tensor.py:69).
+            # The compiled step keeps the dense psum (XLA-fused, one program);
+            # the composable sparse path lives in runtime/sparse_grad.py —
+            # evaluate its size heuristic here so the flag gives guidance
+            # instead of being silently ignored.
+            from deepspeed_tpu.runtime.sparse_grad import should_use_sparse_embedding_grad
+
+            mcfg = getattr(self.model, "transformer_config", None)
+            if mcfg is not None:
+                tokens = self.config.train_batch_size * mcfg.max_seq_len
+                wins = should_use_sparse_embedding_grad(mcfg.vocab_size, tokens)
+                log_dist(
+                    "sparse_gradients: the compiled step syncs the dense "
+                    f"embedding grad; heuristic for vocab={mcfg.vocab_size}, "
+                    f"global batch tokens<={tokens}: sparse sync would "
+                    f"{'WIN' if wins else 'not win'} — see "
+                    "runtime/sparse_grad.py for the composable sparse path",
+                    ranks=[0],
+                )
         log_dist(
             f"engine ready: mesh={dict(self.mesh.shape)} zero_stage={self.zero_config.stage} "
             f"dtype={self.compute_dtype.__name__} batch={self.config.train_batch_size} "

@@ -37,6 +37,118 @@ except Exception:
 
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------- tiering
+# Reference parity (tests/pytest.ini:1-14): the default run excludes the slow
+# tier (`nightly`) so one cold single-core run stays under the 550 s budget;
+# `pytest -m nightly tests/` runs the deep tier. Central registry (matched as
+# nodeid substrings) so the tiering is auditable in one place. POLICY: every
+# subsystem keeps at least one canonical parity test in the default tier —
+# nightly holds the deep/duplicate/trajectory coverage, never the only
+# coverage of a feature.
+NIGHTLY_NODE_SUBSTRINGS = [
+    # deep checkpoint/trajectory coverage (canonical: test_universal basic
+    # roundtrips, test_offload_nvme_roundtrip, zpp[2-knobs0])
+    "test_universal_checkpoint_moe_expert_params",
+    "test_universal_checkpoint_streams_atoms",
+    "test_offload_optimizer_cpu_trajectory_matches_fused",
+    "test_offload_zero3_with_param_offload",
+    "test_offload_checkpoint_roundtrip",
+    "test_hpz_trajectory_matches_stage3",
+    "test_hpz_gathers_ride_small_axis",
+    "test_zpp_trajectory_close_to_exact[3-knobs1]",
+    "test_zpp_trajectory_close_to_exact[3-knobs2]",
+    "test_zpp_parity_path_uses_quantized_comm",
+    "test_mics_trajectory_matches_full_fsdp",
+    "test_onebit_close_to_uncompressed",
+    "test_onebit_universal_checkpoint_excludes_residuals",
+    "test_onebit_trains_and_ships_uint8",
+    "test_activation_checkpointing_changes_program_not_math",
+    # parallelism deep tier (canonical: sp_matches_dp_baseline, moe_trains,
+    # ring_attention_matches_dense, pipelined_causal_lm_matches_plain)
+    "test_expert_parallel_matches_dense_ep",
+    "test_pyramid_moe_per_layer_experts",
+    "test_pr_moe_residual_trains",
+    "test_sp_with_zero3",
+    "test_causal_lm_with_ring_sp",
+    "test_ring_attention_contiguous_fallback",
+    "test_pipelined_engine_end_to_end",
+    "test_interleaved_causal_lm_trains",
+    "test_zero3_tp_composition",
+    "test_hf_flax_gpt2_autotp_exactness",
+    # models deep tier (canonical: test_tp_matches_pure_dp)
+    "test_remat_and_no_scan_match",
+    "test_tiny_llama_trains",
+    "test_gpt2_style_trains",
+    # ops deep tier (canonical: flash/sparse parity + bwd tests)
+    "test_causal_lm_fused_ce_matches_unfused",
+    "test_layout_cache_eviction_safe_under_grad",
+    # inference deep tier (canonical: cached_decode[overrides0],
+    # nvme_generate_matches_resident, paged_matches_dense_v1[overrides0])
+    "test_cached_decode_matches_full_forward[overrides1]",
+    "test_cached_decode_matches_full_forward[overrides2]",
+    "test_cached_decode_matches_full_forward[overrides3]",
+    "test_cached_decode_matches_full_forward[overrides4]",
+    "test_ragged_prompts_right_padded",
+    "test_moe_inference_forward",
+    "test_woq_generate_close_to_dense",
+    "test_nvme_composes_with_woq",
+    # aux deep tier (canonical kept in default: autotuner_picks_viable_config,
+    # agent_restarts_without_failed_host)
+    "test_autotuner_model_factory_overrides",
+    "test_agent_keeps_terminated_survivors",
+    "test_agent_gives_up_after_budget",
+    # ---- tranche 2 (single-core budget: default must fit one cold <550 s
+    # run; canonical parity anchors that STAY default are listed in each
+    # subsystem comment above plus: sp_matches_dp_baseline,
+    # cached_decode[overrides0], tp_matches_pure_dp, moe_trains,
+    # llama_ingestion, offload_nvme_roundtrip, nvme_generate_matches_resident,
+    # paged_matches_dense_v1[overrides0], packaging, padding_mask,
+    # sparse-attention gradient parity, flash grads[False]) ----
+    "test_ring_attention_matches_dense",       # deep ring; zigzag/unit ring tests stay
+    "test_pipelined_causal_lm_matches_plain",  # interleaved_pipeline_gradients stays
+    "test_zpp_trajectory_close_to_exact[2-knobs0]",
+    "test_onebit_error_feedback_state",
+    "test_offload_state_not_on_mesh",
+    "test_param_only_offload_is_not_a_silent_noop",
+    "test_hybrid_engine_train_generate_flip",
+    "test_sharded_init_matches_eager_init",
+    "test_woq_memory_shrinks",
+    "test_nvme_generate_matches_resident_sampled_eos",
+    "test_ragged_forward_uses_kernel_consistently",
+    "test_initialize_training_from_hf",
+    "test_num_params_matches_init[4-1-True]",
+    "test_paged_matches_dense_v1[overrides1]",
+    "test_paged_matches_dense_v1[overrides2]",
+    "test_paged_matches_dense_v1[overrides3]",
+    "test_grads_match_xla[True]",
+    "test_masked_grads_match_xla[8-8]",
+    "test_unequal_blocks_dense_grid",
+    # ---- tranche 3 (trim to the 550 s budget; measured 570 s cold) ----
+    "test_zpp_comm_bytes_reduced",            # zpp config/validation tests stay
+    "test_schedule_executor_matches_sequential[2-4]",  # other params stay
+    "test_ring_attention_jits_in_train_context",  # zigzag unit tests stay
+    "test_paged_pallas_gqa_grouping",         # paged parity params stay
+]
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if any(s in item.nodeid for s in NIGHTLY_NODE_SUBSTRINGS):
+            item.add_marker(pytest.mark.nightly)
+    # Default-tier deselection. Done here instead of addopts so that
+    # (a) an explicit -m expression takes full control, and (b) running a
+    # specific node-id (`pytest tests/...::test_x`) executes it even if it
+    # is nightly — addopts would silently report "no tests collected".
+    if config.option.markexpr:
+        return
+    if any("::" in str(a) for a in config.args):
+        return
+    kept = [i for i in items if i.get_closest_marker("nightly") is None]
+    deselected = [i for i in items if i.get_closest_marker("nightly") is not None]
+    if deselected:
+        config.hook.pytest_deselected(items=deselected)
+        items[:] = kept
+
 
 @pytest.fixture(scope="session")
 def devices():
