@@ -7,7 +7,7 @@ off-TPU.
 """
 
 from deepspeed_tpu.ops.registry import available_impls, dispatch, op_report, register
-from deepspeed_tpu.ops.attention import causal_attention
+from deepspeed_tpu.ops.attention import causal_attention, evoformer_attention
 from deepspeed_tpu.ops.norms import layer_norm, rms_norm
 from deepspeed_tpu.ops.rope import rope
 from deepspeed_tpu.ops.quant import dequantize_int8, quantize_int8

@@ -27,6 +27,7 @@ def _xla_causal_attention(
     mask: Optional[jax.Array] = None,  # [B, S] 1=keep (padding mask)
     alibi_slopes: Optional[jax.Array] = None,  # [H] bloom-style score biases
     bias: Optional[jax.Array] = None,  # [H, S, S] or [B, H, S, S] additive
+    causal: bool = True,
 ) -> jax.Array:
     B, S, H, D = q.shape
     Hkv = k.shape[2]
@@ -50,11 +51,14 @@ def _xla_causal_attention(
         b5 = bias if bias.ndim == 4 else bias[None]
         scores = scores + b5.reshape(b5.shape[0], Hkv, G, S, S).astype(jnp.float32)
 
-    causal = jnp.tril(jnp.ones((S, S), bool))
-    keep = causal[None, None, None]
+    keep = None
+    if causal:
+        keep = jnp.tril(jnp.ones((S, S), bool))[None, None, None]
     if mask is not None:
-        keep = keep & (mask[:, None, None, None, :] > 0)
-    scores = jnp.where(keep, scores, _NEG_INF)
+        m = mask[:, None, None, None, :] > 0
+        keep = m if keep is None else keep & m
+    if keep is not None:
+        scores = jnp.where(keep, scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
     return out.reshape(B, S, H, D)
@@ -71,3 +75,15 @@ def causal_attention(q, k, v, mask=None, impl: str = "auto",
         return _xla_causal_attention(q, k, v, mask=mask,
                                      alibi_slopes=alibi_slopes, bias=bias)
     return dispatch("causal_attention", impl)(q, k, v, mask=mask)
+
+
+def evoformer_attention(q, k, v, pair_bias=None, mask=None):
+    """DS4Science evoformer attention (reference
+    ``csrc/deepspeed4science/evoformer_attn/`` — CUTLASS attention with
+    broadcast bias for AlphaFold-family models): BIDIRECTIONAL attention over
+    residue/MSA axes with an additive pair-representation bias and an optional
+    keep-mask. Fully differentiable including d(pair_bias).
+
+    q/k/v: [B, S, H, D]; pair_bias: [H, S, S] or [B, H, S, S]; mask: [B, S].
+    """
+    return _xla_causal_attention(q, k, v, mask=mask, bias=pair_bias, causal=False)
