@@ -123,3 +123,23 @@ def test_causal_lm_with_ring_sp(devices):
         losses = [float(e.train_batch({"input_ids": ids})["loss"]) for _ in range(3)]
         outs[sp_impl] = losses
     np.testing.assert_allclose(outs["ring"], outs["ulysses"], rtol=2e-4)
+
+
+def test_fpdt_chunk_major_zero_copy_layout(devices):
+    """chunk_major=True accepts pre-chunked [n, B, C, Hkv, D] K/V (the
+    zero-copy prefetch layout) and matches the strided-input path."""
+    import numpy as np
+    from deepspeed_tpu.sequence.fpdt import FPDTAttention
+
+    B, S, H, D, Ck = 2, 256, 4, 16, 64
+    rng = np.random.default_rng(3)
+    q, k, v = (rng.standard_normal((B, S, H, D)).astype(np.float32) for _ in range(3))
+    fp = FPDTAttention(q_chunk=64, kv_chunk=Ck, causal=True)
+    want = fp(q, k, v)
+
+    def cm(x):
+        return np.ascontiguousarray(
+            x.reshape(B, S // Ck, Ck, H, D).transpose(1, 0, 2, 3, 4))
+
+    got = fp(q, cm(k), cm(v), chunk_major=True)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
