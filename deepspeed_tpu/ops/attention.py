@@ -67,13 +67,18 @@ def _xla_causal_attention(
 def causal_attention(q, k, v, mask=None, impl: str = "auto",
                      alibi_slopes=None, bias=None):
     """Grouped-query causal attention with optional ALiBi slopes and additive
-    pair bias. Score biases ride the XLA path (fully differentiable — the
-    evoformer training case needs d_bias); the Pallas flash kernel wins
-    dispatch only for the unbiased form. Fusing bias tiles into the flash
-    kernel is a further optimization once a workload demands it."""
-    if alibi_slopes is not None or bias is not None:
+    pair bias. ALiBi is fused into the Pallas flash kernels (slope * column
+    iota — no bias tiles) so bloom-style training keeps the flash path; the
+    slopes are treated as NON-LEARNED positional constants there (their
+    gradient is stopped — pass impl='xla' to differentiate learned slopes).
+    Dense pair bias rides the XLA path (fully differentiable — the evoformer
+    training case needs d_bias)."""
+    if bias is not None:
         return _xla_causal_attention(q, k, v, mask=mask,
                                      alibi_slopes=alibi_slopes, bias=bias)
+    if alibi_slopes is not None:
+        return dispatch("causal_attention", impl)(q, k, v, mask=mask,
+                                                  alibi_slopes=alibi_slopes)
     return dispatch("causal_attention", impl)(q, k, v, mask=mask)
 
 

@@ -142,14 +142,28 @@ def _spec(shape, f, dec):
     return pl.BlockSpec(shape, lambda *a: f(*dec(*a)))
 
 
-def _qkv_in_specs(dec, block_q, block_k, D, G):
-    """mask, q, k, v input specs (shared by fwd and both backward kernels)."""
-    return [
-        _spec((1, 1, block_k), lambda b, h, qi, ki: (b, 0, ki), dec),
+def _qkv_in_specs(dec, block_q, block_k, D, G, alibi=False):
+    """mask, [slopes], q, k, v input specs (shared by fwd and both backward
+    kernels). The alibi slopes ride as a tiny [H, _LANES] fp32 array blocked
+    per query head."""
+    specs = [_spec((1, 1, block_k), lambda b, h, qi, ki: (b, 0, ki), dec)]
+    if alibi:
+        specs.append(_spec((1, _LANES), lambda b, h, qi, ki: (h, 0), dec))
+    specs += [
         _spec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0), dec),
         _spec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0), dec),
         _spec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h // G, ki, 0), dec),
     ]
+    return specs
+
+
+def _alibi_add(s, slopes_ref, ki, block_k):
+    """s += slope[h] * key-position, in the caller's softmax scale (the
+    wrapper pre-folds log2e into the slopes for the base-2 kernels). The HF
+    bloom convention (slopes * j); softmax cancels the per-row shift vs
+    slopes * (j - i)."""
+    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return s + slopes_ref[0, 0] * cols.astype(jnp.float32)
 
 
 def _qrow_specs(dec, block_q, D):
@@ -172,16 +186,18 @@ def _kcol_spec(dec, block_k, D):
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel(*refs, block_q, block_k, causal, masked, squashed):
+def _fwd_kernel(*refs, block_q, block_k, causal, masked, squashed, alibi=False):
     if squashed:
-        (qm_ref, km_ref, mask_ref, q_ref, k_ref, v_ref,
-         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+        (qm_ref, km_ref, mask_ref, *rest) = refs
+        slopes_ref = rest.pop(0) if alibi else None
+        (q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref) = rest
         t = pl.program_id(2)
         qi, ki = qm_ref[t], km_ref[t]
         first, last = ki == 0, ki == qi
     else:
-        (mask_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-         acc_ref, m_ref, l_ref) = refs
+        (mask_ref, *rest) = refs
+        slopes_ref = rest.pop(0) if alibi else None
+        (q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref) = rest
         qi, ki = pl.program_id(2), pl.program_id(3)
         first, last = ki == 0, ki == pl.num_programs(3) - 1
 
@@ -197,6 +213,8 @@ def _fwd_kernel(*refs, block_q, block_k, causal, masked, squashed):
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
+        if alibi:
+            s = _alibi_add(s, slopes_ref, ki, block_k)
 
         if mask_block or masked:
             keep = None
@@ -247,8 +265,11 @@ def _fwd_kernel(*refs, block_q, block_k, causal, masked, squashed):
 _PARALLEL_SEMANTICS = ("parallel", "parallel", "parallel", "arbitrary")
 
 
-def _flash_fwd(q, k, v, mask, block_q: int, block_k: int, causal: bool, masked: bool):
-    """q,k,v: [B, H(q/kv), S, D] (q pre-scaled). mask: [B, S] int32. Returns (out, lse)."""
+def _flash_fwd(q, k, v, mask, slopes, block_q: int, block_k: int, causal: bool,
+               masked: bool, alibi: bool):
+    """q,k,v: [B, H(q/kv), S, D] (q pre-scaled). mask: [B, S] int32.
+    slopes: [H, _LANES] fp32 (log2e-scaled; ignored unless alibi).
+    Returns (out, lse)."""
     B, H, S, D = q.shape
     Hkv = k.shape[1]
     G = H // Hkv
@@ -265,11 +286,13 @@ def _flash_fwd(q, k, v, mask, block_q: int, block_k: int, causal: bool, masked: 
         pltpu.VMEM((block_q, _LANES), jnp.float32),
     ]
     kernel = functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
-                               causal=causal, masked=masked, squashed=squashed)
+                               causal=causal, masked=masked, squashed=squashed,
+                               alibi=alibi)
     dec = _DEC_SQUASHED if squashed else _DEC_DENSE
-    in_specs = _qkv_in_specs(dec, block_q, block_k, D, G)
+    in_specs = _qkv_in_specs(dec, block_q, block_k, D, G, alibi=alibi)
     qrow = _qrow_specs(dec, block_q, D)
     out_specs = [qrow["qD"], qrow["qL"]]
+    extra = (slopes,) if alibi else ()
 
     if squashed:
         qm, km = _tri_maps(nq)
@@ -286,7 +309,7 @@ def _flash_fwd(q, k, v, mask, block_q: int, block_k: int, causal: bool, masked: 
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=_interpret(),
-        )(qm, km, mask, q, k, v)
+        )(qm, km, mask, *extra, q, k, v)
         return out, lse
 
     out, lse = pl.pallas_call(
@@ -298,7 +321,7 @@ def _flash_fwd(q, k, v, mask, block_q: int, block_k: int, causal: bool, masked: 
         scratch_shapes=scratch_shapes,
         compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL_SEMANTICS),
         interpret=_interpret(),
-    )(mask, q, k, v)
+    )(mask, *extra, q, k, v)
     return out, lse
 
 
@@ -307,16 +330,18 @@ def _flash_fwd(q, k, v, mask, block_q: int, block_k: int, causal: bool, masked: 
 # --------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(*refs, block_q, block_k, causal, masked, squashed):
+def _bwd_dq_kernel(*refs, block_q, block_k, causal, masked, squashed, alibi=False):
     if squashed:
-        (qm_ref, km_ref, mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-         delta_ref, dq_ref, acc_ref) = refs
+        (qm_ref, km_ref, mask_ref, *rest) = refs
+        slopes_ref = rest.pop(0) if alibi else None
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref) = rest
         t = pl.program_id(2)
         qi, ki = qm_ref[t], km_ref[t]
         first, last = ki == 0, ki == qi
     else:
-        (mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-         delta_ref, dq_ref, acc_ref) = refs
+        (mask_ref, *rest) = refs
+        slopes_ref = rest.pop(0) if alibi else None
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref) = rest
         qi, ki = pl.program_id(2), pl.program_id(3)
         first, last = ki == 0, ki == pl.num_programs(3) - 1
 
@@ -328,6 +353,8 @@ def _bwd_dq_kernel(*refs, block_q, block_k, causal, masked, squashed):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if alibi:
+            s = _alibi_add(s, slopes_ref, ki, block_k)
 
         if mask_block or masked:
             keep = None
@@ -366,16 +393,21 @@ def _bwd_dq_kernel(*refs, block_q, block_k, causal, masked, squashed):
         dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, block_q, block_k, causal, masked, squashed, nq_total):
+def _bwd_dkv_kernel(*refs, block_q, block_k, causal, masked, squashed, nq_total,
+                    alibi=False):
     if squashed:
-        (qm_ref, km_ref, mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-         delta_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        (qm_ref, km_ref, mask_ref, *rest) = refs
+        slopes_ref = rest.pop(0) if alibi else None
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = rest
         t = pl.program_id(2)
         qi, ki = qm_ref[t], km_ref[t]
         first, last = qi == ki, qi == nq_total - 1
     else:
-        (mask_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-         delta_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        (mask_ref, *rest) = refs
+        slopes_ref = rest.pop(0) if alibi else None
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = rest
         ki, qi = pl.program_id(2), pl.program_id(3)
         first, last = qi == 0, qi == pl.num_programs(3) - 1
 
@@ -388,6 +420,8 @@ def _bwd_dkv_kernel(*refs, block_q, block_k, causal, masked, squashed, nq_total)
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if alibi:
+            s = _alibi_add(s, slopes_ref, ki, block_k)
 
         if mask_block or masked:
             keep = None
@@ -428,7 +462,8 @@ def _bwd_dkv_kernel(*refs, block_q, block_k, causal, masked, squashed, nq_total)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, mask, out, lse, do, block_q: int, block_k: int, causal: bool, masked: bool):
+def _flash_bwd(q, k, v, mask, slopes, out, lse, do, block_q: int, block_k: int,
+               causal: bool, masked: bool, alibi: bool):
     B, H, S, D = q.shape
     Hkv = k.shape[1]
     G = H // Hkv
@@ -440,10 +475,12 @@ def _flash_bwd(q, k, v, mask, out, lse, do, block_q: int, block_k: int, causal: 
 
     grad_vma = _vma(q, k, v, mask, do)
     dq_kernel = functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
-                                  causal=causal, masked=masked, squashed=squashed)
+                                  causal=causal, masked=masked, squashed=squashed,
+                                  alibi=alibi)
     dkv_kernel = functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
                                    causal=causal, masked=masked, squashed=squashed,
-                                   nq_total=nq)
+                                   nq_total=nq, alibi=alibi)
+    extra = (slopes,) if alibi else ()
     dq_scratch = [pltpu.VMEM((block_q, D), jnp.float32)]
     dkv_scratch = [pltpu.VMEM((block_k, D), jnp.float32),
                    pltpu.VMEM((block_k, D), jnp.float32)]
@@ -452,7 +489,8 @@ def _flash_bwd(q, k, v, mask, out, lse, do, block_q: int, block_k: int, causal: 
 
     def bwd_in_specs(dec):
         qrow = _qrow_specs(dec, block_q, D)
-        return _qkv_in_specs(dec, block_q, block_k, D, G) + [qrow["qD"], qrow["qL"], qrow["qL"]]
+        return (_qkv_in_specs(dec, block_q, block_k, D, G, alibi=alibi)
+                + [qrow["qD"], qrow["qL"], qrow["qL"]])
 
     if squashed:
         arb = pltpu.CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary"))
@@ -469,7 +507,7 @@ def _flash_bwd(q, k, v, mask, out, lse, do, block_q: int, block_k: int, causal: 
             out_shape=dq_shape,
             compiler_params=arb,
             interpret=_interpret(),
-        )(qm, km, mask, q, k, v, do, lse, delta)
+        )(qm, km, mask, *extra, q, k, v, do, lse, delta)
 
         # dk/dv are per *query* head here; grouped heads are summed below.
         wqm, wkm = _wedge_maps(nk)
@@ -485,7 +523,7 @@ def _flash_bwd(q, k, v, mask, out, lse, do, block_q: int, block_k: int, causal: 
             out_shape=dkv_shape,
             compiler_params=arb,
             interpret=_interpret(),
-        )(wqm, wkm, mask, q, k, v, do, lse, delta)
+        )(wqm, wkm, mask, *extra, q, k, v, do, lse, delta)
     else:
         dq = pl.pallas_call(
             dq_kernel,
@@ -496,7 +534,7 @@ def _flash_bwd(q, k, v, mask, out, lse, do, block_q: int, block_k: int, causal: 
             scratch_shapes=dq_scratch,
             compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL_SEMANTICS),
             interpret=_interpret(),
-        )(mask, q, k, v, do, lse, delta)
+        )(mask, *extra, q, k, v, do, lse, delta)
 
         # dk/dv are per *query* head here; grouped heads are summed below. The
         # dense dkv grid iterates (ki outer, qi inner) — _DEC_DENSE_KQ restores
@@ -510,7 +548,7 @@ def _flash_bwd(q, k, v, mask, out, lse, do, block_q: int, block_k: int, causal: 
             scratch_shapes=dkv_scratch,
             compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL_SEMANTICS),
             interpret=_interpret(),
-        )(mask, q, k, v, do, lse, delta)
+        )(mask, *extra, q, k, v, do, lse, delta)
 
     if G > 1:
         dk = dk.reshape(B, Hkv, G, S, D).sum(axis=2)
@@ -523,30 +561,32 @@ def _flash_bwd(q, k, v, mask, out, lse, do, block_q: int, block_k: int, causal: 
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_attention(q, k, v, mask, block_q, block_k, causal, masked):
-    out, _ = _flash_core(q, k, v, mask, block_q, block_k, causal, masked)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_attention(q, k, v, mask, slopes, block_q, block_k, causal, masked, alibi):
+    out, _ = _flash_core(q, k, v, mask, slopes, block_q, block_k, causal, masked, alibi)
     return out
 
 
-def _flash_core(q, k, v, mask, block_q, block_k, causal, masked):
+def _flash_core(q, k, v, mask, slopes, block_q, block_k, causal, masked, alibi):
     scale = q.shape[-1] ** -0.5 * _LOG2E  # base-2 softmax (see module header)
     qs = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)  # [B,H,S,D]
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out, lse = _flash_fwd(qs, kt, vt, mask, block_q, block_k, causal, masked)
+    out, lse = _flash_fwd(qs, kt, vt, mask, slopes, block_q, block_k, causal, masked, alibi)
     return out.transpose(0, 2, 1, 3), (qs, kt, vt, lse, out)
 
 
-def _flash_vjp_fwd(q, k, v, mask, block_q, block_k, causal, masked):
-    out, (qs, kt, vt, lse, out_bhsd) = _flash_core(q, k, v, mask, block_q, block_k, causal, masked)
-    return out, (qs, kt, vt, mask, lse, out_bhsd)
+def _flash_vjp_fwd(q, k, v, mask, slopes, block_q, block_k, causal, masked, alibi):
+    out, (qs, kt, vt, lse, out_bhsd) = _flash_core(q, k, v, mask, slopes, block_q,
+                                                   block_k, causal, masked, alibi)
+    return out, (qs, kt, vt, mask, slopes, lse, out_bhsd)
 
 
-def _flash_vjp_bwd(block_q, block_k, causal, masked, res, g):
-    qs, kt, vt, mask, lse, out_bhsd = res
+def _flash_vjp_bwd(block_q, block_k, causal, masked, alibi, res, g):
+    qs, kt, vt, mask, slopes, lse, out_bhsd = res
     do = g.transpose(0, 2, 1, 3)
-    dq, dk, dv = _flash_bwd(qs, kt, vt, mask, out_bhsd, lse, do, block_q, block_k, causal, masked)
+    dq, dk, dv = _flash_bwd(qs, kt, vt, mask, slopes, out_bhsd, lse, do,
+                            block_q, block_k, causal, masked, alibi)
     # Base-2 gradient bookkeeping (kernels compute the base-e ds = p*(dp-δ)):
     # dq needs scale*log2e*ln2 == plain scale (exact — no ln2 rounding), and
     # dk, accumulated against the log2e-pre-scaled q, needs ln2 applied here
@@ -555,7 +595,7 @@ def _flash_vjp_bwd(block_q, block_k, causal, masked, res, g):
     dq = (dq * scale).transpose(0, 2, 1, 3).astype(qs.dtype)
     dk = (dk * _LN2).transpose(0, 2, 1, 3).astype(kt.dtype)
     dv = dv.transpose(0, 2, 1, 3).astype(vt.dtype)
-    return dq, dk, dv, None
+    return dq, dk, dv, None, None
 
 
 _flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -569,6 +609,7 @@ def flash_causal_attention(
     mask: Optional[jax.Array] = None,  # [B, S] 1=keep
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    alibi_slopes: Optional[jax.Array] = None,  # [H] fp32 (bloom ALiBi)
 ) -> jax.Array:
     B, S, H, D = q.shape
     block_q = min(block_q, max(S, 8))
@@ -588,5 +629,19 @@ def flash_causal_attention(
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
         keep = jnp.pad(keep, ((0, 0), (0, pad)))
 
-    out = _flash_attention(q, k, v, keep[:, None, :], block_q, block_k, True, masked)
+    alibi = alibi_slopes is not None
+    if alibi:
+        # The kernels run base-2 softmax: fold log2e into the slopes so the
+        # in-kernel bias lands in the same scale as the pre-scaled scores.
+        # Slopes are NON-DIFFERENTIABLE on this path (stop_gradient makes it
+        # explicit): they are positional constants in ALiBi models; to train
+        # learned per-head slopes, use causal_attention(..., impl='xla').
+        slopes = jnp.broadcast_to(
+            (jax.lax.stop_gradient(alibi_slopes).astype(jnp.float32)
+             * _LOG2E)[:, None], (H, _LANES))
+    else:
+        slopes = jnp.zeros((H, _LANES), jnp.float32)
+
+    out = _flash_attention(q, k, v, keep[:, None, :], slopes,
+                           block_q, block_k, True, masked, alibi)
     return out[:, :S]

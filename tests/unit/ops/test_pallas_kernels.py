@@ -249,3 +249,62 @@ def test_evoformer_attention_bidirectional_with_pair_bias(devices):
     gb = jax.grad(lambda b: (ops.evoformer_attention(q, k, v, pair_bias=b,
                                                      mask=mask) ** 2).sum())(bias)
     assert np.isfinite(np.asarray(gb)).all() and np.abs(np.asarray(gb)).sum() > 0
+
+
+class TestFlashAlibi:
+    """ALiBi fused into the flash kernels (slope * column iota in all three
+    kernels) — bloom-style training keeps the flash path instead of the XLA
+    fallback."""
+
+    def _qkv(self, B=2, S=32, H=4, D=8, Hkv=None):
+        from deepspeed_tpu.models.transformer import alibi_slopes
+
+        Hkv = Hkv or H
+        return (_rand(0, (B, S, H, D)), _rand(1, (B, S, Hkv, D)),
+                _rand(2, (B, S, Hkv, D)), alibi_slopes(H))
+
+    @pytest.mark.parametrize("bq,bk", [(8, 8), (16, 8)])  # squashed + dense grids
+    def test_forward_matches_xla(self, bq, bk):
+        q, k, v, slopes = self._qkv()
+        ref = ops.causal_attention(q, k, v, impl="xla", alibi_slopes=slopes)
+        out = ops.dispatch("causal_attention", "pallas")(
+            q, k, v, block_q=bq, block_k=bk, alibi_slopes=slopes)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("gqa,bq,bk", [
+        (False, 8, 8),   # squashed grid
+        (False, 16, 8),  # dense grid (incl. the _DEC_DENSE_KQ dkv decoder)
+        (True, 8, 8),    # GQA: slope indexed by query head h, k/v by h//G
+    ])
+    def test_grads_match_xla(self, gqa, bq, bk):
+        q, k, v, slopes = self._qkv(Hkv=2 if gqa else None)
+
+        def loss(fn):
+            def f(q, k, v):
+                out = fn(q, k, v)
+                return jnp.sum(out * jnp.cos(out.astype(jnp.float32)))
+            return f
+
+        ref = jax.grad(loss(lambda q, k, v: ops.causal_attention(
+            q, k, v, impl="xla", alibi_slopes=slopes)), argnums=(0, 1, 2))(q, k, v)
+        got = jax.grad(loss(lambda q, k, v: ops.dispatch("causal_attention", "pallas")(
+            q, k, v, block_q=bq, block_k=bk, alibi_slopes=slopes)), argnums=(0, 1, 2))(q, k, v)
+        for r, g in zip(ref, got):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=5e-5, rtol=5e-5)
+
+    def test_gqa_forward_matches_xla(self):
+        q, k, v, slopes = self._qkv(Hkv=2)
+        ref = ops.causal_attention(q, k, v, impl="xla", alibi_slopes=slopes)
+        out = ops.dispatch("causal_attention", "pallas")(
+            q, k, v, block_q=8, block_k=8, alibi_slopes=slopes)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    def test_masked_forward_matches_xla(self):
+        q, k, v, slopes = self._qkv(S=24)
+        mask = jnp.asarray(np.random.default_rng(2).integers(0, 2, (2, 24)), jnp.int32).at[:, 0].set(1)
+        ref = ops.causal_attention(q, k, v, mask=mask, impl="xla", alibi_slopes=slopes)
+        out = ops.dispatch("causal_attention", "pallas")(
+            q, k, v, mask=mask, block_q=8, block_k=8, alibi_slopes=slopes)
+        keep = np.asarray(mask, bool)
+        np.testing.assert_allclose(np.asarray(out)[keep], np.asarray(ref)[keep],
+                                   atol=2e-5, rtol=2e-5)
