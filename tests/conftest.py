@@ -123,6 +123,9 @@ NIGHTLY_NODE_SUBSTRINGS = [
     "test_grads_match_xla[True]",
     "test_masked_grads_match_xla[8-8]",
     "test_unequal_blocks_dense_grid",
+    # flash+alibi deep grid/GQA gradient variants (canonical [False-8-8] stays)
+    "TestFlashAlibi::test_grads_match_xla[False-16-8]",
+    "TestFlashAlibi::test_grads_match_xla[True-8-8]",
     # ---- tranche 3 (trim to the 550 s budget; measured 570 s cold) ----
     "test_zpp_comm_bytes_reduced",            # zpp config/validation tests stay
     "test_schedule_executor_matches_sequential[2-4]",  # other params stay
