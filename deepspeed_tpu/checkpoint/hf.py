@@ -11,7 +11,9 @@ the mesh (``parallel/autotp.place_parameters``).
 
 Supported families: llama (incl. mistral — same graph), qwen2 (llama graph
 + qkv biases), gpt2, opt, falcon (7b-style parallel block, MQA), phi (parallel
-block + partial rotary), mixtral.
+block + partial rotary), mixtral, gpt_neox (per-head fused QKV, parallel
+residual with separate MLP norm), bloom (ALiBi + embedding layernorm), gptj
+(interleaved rotary, parallel block, biased MLP/head).
 Sharded checkpoints (``model.safetensors.index.json``) are read shard-by-shard
 into one host dict before conversion — peak host memory is the full fp* model
 plus the stacked copy being built. A per-layer streaming path (convert and
@@ -238,9 +240,42 @@ def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
             embed_norm=True,  # word_embeddings_layernorm
             tie_embeddings=True,  # bloom always ties lm_head to embeddings
         )
+    if mt == "gptj":
+        h = hf_config["n_embd"]
+        heads = hf_config["n_head"]
+        act = hf_config.get("activation_function", "gelu_new")
+        if act not in ("gelu_new", "gelu", "relu"):
+            raise ValueError(f"unsupported gptj activation_function {act!r}")
+        if hf_config.get("tie_word_embeddings", False):
+            # GPTJForCausalLM's lm_head keeps its BIAS even when tied; our
+            # tied path computes x @ embed.T with no bias, which would
+            # silently drop it. Real GPT-J checkpoints are untied.
+            raise ValueError("gptj with tie_word_embeddings=true is unsupported "
+                             "(the tied head would drop lm_head.bias)")
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config.get("n_inner") or 4 * h,
+            num_layers=hf_config["n_layer"],
+            num_heads=heads,
+            max_seq_len=hf_config.get("n_positions", 2048),
+            norm="layernorm",
+            activation={"gelu_new": "gelu", "gelu": "gelu_exact", "relu": "relu"}[act],
+            position="rope",
+            rope_theta=10000.0,
+            rotary_dim=hf_config.get("rotary_dim") or (h // heads),
+            rope_interleaved=True,  # rotate_every_two convention
+            norm_eps=float(hf_config.get("layer_norm_epsilon", 1e-5)),
+            qkv_bias=False,
+            dense_bias=False,   # attention projections are bias-free...
+            mlp_bias=True,      # ...but fc_in/fc_out carry biases
+            lm_head_bias=True,  # GPTJForCausalLM.lm_head has a bias
+            parallel_block=True,  # one shared ln_1 feeds attn AND mlp
+            tie_embeddings=False,  # tied variant rejected above (bias drop)
+        )
     raise ValueError(
         f"unsupported HF model_type {mt!r} "
-        "(supported: llama/mistral/mixtral/qwen2/gpt2/opt/falcon/phi/gpt_neox/bloom)")
+        "(supported: llama/mistral/mixtral/qwen2/gpt2/opt/falcon/phi/gpt_neox/bloom/gptj)")
 
 
 def detect_family(state: Dict[str, np.ndarray]) -> str:
@@ -261,6 +296,8 @@ def detect_family(state: Dict[str, np.ndarray]) -> str:
         return "qwen2"
     if any("self_attn.q_proj" in k for k in keys):
         return "llama"
+    if any("mlp.fc_in" in k for k in keys):
+        return "gptj"
     if any(k.endswith("attn.c_attn.weight") for k in keys):
         return "gpt2"
     raise ValueError("cannot detect model family from checkpoint keys")
@@ -571,6 +608,38 @@ def _convert_bloom(state, cfg: TransformerConfig) -> Dict[str, Any]:
     }
 
 
+def _convert_gptj(state, cfg: TransformerConfig) -> Dict[str, Any]:
+    h, hd, H = cfg.hidden_size, cfg.dims_per_head, cfg.num_heads
+    g = _getter(state, ("transformer.", ""))
+
+    def layer(i):
+        p = f"h.{i}."
+        return {
+            # parallel block: ONE shared ln_1 feeds attn and mlp
+            "attn_norm": {"scale": g(p + "ln_1.weight"), "bias": g(p + "ln_1.bias")},
+            "attn": {
+                "wq": {"kernel": g(p + "attn.q_proj.weight").T.reshape(h, H, hd)},
+                "wk": {"kernel": g(p + "attn.k_proj.weight").T.reshape(h, H, hd)},
+                "wv": {"kernel": g(p + "attn.v_proj.weight").T.reshape(h, H, hd)},
+                "wo": {"kernel": g(p + "attn.out_proj.weight").T.reshape(H, hd, h)},
+            },
+            "mlp": {
+                "w_up": {"kernel": g(p + "mlp.fc_in.weight").T, "bias": g(p + "mlp.fc_in.bias")},
+                "w_down": {"kernel": g(p + "mlp.fc_out.weight").T, "bias": g(p + "mlp.fc_out.bias")},
+            },
+        }
+
+    params: Dict[str, Any] = {
+        "embed": {"embedding": g("wte.weight")},
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+        "layers": _stack(layer, cfg.num_layers),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": np.asarray(state["lm_head.weight"]).T,
+                             "bias": np.asarray(state["lm_head.bias"])}
+    return params
+
+
 _CONVERTERS = {
     "llama": _convert_llama,
     "mistral": _convert_llama,
@@ -582,6 +651,7 @@ _CONVERTERS = {
     "phi": _convert_phi,
     "gpt_neox": _convert_gpt_neox,
     "bloom": _convert_bloom,
+    "gptj": _convert_gptj,
 }
 
 

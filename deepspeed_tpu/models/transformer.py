@@ -60,6 +60,12 @@ class TransformerConfig:
     embed_norm: bool = False
     # Partial rotary (phi-style): rope only the first rotary_dim of head_dim.
     rotary_dim: Optional[int] = None
+    # GPT-J/CodeGen rotary convention: adjacent pairs rotate together
+    # (rotate_every_two) instead of the half-split llama/neox rotation.
+    rope_interleaved: bool = False
+    # MLP bias override (gpt-j: bias-free attention but biased MLP). None
+    # falls back to dense_bias / the norm-derived default.
+    mlp_bias: Optional[bool] = None
     # lm_head bias (phi-style untied head); disables the fused-CE path.
     lm_head_bias: bool = False
     norm_eps: float = 1e-5
@@ -213,16 +219,18 @@ def apply_qk_rope(cfg: "TransformerConfig", q, k, positions):
     """Apply (possibly partial) rotary embeddings per the config.
 
     Phi-style partial rotary ropes only the first ``rotary_dim`` of head_dim;
-    the tail dims pass through. Shared by the training attention and both
-    inference decode paths so the three sites cannot drift."""
+    the tail dims pass through. ``rope_interleaved`` selects the GPT-J
+    pairwise rotation. Shared by the training attention and both inference
+    decode paths so the three sites cannot drift."""
     hd = q.shape[-1]
     rd = cfg.rotary_dim or hd
     cos, sin = rope_tables(cfg.max_seq_len, rd, cfg.rope_theta)
+    ap = lambda x: apply_rope(x, cos, sin, positions, interleaved=cfg.rope_interleaved)  # noqa: E731
     if rd < hd:
-        q = jnp.concatenate([apply_rope(q[..., :rd], cos, sin, positions), q[..., rd:]], -1)
-        k = jnp.concatenate([apply_rope(k[..., :rd], cos, sin, positions), k[..., rd:]], -1)
+        q = jnp.concatenate([ap(q[..., :rd]), q[..., rd:]], -1)
+        k = jnp.concatenate([ap(k[..., :rd]), k[..., rd:]], -1)
         return q, k
-    return apply_rope(q, cos, sin, positions), apply_rope(k, cos, sin, positions)
+    return ap(q), ap(k)
 
 
 def alibi_slopes(num_heads: int) -> jnp.ndarray:
@@ -240,11 +248,12 @@ def alibi_slopes(num_heads: int) -> jnp.ndarray:
     return jnp.asarray(slopes, jnp.float32)
 
 
-def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array) -> jax.Array:
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array,
+               interleaved: bool = False) -> jax.Array:
     """x: [B, S, H, D]; cos/sin: [maxS, D/2]; positions: [B, S]."""
     from deepspeed_tpu.ops import rope as rope_op
 
-    return rope_op(x, cos, sin, positions)
+    return rope_op(x, cos, sin, positions, interleaved=interleaved)
 
 
 class Attention(nn.Module):
@@ -300,7 +309,8 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool):
         cfg = self.config
-        bias = cfg.dense_bias if cfg.dense_bias is not None else cfg.norm == "layernorm"
+        bias = cfg.mlp_bias if cfg.mlp_bias is not None else (
+            cfg.dense_bias if cfg.dense_bias is not None else cfg.norm == "layernorm")
         if cfg.activation == "silu_glu":
             gate = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype, name="w_gate")(x)
             up = nn.Dense(cfg.intermediate_size, use_bias=bias, dtype=cfg.dtype, name="w_up")(x)

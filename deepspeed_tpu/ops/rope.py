@@ -31,5 +31,26 @@ def _xla_rope(
     return out.astype(dtype)
 
 
-def rope(x, cos, sin, positions, impl: str = "auto"):
-    return dispatch("rope", impl)(x, cos, sin, positions)
+@register("rope_interleaved", "xla")
+def _xla_rope_interleaved(
+    x: jax.Array,  # [B, S, H, D]
+    cos: jax.Array,  # [maxS, D/2]
+    sin: jax.Array,  # [maxS, D/2]
+    positions: jax.Array,  # [B, S] int
+) -> jax.Array:
+    """GPT-J/CodeGen convention: adjacent pairs (x[2i], x[2i+1]) rotate
+    together (the reference kernel's rotate_every_two), vs the half-split
+    rotation above (llama/neox rotate_half)."""
+    dtype = x.dtype
+    cos_p = cos[positions][:, :, None, :].astype(jnp.float32)  # [B,S,1,D/2]
+    sin_p = sin[positions][:, :, None, :].astype(jnp.float32)
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    r1 = x1 * cos_p - x2 * sin_p
+    r2 = x2 * cos_p + x1 * sin_p
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape).astype(dtype)
+
+
+def rope(x, cos, sin, positions, impl: str = "auto", interleaved: bool = False):
+    return dispatch("rope_interleaved" if interleaved else "rope", impl)(
+        x, cos, sin, positions)
