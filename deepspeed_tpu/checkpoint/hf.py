@@ -13,7 +13,8 @@ Supported families: llama (incl. mistral — same graph), qwen2 (llama graph
 + qkv biases), gpt2, opt, falcon (7b-style parallel block, MQA), phi (parallel
 block + partial rotary), mixtral, gpt_neox (per-head fused QKV, parallel
 residual with separate MLP norm), bloom (ALiBi + embedding layernorm), gptj
-(interleaved rotary, parallel block, biased MLP/head).
+(interleaved rotary, parallel block, biased MLP/head), codegen (gptj graph +
+mp_num-blocked fused QKV).
 Sharded checkpoints (``model.safetensors.index.json``) are read shard-by-shard
 into one host dict before conversion — peak host memory is the full fp* model
 plus the stacked copy being built. A per-layer streaming path (convert and
@@ -240,17 +241,20 @@ def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
             embed_norm=True,  # word_embeddings_layernorm
             tie_embeddings=True,  # bloom always ties lm_head to embeddings
         )
-    if mt == "gptj":
+    if mt in ("gptj", "codegen"):
+        # codegen reuses the gpt-j graph (interleaved partial rotary, shared
+        # ln_1 parallel block, biased MLP + untied biased head); only its
+        # fused-QKV storage differs (mp_num blocking, handled in the converter)
         h = hf_config["n_embd"]
         heads = hf_config["n_head"]
         act = hf_config.get("activation_function", "gelu_new")
         if act not in ("gelu_new", "gelu", "relu"):
-            raise ValueError(f"unsupported gptj activation_function {act!r}")
+            raise ValueError(f"unsupported {mt} activation_function {act!r}")
         if hf_config.get("tie_word_embeddings", False):
-            # GPTJForCausalLM's lm_head keeps its BIAS even when tied; our
-            # tied path computes x @ embed.T with no bias, which would
-            # silently drop it. Real GPT-J checkpoints are untied.
-            raise ValueError("gptj with tie_word_embeddings=true is unsupported "
+            # the lm_head keeps its BIAS even when tied; our tied path
+            # computes x @ embed.T with no bias, which would silently drop
+            # it. Real GPT-J/CodeGen checkpoints are untied.
+            raise ValueError(f"{mt} with tie_word_embeddings=true is unsupported "
                              "(the tied head would drop lm_head.bias)")
         return TransformerConfig(
             vocab_size=hf_config["vocab_size"],
@@ -269,13 +273,13 @@ def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
             qkv_bias=False,
             dense_bias=False,   # attention projections are bias-free...
             mlp_bias=True,      # ...but fc_in/fc_out carry biases
-            lm_head_bias=True,  # GPTJForCausalLM.lm_head has a bias
+            lm_head_bias=True,  # the lm_head carries a bias
             parallel_block=True,  # one shared ln_1 feeds attn AND mlp
             tie_embeddings=False,  # tied variant rejected above (bias drop)
         )
     raise ValueError(
-        f"unsupported HF model_type {mt!r} "
-        "(supported: llama/mistral/mixtral/qwen2/gpt2/opt/falcon/phi/gpt_neox/bloom/gptj)")
+        f"unsupported HF model_type {mt!r} (supported: llama/mistral/mixtral/"
+        "qwen2/gpt2/opt/falcon/phi/gpt_neox/bloom/gptj/codegen)")
 
 
 def detect_family(state: Dict[str, np.ndarray]) -> str:
@@ -296,6 +300,8 @@ def detect_family(state: Dict[str, np.ndarray]) -> str:
         return "qwen2"
     if any("self_attn.q_proj" in k for k in keys):
         return "llama"
+    if any("attn.qkv_proj" in k for k in keys):
+        return "codegen"
     if any("mlp.fc_in" in k for k in keys):
         return "gptj"
     if any(k.endswith("attn.c_attn.weight") for k in keys):
@@ -640,6 +646,28 @@ def _convert_gptj(state, cfg: TransformerConfig) -> Dict[str, Any]:
     return params
 
 
+def _convert_codegen(state, cfg: TransformerConfig) -> Dict[str, Any]:
+    """CodeGen = the GPT-J graph with an mp_num-blocked fused QKV (reference
+    ``module_inject/fusedqkv_utils.py:29`` 'codegentype'): qkv_proj rows are
+    mp_num groups of [q_local | V_LOCAL | k_local] (query, value, key order
+    inside each group, matching HF CodeGenAttention's split). The fused
+    projection is de-fused into gpt-j-style q/k/v keys and the rest of the
+    conversion delegates to :func:`_convert_gptj` — one layer mapping."""
+    h = cfg.hidden_size
+    g = _getter(state, ("transformer.", ""))
+    mp_num = 4  # fixed in HF CodeGenAttention
+    local = h // mp_num
+
+    defused = {k: v for k, v in state.items() if "attn.qkv_proj" not in k}
+    for i in range(cfg.num_layers):
+        grouped = g(f"h.{i}.attn.qkv_proj.weight").reshape(mp_num, 3 * local, h)
+        p = f"transformer.h.{i}.attn."
+        defused[p + "q_proj.weight"] = grouped[:, :local].reshape(h, h)
+        defused[p + "v_proj.weight"] = grouped[:, local: 2 * local].reshape(h, h)
+        defused[p + "k_proj.weight"] = grouped[:, 2 * local:].reshape(h, h)
+    return _convert_gptj(defused, cfg)
+
+
 _CONVERTERS = {
     "llama": _convert_llama,
     "mistral": _convert_llama,
@@ -652,6 +680,7 @@ _CONVERTERS = {
     "gpt_neox": _convert_gpt_neox,
     "bloom": _convert_bloom,
     "gptj": _convert_gptj,
+    "codegen": _convert_codegen,
 }
 
 

@@ -413,3 +413,31 @@ def test_gptj_generate_matches_hf(tmp_path):
                                  do_sample=False, pad_token_id=0).numpy()
     got = eng.generate(ids, max_new_tokens=6, do_sample=False)
     np.testing.assert_array_equal(got, want)
+
+
+def test_codegen_ingestion_logits_parity(tmp_path):
+    """CodeGen: gpt-j graph + the mp_num-blocked fused QKV (reference
+    fusedqkv_utils 'codegentype' — q|V|K order inside each of 4 groups)."""
+    # n_head=8 > mp_num=4: TWO heads per mp group, so the blocked layout is
+    # exercised in its non-degenerate form (intra-group head ordering)
+    cfg_hf = transformers.CodeGenConfig(
+        vocab_size=128, n_embd=32, n_layer=2, n_head=8, n_positions=64,
+        rotary_dim=2, activation_function="gelu_new",
+        tie_word_embeddings=False,
+    )
+    hf_model = transformers.CodeGenForCausalLM(cfg_hf)
+    hf_model.eval()
+    hf_model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg, params = load_hf_checkpoint(str(tmp_path))
+    assert cfg.rope_interleaved and cfg.parallel_block and cfg.mlp_bias
+
+    ids = np.random.default_rng(0).integers(0, 128, (2, 12))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+
+    module = CausalLM(cfg)
+    _, logits = module.apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray, params)},
+        {"input_ids": jnp.asarray(ids, jnp.int32)}, train=False)
+    np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3, atol=2e-4)
