@@ -100,14 +100,12 @@ def paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block_size
                     new_lens=None, impl: str = "auto", alibi_slopes=None):
     import deepspeed_tpu.ops.pallas.paged_attention  # noqa: F401  (registers the kernel)
 
-    if alibi_slopes is not None:
-        # the Pallas flash-decode kernel has no slope-bias path yet: alibi
-        # rides the XLA gather fallback (same routing as ops/attention.py)
-        return _xla_paged_attention(
-            q, pool_k_l, pool_v_l, block_tables, q_positions, block_size,
-            new_lens=new_lens, alibi_slopes=alibi_slopes)
+    # alibi is fused in BOTH implementations (the Pallas flash-decode kernel
+    # adds slope * key-position on its existing position iota), so dispatch
+    # is uniform — bloom keeps the fast decode path.
     return dispatch("paged_attention", impl)(
-        q, pool_k_l, pool_v_l, block_tables, q_positions, block_size, new_lens=new_lens
+        q, pool_k_l, pool_v_l, block_tables, q_positions, block_size,
+        new_lens=new_lens, alibi_slopes=alibi_slopes
     )
 
 

@@ -48,9 +48,11 @@ def _cdiv(a: int, b: int) -> int:
     return (a + b - 1) // b
 
 
-def _decode_kernel(bt_ref, ap_ref, q_ref, qpos_ref, k_hbm, v_hbm, o_ref,
-                   kbuf, vbuf, acc_ref, m_ref, l_ref, sem_k, sem_v, *,
-                   bs, ppcb, kv_heads):
+def _decode_kernel(bt_ref, ap_ref, *refs, bs, ppcb, alibi=False):
+    refs = list(refs)
+    q_ref, qpos_ref = refs.pop(0), refs.pop(0)
+    slopes_ref = refs.pop(0) if alibi else None
+    (k_hbm, v_hbm, o_ref, kbuf, vbuf, acc_ref, m_ref, l_ref, sem_k, sem_v) = refs
     n = pl.program_id(0)
     kh = pl.program_id(1)
     pc = pl.program_id(2)
@@ -86,6 +88,10 @@ def _decode_kernel(bt_ref, ap_ref, q_ref, qpos_ref, k_hbm, v_hbm, o_ref,
         # causality over SEQUENCE positions: token j of this page-chunk is at
         # global position pc*ppcb*bs + j; visible iff <= the query's position
         j = pc * ppcb * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if alibi:
+            # bloom convention slope * key-position (slot index == position);
+            # slopes arrive row-aligned with the (c, g) query layout
+            s = s + slopes_ref[0][:, None] * j.astype(jnp.float32)
         qpos = qpos_ref[0]  # [Cg]
         s = jnp.where(j <= qpos[:, None], s, _NEG_INF)
 
@@ -121,6 +127,7 @@ def flash_decode_paged(
     block_size: int,
     new_lens: jax.Array = None,  # [N] live tokens (for page skipping)
     pages_per_block: int = DEFAULT_PAGES_PER_BLOCK,
+    alibi_slopes: jax.Array = None,  # [H] fp32 (bloom ALiBi, fused in-kernel)
 ) -> jax.Array:
     N, C, H, hd = q.shape
     kvH = pool_k_l.shape[1]
@@ -153,18 +160,33 @@ def flash_decode_paged(
         max_pos = jnp.take_along_axis(q_positions, last[:, None], axis=1)[:, 0]
     active_pages = (max_pos + 1 + bs - 1) // bs  # [N]
 
-    kernel = functools.partial(_decode_kernel, bs=bs, ppcb=ppcb, kv_heads=kvH)
+    alibi = alibi_slopes is not None
+    extra = ()
+    in_specs = [
+        pl.BlockSpec((1, 1, Cgp, hd), lambda n, kh, pc, bt, ap: (n, kh, 0, 0)),
+        pl.BlockSpec((1, Cgp), lambda n, kh, pc, bt, ap: (n, 0)),
+    ]
+    if alibi:
+        # row-aligned slopes: row (c, g) of kv head kh uses slope[kh*G + g]
+        srows = jnp.broadcast_to(
+            alibi_slopes.astype(jnp.float32).reshape(kvH, 1, G), (kvH, C, G)
+        ).reshape(kvH, Cg)
+        if Cgp != Cg:
+            srows = jnp.pad(srows, ((0, 0), (0, Cgp - Cg)))
+        extra = (srows,)
+        in_specs.append(pl.BlockSpec((1, Cgp), lambda n, kh, pc, bt, ap: (kh, 0)))
+    in_specs += [
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+
+    kernel = functools.partial(_decode_kernel, bs=bs, ppcb=ppcb, alibi=alibi)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,  # block_tables, active_pages
             grid=(N, kvH, npc),
-            in_specs=[
-                pl.BlockSpec((1, 1, Cgp, hd), lambda n, kh, pc, bt, ap: (n, kh, 0, 0)),
-                pl.BlockSpec((1, Cgp), lambda n, kh, pc, bt, ap: (n, 0)),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, Cgp, hd), lambda n, kh, pc, bt, ap: (n, kh, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((ppcb * bs, 1, hd), pool_k_l.dtype),
@@ -181,7 +203,7 @@ def flash_decode_paged(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),
-    )(block_tables, active_pages, q5, qpos_rows, pool_k_l, pool_v_l)
+    )(block_tables, active_pages, q5, qpos_rows, *extra, pool_k_l, pool_v_l)
 
     out = out[:, :, :Cg].reshape(N, kvH, C, G, hd).transpose(0, 2, 1, 3, 4)
     return out.reshape(N, C, H, hd)

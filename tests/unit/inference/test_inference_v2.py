@@ -81,6 +81,9 @@ def test_build_ragged_batch_shapes():
      "embed_norm": True, "tie_embeddings": True},  # bloom-style: alibi + embed norm
     {"norm": "layernorm", "activation": "gelu_exact", "parallel_block": True,
      "parallel_mlp_norm": True, "rotary_dim": 4},  # gpt-neox-style parallel ln2
+    {"norm": "layernorm", "activation": "gelu", "parallel_block": True,
+     "rotary_dim": 4, "rope_interleaved": True, "qkv_bias": False,
+     "dense_bias": False, "mlp_bias": True},  # gpt-j-style interleaved rotary
 ])
 def test_paged_matches_dense_v1(overrides):
     """Staggered prefill+decance through v2 == per-prompt v1 greedy decode."""

@@ -72,3 +72,19 @@ def test_ragged_forward_uses_kernel_consistently():
 
     logits, _ = ragged_forward(params, cfg, pool, tokens, positions, new_lens, bt, 16)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+@pytest.mark.parametrize("kvH,ppcb", [(2, 8), (8, 8), (2, 2)])  # GQA/MHA + multi-chunk
+def test_paged_pallas_alibi_matches_xla(kvH, ppcb):
+    """ALiBi fused into the decode kernel (slope * key-position on the
+    existing position iota) — bloom keeps the Pallas fast path."""
+    from deepspeed_tpu.models.transformer import alibi_slopes
+
+    q, pk, pv, bt, pos, lens, bs = _setup(H=8, kvH=kvH, hd=16)
+    slopes = alibi_slopes(8)
+    xla = dispatch("paged_attention", "xla")
+    pallas = dispatch("paged_attention", "pallas")
+    want = xla(q, pk, pv, bt, pos, bs, alibi_slopes=slopes)
+    got = pallas(q, pk, pv, bt, pos, bs, new_lens=lens, alibi_slopes=slopes,
+                 pages_per_block=ppcb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
