@@ -278,6 +278,16 @@ class Attention(nn.Module):
         from deepspeed_tpu.ops import causal_attention
         from deepspeed_tpu.parallel.ulysses import sp_active, ulysses_shard, ulysses_unshard
 
+        if slopes is not None and cfg.sp_impl == "ring" and sp_active():
+            # the ring kernel has no slope-bias hop math yet; fall back to
+            # Ulysses LOUDLY — the memory profile differs (full seq per
+            # device after the all-to-all vs ring's O(S/P))
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                "alibi + sp_impl='ring': ring attention has no ALiBi path; "
+                "falling back to Ulysses all-to-all (full-sequence per-device "
+                "memory). Expect a different memory profile than ring.")
         if slopes is None and cfg.sp_impl == "ring" and sp_active() and mask is None:
             # ring attention: K/V rotate over the sp ring (ppermute), queries
             # stay seq-sharded — O(S/P) memory, neighbor-link comm
@@ -286,11 +296,10 @@ class Attention(nn.Module):
 
             out = ring_attention(q, k, v, mesh=get_mesh(), axis="sp")
         else:
-            if slopes is not None and sp_active():
-                raise NotImplementedError(
-                    "alibi under sequence parallelism: the all-to-all re-shards "
-                    "heads, so slopes must be sharded per head rank — not wired")
-            # Ulysses SP: seq-shard -> head-shard all-to-all around exact attention
+            # Ulysses SP: seq-shard -> head-shard all-to-all around exact
+            # attention. Alibi composes for free: ulysses_shard is a sharding
+            # CONSTRAINT (the program stays global SPMD), so the partitioner
+            # splits the per-head slope bias along with the head axis.
             q, k, v = ulysses_shard(q), ulysses_shard(k), ulysses_shard(v)
             out = causal_attention(q, k, v, mask=mask, impl=cfg.attn_impl,
                                    alibi_slopes=slopes)  # [B,S,H,hd]
