@@ -278,23 +278,15 @@ class Attention(nn.Module):
         from deepspeed_tpu.ops import causal_attention
         from deepspeed_tpu.parallel.ulysses import sp_active, ulysses_shard, ulysses_unshard
 
-        if slopes is not None and cfg.sp_impl == "ring" and sp_active():
-            # the ring kernel has no slope-bias hop math yet; fall back to
-            # Ulysses LOUDLY — the memory profile differs (full seq per
-            # device after the all-to-all vs ring's O(S/P))
-            from deepspeed_tpu.utils.logging import logger
-
-            logger.warning(
-                "alibi + sp_impl='ring': ring attention has no ALiBi path; "
-                "falling back to Ulysses all-to-all (full-sequence per-device "
-                "memory). Expect a different memory profile than ring.")
-        if slopes is None and cfg.sp_impl == "ring" and sp_active() and mask is None:
+        if cfg.sp_impl == "ring" and sp_active() and mask is None:
             # ring attention: K/V rotate over the sp ring (ppermute), queries
-            # stay seq-sharded — O(S/P) memory, neighbor-link comm
+            # stay seq-sharded — O(S/P) memory, neighbor-link comm. ALiBi
+            # rides the hops (each block's global k offset feeds the bias).
             from deepspeed_tpu.parallel.ring_attention import ring_attention
             from deepspeed_tpu.topology.mesh import get_mesh
 
-            out = ring_attention(q, k, v, mesh=get_mesh(), axis="sp")
+            out = ring_attention(q, k, v, mesh=get_mesh(), axis="sp",
+                                 alibi_slopes=slopes)
         else:
             # Ulysses SP: seq-shard -> head-shard all-to-all around exact
             # attention. Alibi composes for free: ulysses_shard is a sharding

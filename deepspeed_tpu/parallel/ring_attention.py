@@ -31,16 +31,23 @@ from deepspeed_tpu.topology.mesh import get_mesh
 _NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, m, l, o, q_start, k_start, causal: bool):
+def _block_attend(q, k, v, m, l, o, q_start, k_start, causal: bool,
+                  slopes=None):
     """Online-softmax accumulate one K/V block into (m, l, o).
 
     q: [B, Sq, Hkv, G, D] (pre-scaled); k/v: [B, Sk, Hkv, D];
     m/l: [B, Hkv, G, Sq]; o: [B, Sq, Hkv, G, D]. Positions are global.
+    ``slopes`` [Hkv, G] adds the ALiBi bias slope * GLOBAL key position
+    (bloom convention — softmax cancels the per-row shift), so k_start must
+    be the block's true global offset whenever slopes are used.
     """
     # HIGHEST: TPU einsum otherwise accumulates in bf16 and near-ties in the
     # softmax flip attention weights (catastrophic for long sequences)
     s = jnp.einsum("bqhgd,bkhd->bhgqk", q, k.astype(jnp.float32),
                    precision=jax.lax.Precision.HIGHEST)
+    if slopes is not None:
+        kpos = (k_start + jnp.arange(k.shape[1])).astype(jnp.float32)
+        s = s + slopes[None, :, :, None, None] * kpos[None, None, None, None, :]
     if causal:
         Sq, Sk = q.shape[1], k.shape[1]
         qpos = q_start + jnp.arange(Sq)
@@ -71,6 +78,7 @@ def ring_attention(
     mesh: Optional[Mesh] = None,
     axis: str = "sp",
     causal: bool = True,
+    alibi_slopes: Optional[jax.Array] = None,  # [H] bloom ALiBi
 ) -> jax.Array:
     """Exact attention with K/V rotating around the ``axis`` ring.
 
@@ -83,16 +91,19 @@ def ring_attention(
         if causal:
             from deepspeed_tpu.ops.attention import causal_attention
 
-            return causal_attention(q, k, v)
+            return causal_attention(q, k, v, alibi_slopes=alibi_slopes)
         from deepspeed_tpu.sequence.fpdt import chunked_attention
 
-        return chunked_attention(q, k, v, chunk_size=k.shape[1], causal=False)
+        return chunked_attention(q, k, v, chunk_size=k.shape[1], causal=False,
+                                 alibi_slopes=alibi_slopes)
     B, S, H, D = q.shape
     Hkv = k.shape[2]
+    slopes2 = (None if alibi_slopes is None
+               else alibi_slopes.astype(jnp.float32).reshape(Hkv, H // Hkv))
     if S % P_ring:
         raise ValueError(f"seq {S} not divisible by ring size {P_ring}")
     if causal and S % (2 * P_ring) == 0:
-        return _ring_zigzag(q, k, v, mesh, axis, P_ring)
+        return _ring_zigzag(q, k, v, mesh, axis, P_ring, slopes2)
     G = H // Hkv
     S_loc = S // P_ring
 
@@ -113,7 +124,8 @@ def ring_attention(
 
         # hop 0: attend the resident block (no comm), then P_ring-1
         # permute-then-attend rounds — exactly P_ring-1 rotations total
-        m, l, o = _block_attend(qg, kb, vb, m, l, o, q_start, idx * S_loc, causal)
+        m, l, o = _block_attend(qg, kb, vb, m, l, o, q_start, idx * S_loc, causal,
+                                slopes=slopes2)
 
         def body(carry, hop):
             kb, vb, m, l, o = carry
@@ -131,13 +143,14 @@ def ring_attention(
                 # only runs for odd-shaped fallbacks.
                 m, l, o = jax.lax.cond(
                     src <= idx,
-                    lambda m, l, o, kb, vb: _block_attend(
-                        qg, kb, vb, m, l, o, q_start, src * S_loc, causal),
-                    lambda m, l, o, kb, vb: (m, l, o),
-                    m, l, o, kb, vb,
+                    lambda m, l, o, kb, vb, ks: _block_attend(
+                        qg, kb, vb, m, l, o, q_start, ks, causal, slopes=slopes2),
+                    lambda m, l, o, kb, vb, ks: (m, l, o),
+                    m, l, o, kb, vb, src * S_loc,
                 )
             else:
-                m, l, o = _block_attend(qg, kb, vb, m, l, o, q_start, src * S_loc, causal)
+                m, l, o = _block_attend(qg, kb, vb, m, l, o, q_start, src * S_loc,
+                                        causal, slopes=slopes2)
             return (kb, vb, m, l, o), None
 
         (kb, vb, m, l, o), _ = jax.lax.scan(
@@ -158,7 +171,7 @@ def ring_attention(
     return fn(q, k, v)
 
 
-def _ring_zigzag(q, k, v, mesh, axis: str, P_ring: int):
+def _ring_zigzag(q, k, v, mesh, axis: str, P_ring: int, slopes2=None):
     """Causal ring attention with zigzag (striped) block placement.
 
     Contiguous placement under causality is pathologically imbalanced: device
@@ -235,9 +248,12 @@ def _ring_zigzag(q, k, v, mesh, axis: str, P_ring: int):
         # fully visible (late rows always see early keys); (a,z) fully masked.
         kc, kd = kb[:, :Sb], kb[:, Sb:]
         vc, vd = vb[:, :Sb], vb[:, Sb:]
-        ma, la, oa = _block_attend(qa, kc, vc, ma, la, oa, a_start, a_start, True)
-        mz, lz, oz = _block_attend(qz, kd, vd, mz, lz, oz, z_start, z_start, True)
-        mz, lz, oz = _block_attend(qz, kc, vc, mz, lz, oz, z_start, a_start, False)
+        ma, la, oa = _block_attend(qa, kc, vc, ma, la, oa, a_start, a_start, True,
+                                   slopes=slopes2)
+        mz, lz, oz = _block_attend(qz, kd, vd, mz, lz, oz, z_start, z_start, True,
+                                   slopes=slopes2)
+        mz, lz, oz = _block_attend(qz, kc, vc, mz, lz, oz, z_start, a_start, False,
+                                   slopes=slopes2)
 
         ring = [(i, (i + 1) % P_ring) for i in range(P_ring)]
 
@@ -250,7 +266,8 @@ def _ring_zigzag(q, k, v, mesh, axis: str, P_ring: int):
             vc, vd = vb[:, :Sb], vb[:, Sb:]
 
             # late half vs incoming early block: always fully visible
-            mz, lz, oz = _block_attend(qz, kc, vc, mz, lz, oz, z_start, src * Sb, False)
+            mz, lz, oz = _block_attend(qz, kc, vc, mz, lz, oz, z_start, src * Sb,
+                                       False, slopes=slopes2)
 
             # exactly one of (early-half, incoming-early) / (late-half,
             # incoming-late) is visible, decided by ring position — select the
@@ -263,7 +280,11 @@ def _ring_zigzag(q, k, v, mesh, axis: str, P_ring: int):
             m_sel = jnp.where(pred, ma, mz)
             l_sel = jnp.where(pred, la, lz)
             o_sel = jnp.where(pred, oa, oz)
-            m2, l2, o2 = _block_attend(q_sel, k_sel, v_sel, m_sel, l_sel, o_sel, 0, 0, False)
+            # ALiBi needs the TRUE global key offset of whichever block was
+            # selected (the bias is position-dependent; visibility is not)
+            k_start_sel = jnp.where(pred, src * Sb, (2 * P_ring - 1 - src) * Sb)
+            m2, l2, o2 = _block_attend(q_sel, k_sel, v_sel, m_sel, l_sel, o_sel,
+                                       0, k_start_sel, False, slopes=slopes2)
             ma = jnp.where(pred, m2, ma)
             la = jnp.where(pred, l2, la)
             oa = jnp.where(pred, o2, oa)

@@ -20,6 +20,8 @@ TPU design:
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -34,6 +36,7 @@ def chunked_attention(
     chunk_size: int = 1024,
     causal: bool = True,
     q_offset: int = 0,
+    alibi_slopes: Optional[jax.Array] = None,  # [H] bloom ALiBi
 ) -> jax.Array:
     """Exact attention via online-softmax over K/V chunks (one compiled scan).
 
@@ -43,6 +46,8 @@ def chunked_attention(
     B, Sq, H, D = q.shape
     Sk, Hkv = k.shape[1], k.shape[2]
     G = H // Hkv
+    slopes2 = (None if alibi_slopes is None
+               else alibi_slopes.astype(jnp.float32).reshape(Hkv, G))
     C = min(chunk_size, Sk)
     if Sk % C:
         raise ValueError(f"kv length {Sk} not divisible by chunk {C}")
@@ -59,7 +64,8 @@ def chunked_attention(
     def body(carry, xs):
         m, l, o = carry
         i, kb, vb = xs
-        m, l, o = _block_attend(qg, kb, vb, m, l, o, q_offset, i * C, causal)
+        m, l, o = _block_attend(qg, kb, vb, m, l, o, q_offset, i * C, causal,
+                                slopes=slopes2)
         return (m, l, o), None
 
     (m, l, o), _ = jax.lax.scan(body, (m0, l0, o0), (jnp.arange(n_chunks), kc, vc))

@@ -143,3 +143,41 @@ def test_fpdt_chunk_major_zero_copy_layout(devices):
 
     got = fp(q, cm(k), cm(v), chunk_major=True)
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("S", [64, 52])  # zigzag (S % 2P == 0) + contiguous fallback (52 % 8 != 0)
+def test_ring_attention_alibi_matches_dense(devices, S):
+    """ALiBi through the ring hops: each block's bias uses its true global
+    key offset (incl. the zigzag pair-select path)."""
+    import numpy as np
+    from deepspeed_tpu.models.transformer import alibi_slopes
+    from deepspeed_tpu.ops.attention import causal_attention
+    from deepspeed_tpu.parallel.ring_attention import ring_attention
+    from deepspeed_tpu.topology.mesh import build_mesh, mesh_context
+
+    B, H, D = 2, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32) for kk in ks)
+    slopes = alibi_slopes(H)
+    want = causal_attention(q, k, v, impl="xla", alibi_slopes=slopes)
+
+    mesh = build_mesh(axis_sizes={"sp": 4, "dp": 2})
+    with mesh_context(mesh):
+        got = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, mesh=mesh, axis="sp", alibi_slopes=slopes))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_chunked_attention_alibi_matches_dense(devices):
+    import numpy as np
+    from deepspeed_tpu.models.transformer import alibi_slopes
+    from deepspeed_tpu.ops.attention import causal_attention
+    from deepspeed_tpu.sequence.fpdt import chunked_attention
+
+    B, S, H, D = 2, 64, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32) for kk in ks)
+    slopes = alibi_slopes(H)
+    want = causal_attention(q, k, v, impl="xla", alibi_slopes=slopes)
+    got = chunked_attention(q, k, v, chunk_size=16, alibi_slopes=slopes)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
