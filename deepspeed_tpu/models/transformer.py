@@ -73,7 +73,14 @@ class TransformerConfig:
     tie_embeddings: bool = False
     remat: bool = False
     scan_layers: bool = True
-    attn_impl: str = "auto"  # auto | xla | flash
+    attn_impl: str = "auto"  # auto | xla | flash | sparse
+    # Block-sparse attention config (reference ``sparse_attention`` config
+    # section + ``ops/sparse_attention/sparsity_config.py``): a dict like
+    # {"mode": "bigbird", "block": 16, "num_random_blocks": 1, ...} consumed
+    # when attn_impl == "sparse". Training runs the tile-skipping Pallas
+    # kernels fwd AND bwd. Must be a hashable tuple-of-pairs internally, so
+    # pass a dict and it is frozen at construction.
+    sparse_attention: Optional[Any] = None
     sp_impl: str = "ulysses"  # ulysses (all-to-all) | ring (ppermute) over sp
     dtype: Any = jnp.float32  # activation dtype inside the module
     # Fused chunked-vocab LM-head + cross-entropy on the training path (the
@@ -100,6 +107,18 @@ class TransformerConfig:
                 f"moe_layer_experts has {len(self.moe_layer_experts)} entries "
                 f"for num_layers={self.num_layers} — one expert count per layer"
             )
+        if isinstance(self.sparse_attention, dict):
+            # frozen dataclass must stay hashable (configs are jit static args)
+            object.__setattr__(self, "sparse_attention",
+                               tuple(sorted(self.sparse_attention.items())))
+        if self.attn_impl == "sparse" and not self.sparse_attention:
+            raise ValueError(
+                "attn_impl='sparse' needs a sparse_attention config dict, e.g. "
+                "{'mode': 'bigbird', 'block': 16, 'num_random_blocks': 1}")
+
+    @property
+    def sparse_attention_dict(self) -> Optional[dict]:
+        return dict(self.sparse_attention) if self.sparse_attention else None
 
     def experts_for_layer(self, i: int) -> int:
         if self.moe_layer_experts is not None:
@@ -278,7 +297,36 @@ class Attention(nn.Module):
         from deepspeed_tpu.ops import causal_attention
         from deepspeed_tpu.parallel.ulysses import sp_active, ulysses_shard, ulysses_unshard
 
-        if cfg.sp_impl == "ring" and sp_active() and mask is None:
+        if cfg.attn_impl == "sparse":
+            # Block-sparse attention (reference sparse_attention config +
+            # sparsity_config.py): static layout from the config, the
+            # tile-skipping Pallas kernels run fwd AND bwd.
+            from deepspeed_tpu.ops.sparse_attention import (
+                block_sparse_attention,
+                get_sparsity_config,
+            )
+
+            if mask is not None:
+                raise NotImplementedError(
+                    "attn_impl='sparse' with a padding mask is not wired; "
+                    "right-pad to full blocks or drop the mask")
+            if sp_active():
+                raise NotImplementedError("attn_impl='sparse' under sequence parallelism")
+            if slopes is not None:
+                raise NotImplementedError("attn_impl='sparse' with alibi")
+            sa = dict(cfg.sparse_attention_dict)
+            mode = sa.pop("mode", "bigbird")
+            block = sa.pop("block", 16)
+            S = q.shape[1]
+            scfg = get_sparsity_config(mode, num_heads=cfg.num_heads,
+                                       block=block, **sa)
+            layout = scfg.make_layout(S)
+            if cfg.kv_heads != cfg.num_heads:
+                G = cfg.num_heads // cfg.kv_heads
+                k = jnp.repeat(k, G, axis=2)
+                v = jnp.repeat(v, G, axis=2)
+            out = block_sparse_attention(q, k, v, layout, block=block)
+        elif cfg.sp_impl == "ring" and sp_active() and mask is None:
             # ring attention: K/V rotate over the sp ring (ppermute), queries
             # stay seq-sharded — O(S/P) memory, neighbor-link comm. ALiBi
             # rides the hops (each block's global k offset feeds the bias).
