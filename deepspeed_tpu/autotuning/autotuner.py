@@ -139,7 +139,20 @@ class Autotuner:
             engine_cfg = {k: v for k, v in config.items() if k != "_model_overrides"}
             engine, *_ = deepspeed_tpu.initialize(model=model, config=engine_cfg, seed=seed)
             bs = engine.train_batch_size
-            make = batch_fn or (lambda s: self._default_batch(bs, s))
+            user_make = batch_fn or (lambda s: self._default_batch(bs, s))
+
+            def make(s):
+                # batch_fn cannot know each CANDIDATE's global batch (micro
+                # varies across the sweep): hand it a pool and slice the
+                # candidate's rows — a short pool is a real config error.
+                b = user_make(s)
+                lead = jax.tree_util.tree_leaves(b)[0].shape[0]
+                if lead < bs:
+                    raise ValueError(
+                        f"batch_fn returned {lead} rows < candidate train_batch_size "
+                        f"{bs}; return at least max(micro)*dp_world rows")
+                return jax.tree_util.tree_map(lambda x: x[:bs], b) if lead > bs else b
+
             for i in range(warmup):
                 engine.train_batch(make(seed + i))
             t0 = time.perf_counter()
