@@ -140,6 +140,7 @@ NIGHTLY_NODE_SUBSTRINGS = [
     "test_codegen_ingestion_logits_parity",
     "test_gpt_neox_sequential_residual_parity",
     "test_megatron_load_convert_logits_consistent",
+    "test_pipelined_alibi_embed_norm_matches_plain",
     # sibling-covered variants (the kept sibling is named): opt keeps [relu],
     # qwen2's qkv-bias is covered by gpt2+llama, phi's partial rotary by
     # gptj, the contiguous ring-alibi by the zigzag [64] case

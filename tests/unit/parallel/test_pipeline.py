@@ -318,3 +318,32 @@ def test_interleaved_causal_lm_trains(devices):
 
     # same params/seed => identical first-step loss across schedules
     np.testing.assert_allclose(losses[1][0], losses[2][0], rtol=1e-5)
+
+
+def test_pipelined_alibi_embed_norm_matches_plain(devices):
+    """Pipeline execution x the round-4 model features (ALiBi + embedding
+    layernorm): pp=2 trajectory equals the plain forward at equal global
+    batch. Nightly tier (registered in conftest)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    common = dict(vocab_size=128, hidden_size=32, intermediate_size=64,
+                  num_layers=4, num_heads=4, max_seq_len=32,
+                  norm="layernorm", activation="gelu", position="alibi",
+                  embed_norm=True)
+    ids = np.random.default_rng(0).integers(0, 128, (16, 32), dtype=np.int32)
+
+    def run(pp):
+        spec = causal_lm_spec(TransformerConfig(**common), example_seq_len=32,
+                              pipeline_microbatches=4 if pp > 1 else 0)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=spec,
+            config={"train_micro_batch_size_per_gpu": 4 if pp > 1 else 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1},
+                    "mesh": {"pp": pp, "dp": 8 // pp},
+                    "steps_per_print": 10000, "seed": 11})
+        return [float(np.asarray(engine.train_batch({"input_ids": ids})["loss"]))
+                for _ in range(3)]
+
+    np.testing.assert_allclose(run(2), run(1), rtol=2e-5, atol=2e-6)
