@@ -213,9 +213,14 @@ def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
             # HF ACT2FN 'gelu' is the exact erf gelu; 'gelu_new' the tanh form
             activation={"gelu": "gelu_exact", "gelu_new": "gelu", "relu": "relu"}[act],
             position="rope",
-            rope_theta=float(hf_config.get("rotary_emb_base", 10000.0)),
+            # newer transformers serialize rope_theta/partial_rotary_factor in
+            # place of the legacy neox spellings — accept either, legacy first
+            rope_theta=float(hf_config.get("rotary_emb_base")
+                             or hf_config.get("rope_theta", 10000.0)),
             # neox ropes only the first rotary_pct of each head
-            rotary_dim=int(hf_config.get("rotary_pct", 0.25) * (h // heads)),
+            rotary_dim=int((hf_config.get("rotary_pct")
+                            or hf_config.get("partial_rotary_factor", 0.25))
+                           * (h // heads)),
             norm_eps=float(hf_config.get("layer_norm_eps", 1e-5)),
             qkv_bias=True,
             dense_bias=True,

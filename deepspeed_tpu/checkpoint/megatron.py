@@ -47,24 +47,55 @@ def _category(key: str) -> str:
     return "replicated"
 
 
-def merge_tp_state_dicts(sds: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+#: Megatron Q/K/V row layouts by ``checkpoint_version`` (reference
+#: state_dict_factory.py:220 merge_query_key_value docstring):
+#:   0   — [(3 * np * hn), h]  globally-blocked q|k|v per rank
+#:   1.0 — [(np * hn * 3), h]  per head: [hn, 3] interleaved
+#:   2.0 — [(np * 3 * hn), h]  per head: [3, hn] blocked
+_SUPPORTED_CKPT_VERSIONS = (0, 1.0, 2.0)
+#: In-band metadata key carrying the Megatron ``checkpoint_version`` through
+#: the Dict[str, ndarray] state (0-d float64). Stamped by the loader and by
+#: merge/split; consumed (never treated as a weight) by merge/split/convert.
+_VERSION_KEY = "_checkpoint_version"
+
+
+def _check_version(version: float) -> float:
+    if version not in _SUPPORTED_CKPT_VERSIONS:
+        raise ValueError(
+            f"Megatron checkpoint_version {version!r} is not supported "
+            f"(known: {_SUPPORTED_CKPT_VERSIONS}); reference state_dict_factory.py:252")
+    return version
+
+
+def _resolve_version(sd: Dict[str, np.ndarray], version: Optional[float]) -> float:
+    """Explicit ``version`` kwarg wins; else the state's in-band key; else v0."""
+    if version is None:
+        version = float(sd.get(_VERSION_KEY, 0))
+    return _check_version(float(version))
+
+
+def merge_tp_state_dicts(sds: List[Dict[str, np.ndarray]],
+                         version: Optional[float] = None) -> Dict[str, np.ndarray]:
     """Merge per-TP-rank Megatron state dicts into the full (tp=1) state.
 
-    Reference ``MegatronSDLoader.merge_state_dict`` (state_dict_factory.py:190):
-    qkv chunks are blocked q|k|v per rank, so each rank's tensor is split in
-    3 and the thirds concatenated per category before recombining."""
-    if len(sds) == 1:
-        return dict(sds[0])
+    Reference ``MegatronSDLoader.merge_state_dict`` (state_dict_factory.py:190)
+    + ``merge_query_key_value`` (:220): version-0 qkv chunks are blocked q|k|v
+    per rank, so each rank's tensor is split in 3 and the thirds concatenated
+    per category; v1.0/v2.0 store per-HEAD-local q/k/v rows, so ranks merge by
+    plain concat (heads are contiguous per rank)."""
+    version = _resolve_version(sds[0], version)
     out: Dict[str, np.ndarray] = {}
     for key in sds[0]:
+        if key == _VERSION_KEY:
+            continue
         parts = [np.asarray(sd[key]) for sd in sds]
         cat = _category(key)
-        if cat == "qkv":
+        if cat == "qkv" and version == 0 and len(sds) > 1:
             thirds = [np.split(p, 3, axis=0) for p in parts]  # per rank: q,k,v
             out[key] = np.concatenate(
                 [np.concatenate([t[i] for t in thirds], axis=0) for i in range(3)],
                 axis=0)
-        elif cat == "col":
+        elif cat in ("col", "qkv"):
             out[key] = np.concatenate(parts, axis=0)
         elif cat == "row":
             out[key] = np.concatenate(parts, axis=1)
@@ -72,23 +103,30 @@ def merge_tp_state_dicts(sds: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarr
             if not all(np.array_equal(parts[0], p) for p in parts[1:]):
                 raise ValueError(f"replicated tensor {key!r} differs across TP ranks")
             out[key] = parts[0]
+    out[_VERSION_KEY] = np.float64(version)
     return out
 
 
-def split_tp_state_dict(sd: Dict[str, np.ndarray], tp: int) -> List[Dict[str, np.ndarray]]:
-    """Inverse of :func:`merge_tp_state_dicts` (reference ``split_state_dict``):
-    produce ``tp`` Megatron-layout rank shards from the full state."""
+def split_tp_state_dict(sd: Dict[str, np.ndarray], tp: int,
+                        version: Optional[float] = None) -> List[Dict[str, np.ndarray]]:
+    """Inverse of :func:`merge_tp_state_dicts` (reference ``split_state_dict``
+    + ``split_query_key_value`` state_dict_factory.py:257): produce ``tp``
+    Megatron-layout rank shards from the full state. v1.0/v2.0 qkv rows are
+    per-head-local, so their split is the plain 'col' row split."""
+    version = _resolve_version(sd, version)
     outs: List[Dict[str, np.ndarray]] = [dict() for _ in range(tp)]
     for key, val in sd.items():
+        if key == _VERSION_KEY:
+            continue
         val = np.asarray(val)
         cat = _category(key)
-        if cat == "qkv":
+        if cat == "qkv" and version == 0:
             q, k, v = np.split(val, 3, axis=0)
             for r, (qr, kr, vr) in enumerate(zip(np.split(q, tp, axis=0),
                                                  np.split(k, tp, axis=0),
                                                  np.split(v, tp, axis=0))):
                 outs[r][key] = np.concatenate([qr, kr, vr], axis=0)
-        elif cat == "col":
+        elif cat in ("col", "qkv"):
             for r, part in enumerate(np.split(val, tp, axis=0)):
                 outs[r][key] = part
         elif cat == "row":
@@ -97,6 +135,8 @@ def split_tp_state_dict(sd: Dict[str, np.ndarray], tp: int) -> List[Dict[str, np
         else:
             for r in range(tp):
                 outs[r][key] = val
+    for o in outs:
+        o[_VERSION_KEY] = np.float64(version)
     return outs
 
 
@@ -134,15 +174,21 @@ def load_megatron_checkpoint(ckpt_dir: str, tag: Optional[str] = None
     ranks = sorted(d for d in os.listdir(root) if d.startswith("mp_rank_"))
     if not ranks:
         raise FileNotFoundError(f"no mp_rank_* dirs under {root}")
-    sds = []
+    sds, versions = [], []
     for r in ranks:
         fp = os.path.join(root, r, "model_optim_rng.pt")
         if not os.path.exists(fp):
             fp = os.path.join(root, r, "model_rng.pt")  # older layout
         raw = torch.load(fp, map_location="cpu", weights_only=False)
+        # reference get_checkpoint_version (state_dict_factory.py:425):
+        # absent == the pre-versioning (v0) blocked q|k|v layout.
+        versions.append(float(raw.get("checkpoint_version", 0)) if isinstance(raw, dict) else 0)
         sds.append({k: v.numpy() if hasattr(v, "numpy") else np.asarray(v)
                     for k, v in _strip_model_prefix(raw).items()})
-    return merge_tp_state_dicts(sds)
+    if len(set(versions)) != 1:
+        raise ValueError(f"mp_rank shards disagree on checkpoint_version: {versions}")
+    # merge stamps _VERSION_KEY, consumed downstream by convert_megatron_state
+    return merge_tp_state_dicts(sds, version=_check_version(versions[0]))
 
 
 # ------------------------------------------------------------------ convert
@@ -168,20 +214,37 @@ def config_from_megatron(state: Dict[str, np.ndarray], num_heads: int,
     return TransformerConfig(**kw)
 
 
+def _split_qkv(arr: np.ndarray, version: float, H: int, hd: int
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """De-interleave a merged [3*H*hd, ...] qkv tensor into (q, k, v) per the
+    checkpoint_version row layout (reference merge_query_key_value docstring)."""
+    rest = arr.shape[1:]
+    if version == 0:                       # [3, H, hd] — globally blocked
+        q, k, v = np.split(arr, 3, axis=0)
+    elif version == 1.0:                   # [H, hd, 3] — per-head interleaved
+        a = arr.reshape(H, hd, 3, *rest)
+        q, k, v = (a[:, :, i].reshape(H * hd, *rest) for i in range(3))
+    else:                                  # 2.0: [H, 3, hd] — per-head blocked
+        a = arr.reshape(H, 3, hd, *rest)
+        q, k, v = (a[:, i].reshape(H * hd, *rest) for i in range(3))
+    return q, k, v
+
+
 def convert_megatron_state(state: Dict[str, np.ndarray],
                            cfg: TransformerConfig) -> Dict[str, Any]:
     """Merged Megatron GPT state -> CausalLM stacked-scan param pytree."""
     from deepspeed_tpu.checkpoint.hf import _getter, _stack
 
     h, hd, H = cfg.hidden_size, cfg.dims_per_head, cfg.num_heads
+    version = _check_version(float(state.get(_VERSION_KEY, 0)))
     g = _getter(state, ("transformer.", "encoder.", ""))
 
     def layer(i):
         p = f"layers.{i}."
-        qkv_w = g(p + "attention.query_key_value.weight")  # [3h, h] q|k|v
+        qkv_w = g(p + "attention.query_key_value.weight")  # [3h, h]
         qkv_b = g(p + "attention.query_key_value.bias")
-        wq, wk, wv = np.split(qkv_w, 3, axis=0)
-        bq, bk, bv = np.split(qkv_b, 3)
+        wq, wk, wv = _split_qkv(qkv_w, version, H, hd)
+        bq, bk, bv = _split_qkv(qkv_b, version, H, hd)
         return {
             "attn_norm": {"scale": g(p + "input_layernorm.weight"),
                           "bias": g(p + "input_layernorm.bias")},

@@ -121,9 +121,7 @@ class NVMeStreamedParams:
         # drain in-flight preads FIRST: the AIO threads write into the numpy
         # buffers held by the tokens, which must stay alive until then
         for token in self._inflight.values():
-            _, _, reqs = token
-            for r in reqs:
-                self.swapper.handle.wait(r)
+            self.swapper.swap_in_end(token, device_put=False)
         self._inflight.clear()
         self._ready.clear()
         self.swapper.close()

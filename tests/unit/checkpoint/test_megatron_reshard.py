@@ -58,7 +58,7 @@ def test_split_merge_roundtrip_and_reshard():
     full = _full_megatron_state()
     for tp in (2, 4):
         back = merge_tp_state_dicts(split_tp_state_dict(full, tp))
-        assert set(back) == set(full)
+        assert set(back) == set(full) | {"_checkpoint_version"}  # in-band metadata
         for k in full:
             np.testing.assert_array_equal(back[k], full[k], err_msg=k)
     # reshard 2 -> 4: merge the 2-way shards, re-split 4-way, merge again
@@ -126,6 +126,79 @@ def test_megatron_load_convert_logits_consistent(tmp_path):
     np.testing.assert_allclose(np.asarray(logits(params)),
                                np.asarray(logits(want_params)), rtol=1e-5, atol=1e-6)
     assert np.isfinite(np.asarray(logits(params))).all()
+
+
+def _reinterleave_qkv(full, version):
+    """Rewrite the canonical v0 blocked q|k|v rows into the given
+    checkpoint_version's row layout (reference state_dict_factory.py:220)."""
+    hd = H_ // HEADS
+    out = dict(full)
+    for k, v in full.items():
+        if "query_key_value" not in k:
+            continue
+        rest = v.shape[1:]
+        q, kk, vv = (t.reshape(HEADS, hd, *rest) for t in np.split(v, 3, axis=0))
+        axis = 2 if version == 1.0 else 1  # v1: [H, hd, 3]; v2: [H, 3, hd]
+        out[k] = np.stack([q, kk, vv], axis=axis).reshape(3 * H_, *rest)
+    return out
+
+
+@pytest.mark.parametrize("version", [1.0, 2.0])
+def test_megatron_checkpoint_version_layouts(tmp_path, version):
+    """v1.0/v2.0 checkpoints store per-head-interleaved qkv rows and merge by
+    plain concat; loading one must produce the SAME params as the equivalent
+    v0 checkpoint (reference merge_query_key_value branches on ckpt_ver)."""
+    from deepspeed_tpu.checkpoint.megatron import load_megatron_model
+
+    full_v0 = _full_megatron_state()
+    full_ver = _reinterleave_qkv(full_v0, version)
+    shards = split_tp_state_dict(full_ver, 2, version=version)
+    for r, sd in enumerate(shards):
+        d = tmp_path / f"mp_rank_{r:02d}"
+        os.makedirs(d)
+        nested = {"checkpoint_version": version, "model": {"language_model": {
+            "embedding": {
+                "word_embeddings": {"weight": torch.tensor(sd["embedding.word_embeddings.weight"])},
+                "position_embeddings": {"weight": torch.tensor(sd["embedding.position_embeddings.weight"])},
+            },
+            "transformer": {k.split("transformer.", 1)[1]: torch.tensor(v)
+                            for k, v in sd.items() if k.startswith("transformer.")},
+        }}}
+        torch.save(nested, str(d / "model_optim_rng.pt"))
+
+    cfg, params = load_megatron_model(str(tmp_path), num_heads=HEADS)
+    want = convert_megatron_state(full_v0, cfg)  # no _checkpoint_version -> v0
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), params, want)
+
+    # resharding a loaded v1/v2 state WITHOUT the version kwarg must honor the
+    # in-band version (not scramble rows through the v0 thirds split)
+    from deepspeed_tpu.checkpoint.megatron import load_megatron_checkpoint
+    state = load_megatron_checkpoint(str(tmp_path))
+    back = merge_tp_state_dicts(split_tp_state_dict(state, 4))
+    for k in full_ver:
+        np.testing.assert_allclose(back[k], full_ver[k], rtol=1e-6, err_msg=k)
+
+
+def test_megatron_unknown_checkpoint_version_raises(tmp_path):
+    """A future/unknown checkpoint_version must fail loudly, not load blocked-
+    layout math onto interleaved rows (reference asserts, ours raises)."""
+    full = _full_megatron_state()
+    sd = split_tp_state_dict(full, 1)[0]
+    d = tmp_path / "mp_rank_00"
+    os.makedirs(d)
+    nested = {"checkpoint_version": 3.0, "model": {"language_model": {
+        "embedding": {
+            "word_embeddings": {"weight": torch.tensor(sd["embedding.word_embeddings.weight"])},
+            "position_embeddings": {"weight": torch.tensor(sd["embedding.position_embeddings.weight"])},
+        },
+        "transformer": {k.split("transformer.", 1)[1]: torch.tensor(v)
+                        for k, v in sd.items() if k.startswith("transformer.")},
+    }}}
+    torch.save(nested, str(d / "model_optim_rng.pt"))
+    from deepspeed_tpu.checkpoint.megatron import load_megatron_checkpoint
+    with pytest.raises(ValueError, match="checkpoint_version"):
+        load_megatron_checkpoint(str(tmp_path))
 
 
 def test_config_inference_from_state():
