@@ -101,6 +101,11 @@ class ZeroConfig(DeepSpeedConfigModel):
     # ZeRO++ analogs (quantized collectives)
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
+    # ZeRO++ LoCo (reference coalesced_collectives.py:81
+    # all_to_all_loco_quant_reduce): error-feedback compensation on the qgZ
+    # quantized gradient reduce. Requires zero_quantized_gradients.
+    # e.g. {"err_beta": 0.8, "reset_T": 1024}
+    loco_param: Optional[Dict[str, Any]] = None
     zero_hpz_partition_size: int = 1
     # MiCS analog: shard params over a sub-group of the fsdp axis, replicate across groups
     mics_shard_size: int = -1

@@ -73,7 +73,7 @@ class TransformerConfig:
     tie_embeddings: bool = False
     remat: bool = False
     scan_layers: bool = True
-    attn_impl: str = "auto"  # auto | xla | flash | sparse
+    attn_impl: str = "auto"  # auto | xla | flash | sparse | fpdt
     # Block-sparse attention config (reference ``sparse_attention`` config
     # section + ``ops/sparse_attention/sparsity_config.py``): a dict like
     # {"mode": "bigbird", "block": 16, "num_random_blocks": 1, ...} consumed
@@ -81,6 +81,25 @@ class TransformerConfig:
     # kernels fwd AND bwd. Must be a hashable tuple-of-pairs internally, so
     # pass a dict and it is frozen at construction.
     sparse_attention: Optional[Any] = None
+    # FPDT long-context training (reference sequence/fpdt_layer.py:510,971):
+    # attn_impl == "fpdt" runs the custom-VJP chunked attention — O(Cq·Ck)
+    # score tiles, never O(S²) — composing with Ulysses sp. fpdt_offload
+    # additionally parks the q/k/v/out residuals in (pinned) host memory
+    # between forward and backward. NOTE: the memory-space transfers are
+    # rejected by the current XLA SPMD partitioner ("Side-effect HLO must
+    # have sharding" on the placement annotations) — offload therefore works
+    # on single-device jit only; the engine raises on multi-device meshes.
+    # Multi-chip long-context = fpdt (no offload) and/or ring attention.
+    fpdt_q_chunk: int = 1024
+    fpdt_kv_chunk: int = 1024
+    fpdt_offload: bool = False
+    # Engine-wired sparse embedding gradients (reference sparse_gradients +
+    # runtime/sparse_tensor.py): the embedding backward all-gathers compact
+    # (ids, rows) pairs instead of psum-ing the dense [V, H] grad. Set by the
+    # engine when the DS config has ``sparse_gradients: true`` and the
+    # heuristic wins; incompatible with tie_embeddings (the tied LM head's
+    # dense [V, H] grad would dominate anyway).
+    sparse_embedding_grads: bool = False
     sp_impl: str = "ulysses"  # ulysses (all-to-all) | ring (ppermute) over sp
     dtype: Any = jnp.float32  # activation dtype inside the module
     # Fused chunked-vocab LM-head + cross-entropy on the training path (the
@@ -115,6 +134,13 @@ class TransformerConfig:
             raise ValueError(
                 "attn_impl='sparse' needs a sparse_attention config dict, e.g. "
                 "{'mode': 'bigbird', 'block': 16, 'num_random_blocks': 1}")
+        if self.fpdt_offload and self.attn_impl != "fpdt":
+            raise ValueError("fpdt_offload=True needs attn_impl='fpdt'")
+        if self.sparse_embedding_grads and self.tie_embeddings:
+            raise ValueError(
+                "sparse_embedding_grads with tie_embeddings is counter-"
+                "productive: the tied LM head contributes a dense [V, H] "
+                "gradient either way")
 
     @property
     def sparse_attention_dict(self) -> Optional[dict]:
@@ -275,6 +301,22 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Arra
     return rope_op(x, cos, sin, positions, interleaved=interleaved)
 
 
+class _SparseGradEmbed(nn.Embed):
+    """``nn.Embed`` whose backward ships sparse rows through the DP sync.
+
+    Engine-wired ``sparse_gradients: true`` (reference runtime/sparse_tensor.py:69):
+    identical params/forward to ``nn.Embed``; only the gradient's cross-replica
+    sync changes (see ``runtime/sparse_grad.sparse_lookup``)."""
+
+    def __call__(self, inputs):
+        from deepspeed_tpu.runtime.sparse_grad import sparse_lookup
+
+        table = self.embedding
+        if self.dtype is not None:
+            table = table.astype(self.dtype)
+        return sparse_lookup(table, inputs)
+
+
 class Attention(nn.Module):
     config: TransformerConfig
 
@@ -306,14 +348,8 @@ class Attention(nn.Module):
                 get_sparsity_config,
             )
 
-            if mask is not None:
-                raise NotImplementedError(
-                    "attn_impl='sparse' with a padding mask is not wired; "
-                    "right-pad to full blocks or drop the mask")
             if sp_active():
                 raise NotImplementedError("attn_impl='sparse' under sequence parallelism")
-            if slopes is not None:
-                raise NotImplementedError("attn_impl='sparse' with alibi")
             sa = dict(cfg.sparse_attention_dict)
             mode = sa.pop("mode", "bigbird")
             block = sa.pop("block", 16)
@@ -325,7 +361,31 @@ class Attention(nn.Module):
                 G = cfg.num_heads // cfg.kv_heads
                 k = jnp.repeat(k, G, axis=2)
                 v = jnp.repeat(v, G, axis=2)
-            out = block_sparse_attention(q, k, v, layout, block=block)
+            # ALiBi and key padding compose through the masked softmax
+            # (round 5; those combos ride the XLA path — see
+            # ops/sparse_attention.block_sparse_attention)
+            out = block_sparse_attention(q, k, v, layout, block=block,
+                                         alibi_slopes=slopes, pad_mask=mask)
+        elif cfg.attn_impl == "fpdt":
+            # FPDT long-context training (reference fpdt_layer.py:971
+            # FPDT_Attention): custom-VJP chunked attention, O(Cq·Ck) score
+            # tiles. Composes with Ulysses sp exactly like the dense path —
+            # the all-to-all head shard happens via the same sharding
+            # constraints. fpdt_offload parks the q/k/v/out residuals in
+            # (pinned) host memory between forward and backward (the
+            # reference's host-offloaded chunks), SPMD-safe.
+            from deepspeed_tpu.sequence.fpdt import fpdt_attention
+
+            if mask is not None:
+                raise NotImplementedError(
+                    "attn_impl='fpdt' with a padding mask is not wired; "
+                    "right-pad and rely on causal masking or drop the mask")
+            q, k, v = ulysses_shard(q), ulysses_shard(k), ulysses_shard(v)
+            out = fpdt_attention(q, k, v, q_chunk=cfg.fpdt_q_chunk,
+                                 kv_chunk=cfg.fpdt_kv_chunk, causal=True,
+                                 alibi_slopes=slopes,
+                                 offload=cfg.fpdt_offload)
+            out = ulysses_unshard(out)
         elif cfg.sp_impl == "ring" and sp_active() and mask is None:
             # ring attention: K/V rotate over the sp ring (ppermute), queries
             # stay seq-sharded — O(S/P) memory, neighbor-link comm. ALiBi
@@ -455,7 +515,8 @@ class CausalLM(nn.Module):
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
         pad_mask = batch.get("attention_mask")  # [B, S] 1=keep
 
-        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="embed")(ids)
+        embed_cls = _SparseGradEmbed if cfg.sparse_embedding_grads else nn.Embed
+        x = embed_cls(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, name="embed")(ids)
         if cfg.embed_norm:
             x = _norm(cfg, "embed_norm")(x)
         if cfg.position == "learned":
@@ -752,4 +813,10 @@ def causal_lm_spec(
         name=f"CausalLM({config.hidden_size}x{config.num_layers})",
         partition_rules=pipeline_partition_rules if pipeline_microbatches > 1 else causal_lm_partition_rules,
         model_config=config,
+        # lets the engine re-derive the spec with config tweaks it owns
+        # (e.g. sparse_embedding_grads from DS `sparse_gradients: true`)
+        rebuild=lambda new_cfg: causal_lm_spec(
+            new_cfg, example_seq_len=example_seq_len,
+            pipeline_microbatches=pipeline_microbatches,
+            pipeline_virtual_stages=pipeline_virtual_stages),
     )

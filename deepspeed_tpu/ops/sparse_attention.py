@@ -118,6 +118,106 @@ class BigBirdSparsityConfig(SparsityConfig):
         return layout
 
 
+def _global_ranges(starts, ends):
+    """(start, end) block ranges from the reference's paired index lists
+    (sparsity_config.py VariableSparsityConfig/BSLongformerSparsityConfig):
+    with no ends, each start is a single-block range."""
+    starts = tuple(starts)
+    if ends is None:
+        return tuple((s, s + 1) for s in starts)
+    ends = tuple(ends)
+    if len(starts) != len(ends):
+        raise ValueError(
+            f"global_block_indices length {len(starts)} != "
+            f"global_block_end_indices length {len(ends)}")
+    for s, e in zip(starts, ends):
+        if e <= s:
+            raise ValueError(f"global block range ({s}, {e}) is empty")
+    return tuple(zip(starts, ends))
+
+
+def _apply_global(layout: np.ndarray, ranges, horizontal: bool) -> None:
+    """Global columns (every row attends the global blocks, causally clamped)
+    plus optional horizontal rows (global blocks attend everything ≤ them)."""
+    n = layout.shape[1]
+    for s, e in ranges:
+        for i in range(n):
+            lo, hi = min(s, i + 1), min(e, i + 1)
+            if hi > lo:
+                layout[:, i, lo:hi] = 1
+        if horizontal:
+            for g in range(s, min(e, n)):
+                layout[:, g, : g + 1] = 1
+
+
+@dataclasses.dataclass
+class VariableSparsityConfig(SparsityConfig):
+    """Variable windows + global ranges + random blocks (reference
+    ``VariableSparsityConfig`` sparsity_config.py:250, causal/unidirectional
+    form): ``local_window_blocks`` sizes each successive local window (last
+    entry repeats), ``global_block_indices``/``global_block_end_indices``
+    mark global block ranges, ``num_random_blocks`` adds per-head random
+    blocks. Pass tuples (the model config freezes dicts for hashability)."""
+
+    num_random_blocks: int = 0
+    local_window_blocks: tuple = (4,)
+    global_block_indices: tuple = (0,)
+    global_block_end_indices: Optional[tuple] = None
+    horizontal_global_attention: bool = False
+    seed: int = 0
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self._empty(seq_len)
+        n = layout.shape[1]
+        # local windows: consecutive spans of the given sizes; within a span
+        # rows attend causally to the span's blocks
+        sizes = list(self.local_window_blocks)
+        start = 0
+        while start < n:
+            w = sizes[0] if len(sizes) == 1 else sizes.pop(0)
+            end = min(start + w, n)
+            for i in range(start, end):
+                layout[:, i, start: i + 1] = 1
+            start = end
+        _apply_global(layout,
+                      _global_ranges(self.global_block_indices,
+                                     self.global_block_end_indices),
+                      self.horizontal_global_attention)
+        if self.num_random_blocks:
+            rng = np.random.RandomState(self.seed)
+            for h in range(self.num_heads):
+                for i in range(1, n):
+                    picks = rng.choice(i + 1, size=min(self.num_random_blocks, i + 1),
+                                       replace=False)
+                    layout[h, i, picks] = 1
+        return layout
+
+
+@dataclasses.dataclass
+class BSLongformerSparsityConfig(SparsityConfig):
+    """Block-sparse Longformer: sliding window + global block ranges that both
+    attend and are attended (reference ``BSLongformerSparsityConfig``
+    sparsity_config.py:555, causal form)."""
+
+    num_sliding_window_blocks: int = 3
+    global_block_indices: tuple = (0,)
+    global_block_end_indices: Optional[tuple] = None
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self._empty(seq_len)
+        n = layout.shape[1]
+        w = self.num_sliding_window_blocks
+        for i in range(n):
+            lo = max(0, i - w + 1)
+            layout[:, i, lo: i + 1] = 1
+        # longformer global tokens: vertical AND horizontal (causally clamped)
+        _apply_global(layout,
+                      _global_ranges(self.global_block_indices,
+                                     self.global_block_end_indices),
+                      horizontal=True)
+        return layout
+
+
 def get_sparsity_config(name: str, num_heads: int, block: int = 16, **kw) -> SparsityConfig:
     table = {
         "dense": DenseSparsityConfig,
@@ -125,6 +225,9 @@ def get_sparsity_config(name: str, num_heads: int, block: int = 16, **kw) -> Spa
         "bigbird": BigBirdSparsityConfig,
         "local": LocalSlidingWindowSparsityConfig,
         "sliding_window": LocalSlidingWindowSparsityConfig,
+        "variable": VariableSparsityConfig,
+        "bslongformer": BSLongformerSparsityConfig,
+        "longformer": BSLongformerSparsityConfig,
     }
     if name not in table:
         raise ValueError(f"unknown sparsity config {name!r} (have {sorted(table)})")
@@ -139,6 +242,8 @@ def block_sparse_attention_dense(
     layout: np.ndarray,  # [H, S/blk, S/blk]
     block: int,
     causal: bool = True,
+    alibi_slopes: Optional[jax.Array] = None,  # [H] bloom-style biases
+    pad_mask: Optional[jax.Array] = None,  # [B, S] 1=keep (key padding)
 ) -> jax.Array:
     """Dense-masked fallback + numerical baseline: materializes the full score
     tensor and masks (reference SparseSelfAttention math without the
@@ -154,29 +259,53 @@ def block_sparse_attention_dense(
     qs = q.astype(jnp.float32) * (D ** -0.5)
     scores = jnp.einsum("bqhd,bkhd->bhqk", qs, k.astype(jnp.float32),
                         precision=jax.lax.Precision.HIGHEST)
+    if alibi_slopes is not None:
+        # slopes * key position (HF bloom convention; softmax cancels the
+        # per-row shift) — same form as ops/attention.causal_attention
+        kpos = jnp.arange(S, dtype=jnp.float32)
+        scores = scores + (alibi_slopes.astype(jnp.float32)[None, :, None, None]
+                           * kpos[None, None, None, :])
     # expand block layout to token resolution: [H, S, S]
     tok_mask = jnp.repeat(jnp.repeat(lay, block, axis=1), block, axis=2)
     keep = tok_mask[None]
     if causal:
         keep = keep & jnp.tril(jnp.ones((S, S), bool))[None, None]
+    if pad_mask is not None:
+        keep = keep & pad_mask.astype(bool)[:, None, None, :]
     scores = jnp.where(keep, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    # rows with no active blocks (shouldn't happen with causal diag) guard:
+    # rows with no active blocks (fully-padded rows, or holes in an odd
+    # layout) must emit zeros, not a uniform average
     probs = jnp.where(keep.any(-1, keepdims=True), probs, 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out.astype(q.dtype)
 
 
 def block_sparse_attention(q, k, v, layout, block: int, causal: bool = True,
-                           impl: str = "auto") -> jax.Array:
+                           impl: str = "auto", alibi_slopes=None,
+                           pad_mask=None) -> jax.Array:
     """Block-sparse attention. On TPU, ``auto`` uses the tile-skipping Pallas
     kernel (compute/DMA scale with ``layout.sum()``, reference matmul.py:196);
     off-TPU it falls back to the dense-masked XLA path (the kernel would only
-    run under the slow Pallas interpreter there). 'pallas'/'xla' force."""
+    run under the slow Pallas interpreter there). 'pallas'/'xla' force.
+
+    ALiBi / key-padding compose on the XLA path (round-5; the reference's
+    sparse attention composes them the same way through its masked softmax,
+    softmax.py:123); fusing them into the tile-skipping kernels is a known
+    follow-up, so ``auto`` routes those combos to XLA."""
+    extras = alibi_slopes is not None or pad_mask is not None
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        if extras:
+            impl = "xla"  # documented auto-routing for unsupported-by-kernel combos
+    elif impl == "pallas" and extras:
+        raise NotImplementedError(
+            "the tile-skipping Pallas kernels do not fuse alibi/padding yet; "
+            "use impl='auto' (routes to xla) or impl='xla'")
     if impl == "xla":
-        return block_sparse_attention_dense(q, k, v, layout, block, causal)
+        return block_sparse_attention_dense(q, k, v, layout, block, causal,
+                                            alibi_slopes=alibi_slopes,
+                                            pad_mask=pad_mask)
     from deepspeed_tpu.ops.pallas.sparse_attention import block_sparse_attention_pallas
 
     return block_sparse_attention_pallas(q, k, v, layout, block, causal)

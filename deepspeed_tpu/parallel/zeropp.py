@@ -97,15 +97,20 @@ def _int8_all_gather_dim(x: jax.Array, dim: int, axes, block: int) -> jax.Array:
     return jnp.moveaxis(full, 0, dim)
 
 
-def _int8_reduce_scatter_dim(g: jax.Array, dim: int, axes, block: int) -> jax.Array:
-    """Mean-reduce-scatter of ``g`` along ``dim`` with int8 wire format.
+def _int8_rs_core(g: jax.Array, err, dim: int, axes, err_beta: float,
+                  block: int) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """The ONE qgZ wire format (quantize per destination shard -> a2a ->
+    dequant -> mean), with optional LoCo error feedback (reference
+    ``coalesced_collectives.py:81 all_to_all_loco_quant_reduce`` +
+    ``csrc/quantization/pt_binding.cpp loco_*``):
 
-    Each rank quantizes per-destination-shard rows, all-to-alls the int8
-    payload + scales, dequantizes and averages (reference qgZ's
-    quantize -> a2a -> dequant-reduce, coalesced_collectives.py:31).
+        v       = g + err_beta * err          (when err is carried)
+        wire    = Q(v)                        (int8 rows, as plain qgZ)
+        new_err = v - dequant(Q(v))           (what the wire dropped)
     """
     n = _axis_size(axes)
-    moved = jnp.moveaxis(g, dim, 0)
+    v = g if err is None else g.astype(jnp.float32) + err_beta * err
+    moved = jnp.moveaxis(v, dim, 0)
     D, rest = moved.shape[0], moved.shape[1:]
     flat = moved.reshape(-1)
     shard = flat.shape[0] // n
@@ -115,14 +120,37 @@ def _int8_reduce_scatter_dim(g: jax.Array, dim: int, axes, block: int) -> jax.Ar
     if shard_p != shard:
         rows = jnp.pad(rows, ((0, 0), (0, shard_p - shard)))
     vals, scales = quantize_int8(rows, block_size=blk)
+
+    new_err = None
+    if err is not None:
+        # local residual: exactly what this rank's wire payload dropped
+        local_deq = dequantize_int8(
+            vals.reshape(-1), scales.reshape(-1), (n, shard_p),
+            dtype=jnp.float32, block_size=blk)
+        new_err = (rows - local_deq)[:, :shard].reshape(moved.shape)
+        new_err = jnp.moveaxis(new_err, 0, dim).astype(err.dtype)
+
     vals_t = dist.all_to_all(vals.reshape(n, shard_p), axes, split_axis=0, concat_axis=0)
     scales_t = dist.all_to_all(scales.reshape(n, -1), axes, split_axis=0, concat_axis=0)
     deq = dequantize_int8(
-        vals_t.reshape(-1), scales_t.reshape(-1), (n, shard_p), dtype=jnp.float32, block_size=blk
-    )
+        vals_t.reshape(-1), scales_t.reshape(-1), (n, shard_p), dtype=jnp.float32,
+        block_size=blk)
     red = jnp.mean(deq[:, :shard], axis=0)
     out = red.reshape((D // n,) + rest).astype(g.dtype)
-    return jnp.moveaxis(out, 0, dim)
+    return jnp.moveaxis(out, 0, dim), new_err
+
+
+def _int8_reduce_scatter_dim(g: jax.Array, dim: int, axes, block: int) -> jax.Array:
+    """Plain qgZ mean-reduce-scatter (coalesced_collectives.py:31)."""
+    out, _ = _int8_rs_core(g, None, dim, axes, 0.0, block)
+    return out
+
+
+def _int8_reduce_scatter_dim_loco(g: jax.Array, err: jax.Array, dim: int, axes,
+                                  err_beta: float, block: int
+                                  ) -> Tuple[jax.Array, jax.Array]:
+    """LoCo qgZ: error-feedback compensation + refreshed residual."""
+    return _int8_rs_core(g, err, dim, axes, err_beta, block)
 
 
 def _exact_all_gather_dim(x: jax.Array, dim: int, axes) -> jax.Array:
@@ -174,18 +202,78 @@ def _swg_bwd(dim, gather_axes, other_axes, qw, qg, block, _res, g):
 sharded_weight_gather.defvjp(_swg_fwd, _swg_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def sharded_weight_gather_loco(
+    shard: jax.Array,
+    err: jax.Array,
+    inv: jax.Array,
+    dim: int,
+    gather_axes: Tuple[str, ...],
+    other_axes: Tuple[str, ...],
+    qw: bool,
+    err_beta: float,
+    block: int,
+) -> jax.Array:
+    """LoCo form of :func:`sharded_weight_gather`: same forward, but the
+    backward's quantized reduce-scatter carries error feedback. The updated
+    residual is smuggled out as ``err``'s cotangent — the engine reads the
+    error buffer's "gradient" as the next step's buffer (the same trick the
+    1-bit path uses to thread state through a compiled grad program).
+
+    ``err`` is stored in TRUE gradient units; ``inv`` (= 1/loss_scale)
+    converts to/from the scaled-loss wire units inside the backward, so a
+    dynamic loss-scale change between steps cannot corrupt the residuals
+    (same invariant as the 1-bit path)."""
+    if qw:
+        return _int8_all_gather_dim(shard, dim, gather_axes, block)
+    return _exact_all_gather_dim(shard, dim, gather_axes)
+
+
+def _swgl_fwd(shard, err, inv, dim, gather_axes, other_axes, qw, err_beta, block):
+    out = sharded_weight_gather_loco(shard, err, inv, dim, gather_axes,
+                                     other_axes, qw, err_beta, block)
+    return out, (err, inv)
+
+
+def _swgl_bwd(dim, gather_axes, other_axes, qw, err_beta, block, res, g):
+    err_true, inv = res
+    gs, new_err_wire = _int8_reduce_scatter_dim_loco(
+        g, err_true / inv, dim, gather_axes, err_beta, block)
+    if other_axes:
+        gs = jax.lax.pmean(gs, other_axes)
+    return gs, new_err_wire * inv, jnp.zeros_like(inv)
+
+
+sharded_weight_gather_loco.defvjp(_swgl_fwd, _swgl_bwd)
+
+
 def gather_params_for_compute(params, plans, qw: bool, qg: bool, block: int = DEFAULT_BLOCK,
-                              live_axes: Tuple[str, ...] = ()):
+                              live_axes: Tuple[str, ...] = (),
+                              errors=None, err_beta: float = 0.8, inv=None):
     """Map ``sharded_weight_gather`` over a param pytree inside shard_map.
 
     ``plans`` mirrors ``params`` with a ``CommPlan`` per leaf; replicated
     leaves pass through (their grads get a pmean in the caller instead).
+    ``errors`` (a mirror pytree of per-leaf residual buffers) switches the
+    sharded leaves to the LoCo gather — their grads then compensate with and
+    refresh the residuals (reference all_to_all_loco_quant_reduce); ``inv``
+    (1/loss_scale) is required with it.
     """
 
-    def one(leaf, plan):
+    if errors is None:
+        def one(leaf, plan):
+            if not plan.sharded:
+                return leaf
+            other = tuple(a for a in live_axes if a not in plan.axes)
+            return sharded_weight_gather(leaf, plan.dim, plan.axes, other, qw, qg, block)
+
+        return jax.tree_util.tree_map(one, params, plans)
+
+    def one_loco(leaf, err, plan):
         if not plan.sharded:
             return leaf
         other = tuple(a for a in live_axes if a not in plan.axes)
-        return sharded_weight_gather(leaf, plan.dim, plan.axes, other, qw, qg, block)
+        return sharded_weight_gather_loco(leaf, err, inv, plan.dim, plan.axes,
+                                          other, qw, err_beta, block)
 
-    return jax.tree_util.tree_map(one, params, plans)
+    return jax.tree_util.tree_map(one_loco, params, errors, plans)

@@ -38,7 +38,10 @@ def resolve_policy(name: str):
     if name == "offload":
         # matmul outputs (no batch dims) move to pinned host memory instead of
         # being recomputed — the reference's partitioned/CPU activation
-        # checkpointing (checkpointing.py:377 partition_activations + CPU ckpt)
+        # checkpointing (checkpointing.py:377 partition_activations + CPU ckpt).
+        # (FPDT's host offload is NOT a remat policy: its custom VJP moves the
+        # q/k/v/out residuals with sharding-preserving device_puts instead —
+        # named-offload policies lose shardings under the SPMD partitioner.)
         return pol.offload_dot_with_no_batch_dims("device", "pinned_host")
     raise ValueError(f"unknown activation_checkpointing policy {name!r}; one of {POLICIES}")
 

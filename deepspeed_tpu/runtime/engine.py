@@ -119,6 +119,19 @@ class DeepSpeedTPUEngine:
             )
         self._onebit = self._onebit_config()
 
+        # ---- sparse embedding gradients (must precede step compilation) --
+        self._resolve_sparse_gradients()
+
+        mcfg = getattr(self.model, "transformer_config", None)
+        if (getattr(mcfg, "fpdt_offload", False)
+                and int(np.prod(list(self.mesh.shape.values()))) > 1):
+            raise NotImplementedError(
+                "fpdt_offload on a multi-device mesh: XLA's SPMD partitioner "
+                "rejects host-memory placement annotations (\"Side-effect HLO "
+                "must have sharding\") in this version — run fpdt_offload "
+                "single-chip, or use attn_impl='fpdt' without offload (or "
+                "sp_impl='ring') for multi-chip long context")
+
         # ---- state init + placement --------------------------------------
         self._init_state(model_parameters, seed)
 
@@ -161,26 +174,6 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
 
         self.flops_profiler = FlopsProfiler(engine=self)
-        if self.config.model.sparse_gradients:
-            # Reference sparse-grad allreduce (runtime/sparse_tensor.py:69).
-            # The compiled step keeps the dense psum (XLA-fused, one program);
-            # the composable sparse path lives in runtime/sparse_grad.py —
-            # evaluate its size heuristic here so the flag gives guidance
-            # instead of being silently ignored.
-            from deepspeed_tpu.runtime.sparse_grad import should_use_sparse_embedding_grad
-
-            mcfg = getattr(self.model, "transformer_config", None)
-            if mcfg is not None:
-                tokens = self.config.train_batch_size * mcfg.max_seq_len
-                wins = should_use_sparse_embedding_grad(mcfg.vocab_size, tokens)
-                log_dist(
-                    "sparse_gradients: the compiled step syncs the dense "
-                    f"embedding grad; heuristic for vocab={mcfg.vocab_size}, "
-                    f"global batch tokens<={tokens}: sparse sync would "
-                    f"{'WIN' if wins else 'not win'} — see "
-                    "runtime/sparse_grad.py for the composable sparse path",
-                    ranks=[0],
-                )
         log_dist(
             f"engine ready: mesh={dict(self.mesh.shape)} zero_stage={self.zero_config.stage} "
             f"dtype={self.compute_dtype.__name__} batch={self.config.train_batch_size} "
@@ -189,6 +182,49 @@ class DeepSpeedTPUEngine:
         )
 
     # ------------------------------------------------------------------ init
+    def _resolve_sparse_gradients(self) -> None:
+        """Honor ``sparse_gradients: true`` (reference runtime/sparse_tensor.py:69
+        + engine sparse-grad allreduce paths, engine.py:2104): when the size
+        heuristic says sparse sync wins, rebuild the model spec with the
+        sparse-backward embedding lookup (``runtime/sparse_grad.sparse_lookup``)
+        so the compiled step all-gathers compact (ids, rows) pairs instead of
+        psum-ing the dense [V, H] embedding gradient."""
+        if not self.config.model.sparse_gradients:
+            return
+        from deepspeed_tpu.runtime.sparse_grad import should_use_sparse_embedding_grad
+
+        def keep_dense(why: str) -> None:
+            log_dist(f"sparse_gradients: dense embedding-grad sync kept — {why}",
+                     ranks=[0])
+
+        mcfg = getattr(self.model, "transformer_config", None)
+        if mcfg is None:
+            return keep_dense("model spec carries no transformer_config")
+        tokens = self.config.train_batch_size * mcfg.max_seq_len
+        if not should_use_sparse_embedding_grad(mcfg.vocab_size, tokens):
+            return keep_dense(
+                f"heuristic: vocab={mcfg.vocab_size} vs global batch tokens "
+                f"<={tokens}; sparse rows would not shrink the wire")
+        if getattr(mcfg, "tie_embeddings", False):
+            return keep_dense("tie_embeddings: the tied LM head grad is dense anyway")
+        if getattr(mcfg, "sparse_embedding_grads", False):
+            log_dist("sparse_gradients: model already built with sparse "
+                     "embedding grads", ranks=[0])
+            return
+        if self.model.rebuild is None:
+            return keep_dense(
+                "model spec has no rebuild hook; construct the model with "
+                "TransformerConfig(sparse_embedding_grads=True) to opt in")
+        import dataclasses as _dc
+
+        self.model = self.model.rebuild(
+            _dc.replace(mcfg, sparse_embedding_grads=True))
+        log_dist(
+            f"sparse_gradients: sparse embedding-grad sync ENGAGED "
+            f"(vocab={mcfg.vocab_size}, global batch tokens<={tokens}) — "
+            "backward all-gathers (ids, rows) pairs, no dense [V, H] psum",
+            ranks=[0])
+
     def _configure_offload(self) -> None:
         """Resolve the ZeRO-Offload/Infinity mode from the config.
 
@@ -478,10 +514,15 @@ class DeepSpeedTPUEngine:
         )
         self.grad_sharding = zero_mod.grads_sharding(param_shapes, mesh, self.zero_config, base_specs)
 
+        err_live = None
         if getattr(self, "_onebit", None):
+            err_live = self._onebit
+        elif getattr(self, "_zpp", None) and self._zpp[3]:
+            err_live = self._zpp[0]  # ZeRO++ LoCo residuals, same layout
+        if err_live:
             # per-rank error-feedback residuals: [dp_world, *shape], dim 0
             # sharded over the live data axes (each rank owns its own slice)
-            live = self._onebit
+            live = err_live
             live_entry = live if len(live) > 1 else live[0]
             W = 1
             for a in live:
@@ -559,16 +600,22 @@ class DeepSpeedTPUEngine:
                 "bypasses the secondary-partition constraint; enable one"
             )
         if not (qw or qg):
+            if zc.loco_param:
+                raise ValueError("loco_param requires zero_quantized_gradients: true "
+                                 "(LoCo compensates the qgZ wire)")
             return None
         if qg and zc.stage < 2:
             raise ValueError("zero_quantized_gradients requires ZeRO stage >= 2 (sharded gradients)")
+        loco = dict(zc.loco_param) if zc.loco_param else None
+        if loco and not qg:
+            raise ValueError("loco_param requires zero_quantized_gradients: true")
         live = tuple(a for a in BATCH_AXES if self.mesh.shape[a] > 1)
         if not live:
             logger.warning("ZeRO++ quantized collectives requested but no data-parallel axis > 1; ignored")
             return None
-        return live, qw, qg
+        return live, qw, qg, loco
 
-    def _build_zpp_micro_fn(self, live, qw: bool, qg: bool) -> Callable:
+    def _build_zpp_micro_fn(self, live, qw: bool, qg: bool, loco=None) -> Callable:
         """Micro-batch gradient fn with addressable (quantized) collectives.
 
         Runs the loss inside a partial-manual shard_map (data axes manual,
@@ -576,6 +623,11 @@ class DeepSpeedTPUEngine:
         gathered through ``sharded_weight_gather`` (int8 when qwZ), and its
         custom VJP reduce-scatters the gradients back (int8 all-to-all when
         qgZ). Reference: coalesced_collectives.py:31, partition_parameters.py:1200.
+
+        ``loco`` ({"err_beta": float, ...}) switches qgZ to the LoCo
+        error-feedback reduce (reference coalesced_collectives.py:81): the fn
+        then takes/returns per-rank residual buffers (``state.comm_error``),
+        stored in TRUE gradient units so loss-scale changes can't corrupt them.
         """
         from deepspeed_tpu.parallel import zeropp
 
@@ -604,6 +656,49 @@ class DeepSpeedTPUEngine:
         )
         batch_spec = PartitionSpec(live if len(live) > 1 else live[0])
 
+        from jax import shard_map
+
+        if loco:
+            err_beta = float(loco.get("err_beta", 0.8))
+            live_entry = live if len(live) > 1 else live[0]
+            err_specs = jax.tree_util.tree_map(
+                lambda _: PartitionSpec(live_entry), plans)
+
+            def local_fn_loco(param_shards, err_blocks, micro, scale, inv, step_rng):
+                r = jax.random.fold_in(
+                    jax.random.wrap_key_data(step_rng), jax.lax.axis_index(live)
+                )
+                errs = jax.tree_util.tree_map(lambda e: e[0], err_blocks)
+
+                def scaled_loss(shards_errs, b, rr):
+                    shards, errs_ = shards_errs
+                    full = zeropp.gather_params_for_compute(
+                        shards, plans, qw, qg, live_axes=live,
+                        errors=errs_, err_beta=err_beta, inv=inv)
+                    loss, _aux = self._loss_and_aux(full, b, rr)
+                    return (loss.astype(jnp.float32) * scale).astype(
+                        self.compute_dtype if self.fp16 else jnp.float32), loss
+
+                (_, loss), (grads, new_errs) = jax.value_and_grad(
+                    scaled_loss, has_aux=True)((param_shards, errs), micro, r)
+                grads = cast_floating(grads, jnp.float32)
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: g if p.sharded else jax.lax.pmean(g, live), grads, plans
+                )
+                new_errs = jax.tree_util.tree_map(lambda e: e[None].astype(jnp.float32),
+                                                  new_errs)
+                return grads, new_errs, jax.lax.pmean(loss, live)
+
+            return shard_map(
+                local_fn_loco,
+                mesh=mesh,
+                in_specs=(param_in_specs, err_specs, batch_spec,
+                          PartitionSpec(), PartitionSpec(), PartitionSpec()),
+                out_specs=(grad_out_specs, err_specs, PartitionSpec()),
+                axis_names=set(live),
+                check_vma=False,
+            )
+
         def local_fn(param_shards, micro, scale, step_rng):
             # de-correlate dropout across data ranks
             r = jax.random.fold_in(
@@ -622,8 +717,6 @@ class DeepSpeedTPUEngine:
                 lambda g, p: g if p.sharded else jax.lax.pmean(g, live), grads, plans
             )
             return grads, jax.lax.pmean(loss, live)
-
-        from jax import shard_map
 
         return shard_map(
             local_fn,
@@ -741,6 +834,7 @@ class DeepSpeedTPUEngine:
         grad_pspecs = self.grad_sharding  # NamedShardings: usable without a context mesh
 
         zpp_fn = self._build_zpp_micro_fn(*self._zpp) if self._zpp else None
+        zpp_loco = self._zpp[3] if self._zpp else None
         ob_fn = self._build_onebit_fn(self._onebit) if self._onebit else None
 
         def train_step(state: TrainState, batch):
@@ -805,6 +899,45 @@ class DeepSpeedTPUEngine:
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params
             )
             zero_grads = jax.lax.with_sharding_constraint(zero_grads, grad_pspecs)
+
+            if zpp_loco is not None:
+                # LoCo (reference coalesced_collectives.py:81): residuals ride
+                # the micro-step carry; reset every reset_T steps (reference
+                # loco_idx > reset_T re-zeroes the buffers).
+                inv_s = 1.0 / scale
+                err0 = state.comm_error
+                reset_T = int(zpp_loco.get("reset_T", 0) or 0)
+                if reset_T:
+                    do_reset = (state.step % reset_T == 0) & (state.step > 0)
+                    err0 = jax.tree_util.tree_map(
+                        lambda e: jnp.where(do_reset, jnp.zeros_like(e), e), err0)
+
+                def micro_step_loco(carry, micro_batch):
+                    acc, err, i = carry
+                    grads, err, loss = zpp_fn(
+                        compute_params, err, micro_batch, scale, inv_s,
+                        jax.random.key_data(jax.random.fold_in(step_rng, i)))
+                    acc = jax.tree_util.tree_map(lambda a, g: a + g, acc, grads)
+                    acc = jax.lax.with_sharding_constraint(acc, grad_pspecs)
+                    return (acc, err, i + 1), loss
+
+                if gas == 1:
+                    (grads, new_err, _), losses = micro_step_loco(
+                        (zero_grads, err0, 0),
+                        jax.tree_util.tree_map(lambda x: x[0], batch))
+                    losses = losses[None]
+                else:
+                    (grads, new_err, _), losses = jax.lax.scan(
+                        micro_step_loco, (zero_grads, err0, 0), batch)
+
+                new_state, metrics = self._update_math(state, grads, jax.random.key_data(rng))
+                # overflow => keep the previous residuals (as the 1-bit path)
+                keep = ~metrics["overflow"]
+                new_err = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(keep, n, o), new_err, state.comm_error)
+                new_state = new_state._replace(comm_error=new_err)
+                metrics["loss"] = jnp.mean(losses.astype(jnp.float32))
+                return new_state, metrics
 
             if gas == 1:
                 (grads, _), losses = micro_step((zero_grads, 0), jax.tree_util.tree_map(lambda x: x[0], batch))
@@ -1163,6 +1296,12 @@ class DeepSpeedTPUEngine:
                 "1-bit compressed gradients are only wired into train_batch "
                 "(the error-feedback state lives in the fused step); use "
                 "train_batch with gradient_compression"
+            )
+        if self._zpp and self._zpp[3]:
+            raise NotImplementedError(
+                "ZeRO++ LoCo is only wired into train_batch (the residual "
+                "state lives in the fused step); use train_batch or drop "
+                "loco_param"
             )
         set_mesh(self.mesh)
         if batch is None:

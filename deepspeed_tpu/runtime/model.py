@@ -39,6 +39,11 @@ class ModelSpec:
     # consumers (init_inference's training-engine path, the hybrid engine)
     # can rebuild an inference view without the caller re-passing it.
     model_config: Optional[Any] = None
+    # Optional factory: rebuild this spec from an updated model_config. Set by
+    # causal_lm_spec; used by the engine to honor DS-config flags that alter
+    # the model's compiled graph (e.g. sparse_gradients -> sparse embedding
+    # lookup) without the caller re-constructing the spec.
+    rebuild: Optional[Callable[[Any], "ModelSpec"]] = None
 
     @property
     def transformer_config(self) -> Optional[Any]:

@@ -26,10 +26,12 @@ guidance rather than silently ignored.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -97,6 +99,95 @@ def sparse_embedding_grad_allreduce(ids: jax.Array, g_x: jax.Array,
         in_specs=(P(axis), P(axis)), out_specs=P(),
         check_vma=False,
     )(ids, g_x)
+
+
+# ------------------------------------------------------- compiled-step wiring
+
+def sparse_lookup(table: jax.Array, ids: jax.Array) -> jax.Array:
+    """Embedding lookup whose BACKWARD ships sparse rows through the sync.
+
+    The engine-wired form of this module (round-5; reference
+    ``runtime/sparse_tensor.py:69`` + engine sparse-grad paths
+    ``runtime/engine.py:2104``): a custom-VJP around ``take`` whose backward
+    runs local-rows → all-gather of the compact ``(ids [T], rows [T, H])``
+    pairs over every token-sharding mesh axis → one scatter-add, replicated.
+    The SPMD partitioner therefore never sees a sharded [V, H] scatter and
+    inserts NO dense all-reduce — comm drops from ``V*H`` to ``T*(H+1)``
+    elements. Token-sharding axes are captured from the active mesh at trace
+    time; with no mesh (or a 1-device mesh) the backward degenerates to the
+    plain local scatter-add.
+    """
+    from deepspeed_tpu.topology.mesh import get_mesh, has_mesh
+
+    axes: Tuple[str, ...] = ()
+    if has_mesh():
+        mesh = get_mesh()
+        axes = tuple(a for a in ("dp", "fsdp", "sp") if mesh.shape.get(a, 1) > 1)
+    return _sparse_lookup(table, ids, axes)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _sparse_lookup(table, ids, token_axes):
+    return jnp.take(table, ids, axis=0)
+
+
+def _sparse_lookup_fwd(table, ids, token_axes):
+    return jnp.take(table, ids, axis=0), (table, ids)
+
+
+def _sparse_lookup_bwd(token_axes, res, g):
+    table, ids = res
+    V, Hd = table.shape
+    ids_zero = np.zeros(ids.shape, dtype=jax.dtypes.float0)
+
+    def local_scatter(fids, rows):
+        return jnp.zeros((V, Hd), jnp.float32).at[fids].add(rows)
+
+    if not token_axes:
+        dense = local_scatter(ids.reshape(-1),
+                              g.reshape(-1, Hd).astype(jnp.float32))
+        return dense.astype(table.dtype), ids_zero
+
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.topology.mesh import get_mesh
+
+    def gather_scatter(ids_l, g_l, axes):
+        fids = ids_l.reshape(-1)
+        rows = g_l.reshape(fids.shape[0], -1).astype(jnp.float32)
+        for ax in axes:  # compact pairs ride the wire, not [V, H]
+            fids = comm.all_gather(fids, ax, concat_axis=0, tiled=True)
+            rows = comm.all_gather(rows, ax, concat_axis=0, tiled=True)
+        return local_scatter(fids, rows)
+
+    # Already inside a manual shard_map over the token axes (the ZeRO++/1-bit
+    # micro fn traces the loss there)? The axis names are bound — gather
+    # directly instead of nesting another shard_map. The engine's manual
+    # convention is per-rank LOCAL grads that a downstream pmean / mean-RS
+    # averages; our gather-scatter is already the GLOBAL sum, so divide by
+    # the gathered world so that average reproduces the sum exactly.
+    from jax._src import mesh as mesh_lib
+
+    manual = set(getattr(mesh_lib.get_abstract_mesh(), "manual_axes", ()) or ())
+    bound = tuple(a for a in token_axes if a in manual)
+    if bound:
+        world = 1
+        for ax in bound:
+            world *= jax.lax.axis_size(ax)
+        dense = gather_scatter(ids, g, bound) / world
+        return dense.astype(table.dtype), ids_zero
+
+    batch_axes = tuple(a for a in token_axes if a != "sp") or None
+    seq_axis = "sp" if "sp" in token_axes else None
+    dense = jax.shard_map(
+        lambda i, gg: gather_scatter(i, gg, token_axes),
+        mesh=get_mesh(),
+        in_specs=(P(batch_axes, seq_axis), P(batch_axes, seq_axis, None)),
+        out_specs=P(), check_vma=False,
+    )(ids, g)
+    return dense.astype(table.dtype), ids_zero
+
+
+_sparse_lookup.defvjp(_sparse_lookup_fwd, _sparse_lookup_bwd)
 
 
 def should_use_sparse_embedding_grad(vocab_size: int, global_batch_tokens: int,

@@ -6,4 +6,8 @@ Reference: ``deepspeed/sequence/`` — Ulysses (``layer.py``, implemented in
 (``deepspeed_tpu.parallel.ring_attention``) is a TPU-native addition.
 """
 
-from deepspeed_tpu.sequence.fpdt import FPDTAttention, chunked_attention
+from deepspeed_tpu.sequence.fpdt import (
+    FPDTAttention,
+    chunked_attention,
+    fpdt_attention,
+)

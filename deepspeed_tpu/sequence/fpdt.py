@@ -20,6 +20,7 @@ TPU design:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -76,6 +77,179 @@ def _normalize_out(o, l):
     """Online-softmax epilogue shared by the compiled scan and the FPDT host
     loop: o [B,Sq,Hkv,G,D] normalized by the accumulated l [B,Hkv,G,Sq]."""
     return o / jnp.maximum(l.transpose(0, 3, 1, 2)[..., None], 1e-30)
+
+
+# --------------------------------------------------------------- training VJP
+
+def fpdt_attention(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, Hkv, D]
+    v: jax.Array,
+    q_chunk: int = 1024,
+    kv_chunk: int = 1024,
+    causal: bool = True,
+    q_offset: int = 0,
+    alibi_slopes: Optional[jax.Array] = None,  # [H]
+    offload: bool = False,
+) -> jax.Array:
+    """Differentiable chunked attention — the FPDT *training* core.
+
+    Reference ``sequence/fpdt_layer.py:510 _FPDTGPUOffloadingAttentionImpl_``
+    implements forward AND backward over (query-chunk, kv-chunk) tiles so
+    training sequences scale past attention's O(S²) memory; this is the same
+    math as one custom-VJP function: a double ``lax.scan`` online-softmax
+    forward saving only (out, logsumexp), and a flash-style backward that
+    recomputes each tile's probabilities from the saved logsumexp. Peak
+    residual memory is O(S·D) (the inputs + out + lse) with O(Cq·Ck) score
+    tiles — never O(S²). Causally-dead tiles are skipped with ``lax.cond``
+    in both passes. Composes with Ulysses SP (heads already sharded by the
+    surrounding all-to-all).
+
+    ``offload=True`` parks the large residuals (q/k/v/out) in host memory
+    between forward and backward via sharding-preserving ``device_put``
+    transfers XLA schedules asynchronously — the reference's double-buffered
+    host offload (fpdt_layer.py:462 SequenceChunk), SPMD-safe.
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    Cq, Ck = min(q_chunk, Sq), min(kv_chunk, Sk)
+    if Sq % Cq or Sk % Ck:
+        raise ValueError(f"seq {Sq}/{Sk} must divide by q_chunk {Cq} / kv_chunk {Ck}")
+    return _fpdt(q, k, v, alibi_slopes, Cq, Ck, causal, q_offset, offload)
+
+
+def _fpdt_prep(q, k, v, slopes, Cq, Ck):
+    """Shared fwd/bwd reshapes: chunk-leading layouts + pre-scaled fp32 q."""
+    B, Sq, H, D = q.shape
+    Sk, Hkv = k.shape[1], k.shape[2]
+    G = H // Hkv
+    nq, nk = Sq // Cq, Sk // Ck
+    qg = (q.reshape(B, nq, Cq, Hkv, G, D).transpose(1, 0, 2, 3, 4, 5)
+          .astype(jnp.float32)) * (D ** -0.5)
+    kc = k.reshape(B, nk, Ck, Hkv, D).transpose(1, 0, 2, 3, 4)
+    vc = v.reshape(B, nk, Ck, Hkv, D).transpose(1, 0, 2, 3, 4)
+    slopes2 = (None if slopes is None
+               else slopes.astype(jnp.float32).reshape(Hkv, G))
+    return qg, kc, vc, slopes2, (B, Sq, H, D, Sk, Hkv, G, nq, nk)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _fpdt(q, k, v, slopes, Cq, Ck, causal, q_offset, offload):
+    out, _ = _fpdt_fwd(q, k, v, slopes, Cq, Ck, causal, q_offset, offload)
+    return out
+
+
+def _fpdt_fwd(q, k, v, slopes, Cq, Ck, causal, q_offset, offload):
+    qg, kc, vc, slopes2, (B, Sq, H, D, Sk, Hkv, G, nq, nk) = \
+        _fpdt_prep(q, k, v, slopes, Cq, Ck)
+
+    def q_body(_, xs):
+        i, qi = xs  # qi [B, Cq, Hkv, G, D]
+        q_start = q_offset + i * Cq
+        m0 = jnp.full((B, Hkv, G, Cq), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, Hkv, G, Cq), jnp.float32)
+        o0 = jnp.zeros((B, Cq, Hkv, G, D), jnp.float32)
+
+        def kv_body(carry, ys):
+            j, kb, vb = ys
+            attend = lambda c: _block_attend(qi, kb, vb, *c, q_start, j * Ck,  # noqa: E731
+                                             causal, slopes=slopes2)
+            if causal:  # skip causally-dead tiles (real XLA branch, not select)
+                carry = jax.lax.cond(j * Ck <= q_start + Cq - 1, attend,
+                                     lambda c: c, carry)
+            else:
+                carry = attend(carry)
+            return carry, None
+
+        (m, l, o), _ = jax.lax.scan(kv_body, (m0, l0, o0),
+                                    (jnp.arange(nk), kc, vc))
+        out_i = _normalize_out(o, l)                        # [B,Cq,Hkv,G,D]
+        lse_i = m + jnp.log(jnp.maximum(l, 1e-30))          # [B,Hkv,G,Cq]
+        return None, (out_i, lse_i)
+
+    _, (outs, lses) = jax.lax.scan(q_body, None, (jnp.arange(nq), qg))
+    out = outs.transpose(1, 0, 2, 3, 4, 5).reshape(B, Sq, H, D).astype(q.dtype)
+    if offload:
+        # big residuals park in (pinned) host memory until the backward —
+        # sharding-preserving transfers, safe under the SPMD partitioner
+        host = lambda x: jax.device_put(x, jax.memory.Space.Host)  # noqa: E731
+        return out, (host(q), host(k), host(v), slopes, host(out), lses)
+    return out, (q, k, v, slopes, out, lses)
+
+
+def _fpdt_bwd(Cq, Ck, causal, q_offset, offload, res, dout):
+    q, k, v, slopes, out, lses = res      # lses [nq, B, Hkv, G, Cq]
+    if offload:
+        dev = lambda x: jax.device_put(x, jax.memory.Space.Device)  # noqa: E731
+        q, k, v, out = dev(q), dev(k), dev(v), dev(out)
+    qg, kc, vc, slopes2, (B, Sq, H, D, Sk, Hkv, G, nq, nk) = \
+        _fpdt_prep(q, k, v, slopes, Cq, Ck)
+    scale = D ** -0.5
+    dog = (dout.reshape(B, nq, Cq, Hkv, G, D)
+           .transpose(1, 0, 2, 3, 4, 5).astype(jnp.float32))
+    # delta_i = rowsum(dout * out) — the softmax-jacobian diagonal term
+    delta = ((dout.astype(jnp.float32) * out.astype(jnp.float32))
+             .sum(-1).reshape(B, nq, Cq, Hkv, G)
+             .transpose(1, 0, 3, 4, 2))                     # [nq,B,Hkv,G,Cq]
+
+    def tile_scores(qi, kb, q_start, k_start):
+        s = jnp.einsum("bqhgd,bkhd->bhgqk", qi, kb.astype(jnp.float32),
+                       precision=jax.lax.Precision.HIGHEST)
+        if slopes2 is not None:
+            kpos = (k_start + jnp.arange(Ck)).astype(jnp.float32)
+            s = s + slopes2[None, :, :, None, None] * kpos[None, None, None, None, :]
+        if causal:
+            keep = (q_start + jnp.arange(Cq))[:, None] >= (k_start + jnp.arange(Ck))[None, :]
+            s = jnp.where(keep[None, None, None], s, _NEG_INF)
+        return s
+
+    def q_body(carry, xs):
+        dk, dv = carry  # [nk, B, Ck, Hkv, D] fp32 accumulators
+        i, qi, doi, lsei, deltai = xs
+        q_start = q_offset + i * Cq
+        dq0 = jnp.zeros((B, Cq, Hkv, G, D), jnp.float32)
+
+        def kv_body(carry2, ys):
+            dq_i, dk, dv = carry2
+            j, kb, vb = ys
+
+            def live_fn(dq_i, dk, dv):
+                s = tile_scores(qi, kb, q_start, j * Ck)
+                p = jnp.exp(s - lsei[..., None])
+                p = jnp.where(s <= _NEG_INF / 2, 0.0, p)    # fully-masked rows
+                dv_t = jnp.einsum("bhgqk,bqhgd->bkhd", p, doi,
+                                  precision=jax.lax.Precision.HIGHEST)
+                dp = jnp.einsum("bqhgd,bkhd->bhgqk", doi, vb.astype(jnp.float32),
+                                precision=jax.lax.Precision.HIGHEST)
+                ds = p * (dp - deltai[..., None])
+                dq_t = jnp.einsum("bhgqk,bkhd->bqhgd", ds, kb.astype(jnp.float32),
+                                  precision=jax.lax.Precision.HIGHEST)
+                dk_t = jnp.einsum("bhgqk,bqhgd->bkhd", ds, qi,
+                                  precision=jax.lax.Precision.HIGHEST)
+                return (dq_i + dq_t, dk.at[j].add(dk_t), dv.at[j].add(dv_t))
+
+            if causal:
+                return jax.lax.cond(
+                    j * Ck <= q_start + Cq - 1, live_fn,
+                    lambda a, b, c: (a, b, c), dq_i, dk, dv), None
+            return live_fn(dq_i, dk, dv), None
+
+        (dq_i, dk, dv), _ = jax.lax.scan(
+            kv_body, (dq0, dk, dv), (jnp.arange(nk), kc, vc))
+        return (dk, dv), dq_i
+
+    dk0 = jnp.zeros((nk, B, Ck, Hkv, D), jnp.float32)
+    dv0 = jnp.zeros((nk, B, Ck, Hkv, D), jnp.float32)
+    (dk, dv), dqs = jax.lax.scan(q_body, (dk0, dv0),
+                                 (jnp.arange(nq), qg, dog, lses, delta))
+    dq = (dqs.transpose(1, 0, 2, 3, 4, 5).reshape(B, Sq, H, D) * scale).astype(q.dtype)
+    dk = dk.transpose(1, 0, 2, 3, 4).reshape(B, Sk, Hkv, D).astype(k.dtype)
+    dv = dv.transpose(1, 0, 2, 3, 4).reshape(B, Sk, Hkv, D).astype(v.dtype)
+    dslopes = None if slopes is None else jnp.zeros_like(slopes)
+    return dq, dk, dv, dslopes
+
+
+_fpdt.defvjp(_fpdt_fwd, _fpdt_bwd)
 
 
 class FPDTAttention:

@@ -55,3 +55,167 @@ def test_size_heuristic_and_volume():
     assert should_use_sparse_embedding_grad(32000, 64 * 1024) is False
     dense, sparse = sparse_grad_comm_volume(50304, 768, dp=8, local_tokens=1024)
     assert sparse < dense  # the win the reference's sparse path exists for
+
+
+# ----------------------------------------------------- engine-wired (round 5)
+
+def test_sparse_lookup_grad_equals_take(devices):
+    """The custom-VJP lookup's table grad must equal jnp.take's, computed
+    under an active dp mesh with batch-sharded ids."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.runtime.sparse_grad import sparse_lookup
+    from deepspeed_tpu.topology.mesh import set_mesh
+
+    mesh = build_mesh(axis_sizes={"dp": 8})
+    set_mesh(mesh)
+    rng = np.random.default_rng(2)
+    table = jnp.asarray(rng.standard_normal((V, H)), jnp.float32)
+    ids = jax.device_put(
+        jnp.asarray(rng.integers(0, V, (8, 4), dtype=np.int32)),
+        NamedSharding(mesh, P("dp", None)))
+    w = jnp.asarray(rng.standard_normal((8, 4, H)), jnp.float32)
+
+    g_sparse = jax.jit(jax.grad(lambda t: (sparse_lookup(t, ids) * w).sum()))(table)
+    g_dense = jax.grad(lambda t: (jnp.take(t, ids, axis=0) * w).sum())(table)
+    np.testing.assert_allclose(np.asarray(g_sparse), np.asarray(g_dense),
+                               rtol=1e-5, atol=1e-6)
+
+
+def _hlo_for(sparse: bool, mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=512, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=2, max_seq_len=16, sparse_embedding_grads=sparse)
+    model = CausalLM(cfg)
+    ids = jax.device_put(jnp.zeros((8, 16), jnp.int32),
+                         NamedSharding(mesh, P("dp", None)))
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids}, train=False)["params"]
+
+    def loss(p, i):
+        return model.apply({"params": p}, {"input_ids": i}, train=False)[0]
+
+    return jax.jit(jax.grad(loss)).lower(params, ids).compile().as_text()
+
+
+def test_compiled_step_comm_pattern(devices):
+    """With sparse grads the compiled program must contain NO dense [V, H]
+    embedding-grad all-reduce — the wire carries the gathered (ids, rows)
+    pairs instead. The dense build is the positive control."""
+    from deepspeed_tpu.topology.mesh import set_mesh
+
+    mesh = build_mesh(axis_sizes={"dp": 8})
+    set_mesh(mesh)
+    dense_hlo = _hlo_for(False, mesh)
+    sparse_hlo = _hlo_for(True, mesh)
+
+    # the [512, 32] embedding-grad all-reduce (metadata pins it to the embed
+    # scatter-add transpose — the untied LM head's dense [V, H] grad reduce
+    # legitimately remains in both builds) exists in the dense build only
+    def embed_grad_reduces(hlo):
+        return [ln for ln in hlo.splitlines()
+                if "all-reduce" in ln and "512,32" in ln and "embed" in ln]
+
+    assert embed_grad_reduces(dense_hlo), "positive control broken"
+    assert not embed_grad_reduces(sparse_hlo)
+    assert "all-gather" in sparse_hlo  # the compact pairs ride the wire
+
+
+def test_engine_sparse_gradients_trajectory(devices):
+    """`sparse_gradients: true` engages the sparse lookup (heuristic wins at
+    vocab=512 vs 128 batch tokens) and the training trajectory matches the
+    dense-sync engine exactly."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    model_cfg = TransformerConfig(
+        vocab_size=512, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=2, max_seq_len=16)
+
+    def run(sparse):
+        cfg = {"train_micro_batch_size_per_gpu": 1,
+               "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+               "sparse_gradients": sparse, "steps_per_print": 1000}
+        eng, *_ = deepspeed_tpu.initialize(
+            model=causal_lm_spec(model_cfg, example_seq_len=16),
+            config=cfg, seed=5)
+        if sparse:
+            assert eng.model.transformer_config.sparse_embedding_grads
+        rng = np.random.default_rng(7)
+        losses = []
+        for _ in range(3):
+            batch = {"input_ids": rng.integers(
+                0, 512, (eng.train_batch_size, 16), dtype=np.int32)}
+            losses.append(float(eng.train_batch(batch)["loss"]))
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+
+def test_sparse_gradients_compose_with_zeropp(devices):
+    """Sparse embedding grads inside the ZeRO++ manual-shard_map micro fn:
+    the backward detects the bound axes and gathers directly (no nested
+    shard_map). Trajectory within qgZ quantization tolerance of the
+    dense-sync qgZ engine."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    model_cfg = TransformerConfig(
+        vocab_size=512, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=2, max_seq_len=16)
+
+    def run(sparse):
+        cfg = {"train_micro_batch_size_per_gpu": 1,
+               "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+               "zero_optimization": {"stage": 2, "zero_quantized_gradients": True},
+               "sparse_gradients": sparse, "steps_per_print": 1000}
+        eng, *_ = deepspeed_tpu.initialize(
+            model=causal_lm_spec(model_cfg, example_seq_len=16),
+            config=cfg, seed=5)
+        if sparse:
+            assert eng.model.transformer_config.sparse_embedding_grads
+        rng = np.random.default_rng(9)
+        losses = []
+        for _ in range(3):
+            batch = {"input_ids": rng.integers(
+                0, 512, (eng.train_batch_size, 16), dtype=np.int32)}
+            losses.append(float(eng.train_batch(batch)["loss"]))
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=0.05)
+
+
+def test_sparse_lookup_grad_scale_inside_manual_shard_map(devices):
+    """Inside a manual shard_map (the ZeRO++/1-bit micro-fn convention:
+    per-rank grads that a downstream pmean averages), the sparse backward
+    must reproduce jnp.take's convention EXACTLY — review r5 caught a dp_world
+    over-count here."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.runtime.sparse_grad import sparse_lookup
+    from deepspeed_tpu.topology.mesh import set_mesh
+
+    mesh = build_mesh(axis_sizes={"dp": 8})
+    set_mesh(mesh)
+    rng = np.random.default_rng(3)
+    table = jnp.asarray(rng.standard_normal((V, H)), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, V, (8, 4), dtype=np.int32))
+    w = jnp.asarray(rng.standard_normal((8, 4, H)), jnp.float32)
+
+    def per_rank_grad(lookup):
+        def local(table, ids_l, w_l):
+            g = jax.grad(lambda t: (lookup(t, ids_l) * w_l).sum())(table)
+            return jax.lax.pmean(g, "dp")  # the engine's unsharded-leaf mean
+
+        return shard_map(local, mesh=mesh,
+                         in_specs=(P(), P("dp"), P("dp")), out_specs=P(),
+                         check_vma=False)(table, ids, w)
+
+    g_sparse = per_rank_grad(sparse_lookup)
+    g_dense = per_rank_grad(lambda t, i: jnp.take(t, i, axis=0))
+    np.testing.assert_allclose(np.asarray(g_sparse), np.asarray(g_dense),
+                               rtol=1e-5, atol=1e-6)
