@@ -410,6 +410,50 @@ def bench_train_long_context(peak_flops):
     }
 
 
+def bench_train_fpdt_long_context(peak_flops):
+    """FPDT chunked-attention TRAINING at 32k on one chip (round 5; reference
+    fpdt_layer.py claims training sequences past attention's memory wall).
+
+    The custom-VJP chunked attention holds O(S*chunk) score state instead of
+    O(S^2): 32k would need ~12 GB of fp32 scores per (layer, head) pair dense,
+    and the flash kernel's backward still rematerializes full rows; FPDT's
+    tile recompute keeps the whole 125M-geometry model + 32k tokens resident
+    on one v5e chip."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    seq = 32768
+    cfg = TransformerConfig(
+        vocab_size=32000, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, max_seq_len=seq,
+        norm="rmsnorm", activation="silu_glu", position="rope",
+        attn_impl="fpdt", fpdt_q_chunk=2048, fpdt_kv_chunk=2048,
+        remat=True, dtype=jax.numpy.bfloat16, scan_layers=False, fused_ce=False,
+    )
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+        },
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    tok_per_sec = _train_tokens_per_sec(engine, batch, steps=3, warmup=1)
+    return {
+        "seq_len": seq,
+        "attn_impl": "fpdt",
+        "tokens_per_sec_per_chip": round(tok_per_sec, 1),
+        "mfu": round(tok_per_sec * cfg.flops_per_token(seq) / peak_flops, 4),
+    }
+
+
 def _probe_tpu(timeout_s: float = 180.0) -> bool:
     """True iff the TPU backend initializes within timeout_s.
 
@@ -489,6 +533,7 @@ def main() -> None:
             ("nvme_offload_550m", lambda: bench_train_nvme_offload(peak_flops)),
             ("mixtral_style_moe", lambda: bench_train_moe(peak_flops)),
             ("long_context_8k", lambda: bench_train_long_context(peak_flops)),
+            ("fpdt_long_context_32k", lambda: bench_train_fpdt_long_context(peak_flops)),
             ("inference_v1_gpt2_125m", bench_inference),
         ):
             try:

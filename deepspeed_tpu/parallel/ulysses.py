@@ -40,14 +40,28 @@ def sp_active() -> bool:
     return has_mesh() and get_mesh().shape["sp"] > 1
 
 
+def _auto_only(entry):
+    """Drop axes that are MANUAL in the current trace context (inside a
+    shard_map, e.g. the ZeRO++/1-bit micro fn's manual data axes): a
+    with_sharding_constraint there may only name the remaining auto axes —
+    the manual ones are already local. (jax raises otherwise.)"""
+    from jax._src import mesh as mesh_lib
+
+    manual = set(getattr(mesh_lib.get_abstract_mesh(), "manual_axes", ()) or ())
+    if not manual or entry is None:
+        return entry
+    names = entry if isinstance(entry, tuple) else (entry,)
+    keep = tuple(a for a in names if a not in manual)
+    return keep if len(keep) > 1 else (keep[0] if keep else None)
+
+
 def ulysses_shard(x: jax.Array) -> jax.Array:
     """[B, S, H, D] seq-sharded -> head-sharded (the first all-to-all)."""
     if not sp_active():
         return x
     mesh = get_mesh()
-    return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P(_live_batch_axes(mesh), None, "sp", None))
-    )
+    spec = P(_auto_only(_live_batch_axes(mesh)), None, _auto_only("sp"), None)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 def ulysses_unshard(x: jax.Array) -> jax.Array:
@@ -55,9 +69,8 @@ def ulysses_unshard(x: jax.Array) -> jax.Array:
     if not sp_active():
         return x
     mesh = get_mesh()
-    return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P(_live_batch_axes(mesh), "sp", None, None))
-    )
+    spec = P(_auto_only(_live_batch_axes(mesh)), _auto_only("sp"), None, None)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
 class DistributedAttention:
