@@ -514,8 +514,13 @@ def main() -> None:
         import jax
 
         # Belt and suspenders: if something imported jax before the env var
-        # latched (sitecustomize), force the live config too.
-        jax.config.update("jax_platforms", "cpu")
+        # latched (sitecustomize), force the live config too — and DROP the
+        # axon backend factory: with the factory registered, the first
+        # computation can initialize the plugin and block on the wedged relay
+        # even under JAX_PLATFORMS=cpu (observed round 5).
+        from deepspeed_tpu.utils.cpu_backend import force_cpu_backend
+
+        force_cpu_backend()
     import jax
 
     backend = jax.default_backend()

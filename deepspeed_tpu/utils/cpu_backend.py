@@ -1,0 +1,31 @@
+"""Force the CPU platform in a sandbox whose sitecustomize registers the
+experimental axon TPU PJRT plugin in every interpreter.
+
+With the plugin factory registered, the FIRST jax computation can initialize
+it and block indefinitely on a wedged relay — even when the platform is
+pinned to cpu via env or config (observed round 5: a 4x4 matmul hung with 0%
+CPU under JAX_PLATFORMS=cpu). Dropping the factory before first device access
+is the only reliable workaround; this is the single shared implementation for
+tests/conftest.py, bench.py, __graft_entry__.py, and tools/.
+"""
+
+from __future__ import annotations
+
+
+def force_cpu_backend() -> None:
+    """Pin jax to the CPU platform and drop the axon backend factory.
+
+    Safe to call multiple times; must run before the first device access
+    (jax may already be imported — sitecustomize does that — so env vars
+    alone are not enough)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge
+
+        xla_bridge._backend_factories.pop("axon", None)
+    except Exception:
+        # private jax API — if it moves, the config pin above still covers
+        # the non-wedged case rather than breaking startup
+        pass

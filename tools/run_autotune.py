@@ -34,7 +34,11 @@ def main():
     import jax
 
     if args.cpu_smoke:
-        jax.config.update("jax_platforms", "cpu")
+        # Also drops the axon factory: with it registered, the first
+        # computation can block on a wedged relay even when pinned to CPU.
+        from deepspeed_tpu.utils.cpu_backend import force_cpu_backend
+
+        force_cpu_backend()
     import numpy as np
 
     from deepspeed_tpu.autotuning import Autotuner
