@@ -100,6 +100,12 @@ class TransformerConfig:
     # heuristic wins; incompatible with tie_embeddings (the tied LM head's
     # dense [V, H] grad would dominate anyway).
     sparse_embedding_grads: bool = False
+    # Pallas attention scheduling knobs forwarded to the flash kernel when it
+    # is the resolved impl (dropped on the XLA path — identical math either
+    # way): {"block_q": ..., "block_k": ..., "k_splits": ...}. The autotuner /
+    # profile_attn_sweep pick these on hardware. Frozen to a tuple-of-pairs at
+    # construction (configs are jit static args).
+    attn_kwargs: Optional[Any] = None
     sp_impl: str = "ulysses"  # ulysses (all-to-all) | ring (ppermute) over sp
     dtype: Any = jnp.float32  # activation dtype inside the module
     # Fused chunked-vocab LM-head + cross-entropy on the training path (the
@@ -130,6 +136,9 @@ class TransformerConfig:
             # frozen dataclass must stay hashable (configs are jit static args)
             object.__setattr__(self, "sparse_attention",
                                tuple(sorted(self.sparse_attention.items())))
+        if isinstance(self.attn_kwargs, dict):
+            object.__setattr__(self, "attn_kwargs",
+                               tuple(sorted(self.attn_kwargs.items())))
         if self.attn_impl == "sparse" and not self.sparse_attention:
             raise ValueError(
                 "attn_impl='sparse' needs a sparse_attention config dict, e.g. "
@@ -402,7 +411,8 @@ class Attention(nn.Module):
             # splits the per-head slope bias along with the head axis.
             q, k, v = ulysses_shard(q), ulysses_shard(k), ulysses_shard(v)
             out = causal_attention(q, k, v, mask=mask, impl=cfg.attn_impl,
-                                   alibi_slopes=slopes)  # [B,S,H,hd]
+                                   alibi_slopes=slopes,
+                                   **dict(cfg.attn_kwargs or ()))  # [B,S,H,hd]
             out = ulysses_unshard(out)
         dense_bias = cfg.dense_bias if cfg.dense_bias is not None else cfg.norm == "layernorm"
         out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1), use_bias=dense_bias,

@@ -14,7 +14,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.ops.registry import dispatch, register
+from deepspeed_tpu.ops.registry import available_impls, dispatch, register
 
 _NEG_INF = -1e9  # mask fill well below any real score but finite for fp16 safety
 
@@ -65,21 +65,27 @@ def _xla_causal_attention(
 
 
 def causal_attention(q, k, v, mask=None, impl: str = "auto",
-                     alibi_slopes=None, bias=None):
+                     alibi_slopes=None, bias=None, **kernel_kwargs):
     """Grouped-query causal attention with optional ALiBi slopes and additive
     pair bias. ALiBi is fused into the Pallas flash kernels (slope * column
     iota — no bias tiles) so bloom-style training keeps the flash path; the
     slopes are treated as NON-LEARNED positional constants there (their
     gradient is stopped — pass impl='xla' to differentiate learned slopes).
     Dense pair bias rides the XLA path (fully differentiable — the evoformer
-    training case needs d_bias)."""
+    training case needs d_bias).
+
+    kernel_kwargs (block_q / block_k / k_splits) are Pallas scheduling knobs
+    with identical math — they are forwarded only when dispatch resolves to
+    the pallas kernel and dropped on the XLA path (which has no blocking)."""
     if bias is not None:
         return _xla_causal_attention(q, k, v, mask=mask,
                                      alibi_slopes=alibi_slopes, bias=bias)
+    fn = dispatch("causal_attention", impl)
+    if kernel_kwargs and fn is not available_impls("causal_attention").get("pallas"):
+        kernel_kwargs = {}
     if alibi_slopes is not None:
-        return dispatch("causal_attention", impl)(q, k, v, mask=mask,
-                                                  alibi_slopes=alibi_slopes)
-    return dispatch("causal_attention", impl)(q, k, v, mask=mask)
+        return fn(q, k, v, mask=mask, alibi_slopes=alibi_slopes, **kernel_kwargs)
+    return fn(q, k, v, mask=mask, **kernel_kwargs)
 
 
 def evoformer_attention(q, k, v, pair_bias=None, mask=None):

@@ -85,9 +85,9 @@ def _block_classes(qi, ki, block_q, block_k):
     return full_below, touches & ~full_below
 
 
-def _causal_keep(qi, ki, shape, block_q, block_k):
+def _causal_keep(qi, ki, shape, block_q, block_k, col_off=0):
     rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
-    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    cols = ki * block_k + col_off + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
     return cols <= rows
 
 
@@ -157,12 +157,12 @@ def _qkv_in_specs(dec, block_q, block_k, D, G, alibi=False):
     return specs
 
 
-def _alibi_add(s, slopes_ref, ki, block_k):
+def _alibi_add(s, slopes_ref, ki, block_k, col_off=0):
     """s += slope[h] * key-position, in the caller's softmax scale (the
     wrapper pre-folds log2e into the slopes for the base-2 kernels). The HF
     bloom convention (slopes * j); softmax cancels the per-row shift vs
     slopes * (j - i)."""
-    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    cols = ki * block_k + col_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     return s + slopes_ref[0, 0] * cols.astype(jnp.float32)
 
 
@@ -186,7 +186,37 @@ def _kcol_spec(dec, block_k, D):
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel(*refs, block_q, block_k, causal, masked, squashed, alibi=False):
+def _sub_slices(block_k: int, k_splits: int):
+    """Static row/col ranges splitting a block_k tile into k_splits chunks."""
+    c = block_k // k_splits
+    return [(i * c, c) for i in range(k_splits)]
+
+
+def _sub_score(q, k, mask_ref, slopes_ref, qi, ki, off, c, *, block_q, block_k,
+               masked, mask_block, alibi):
+    """Masked scores for one sub-chunk: s = q @ k[off:off+c]^T (+alibi, +mask).
+
+    The one scoring implementation shared by the forward and both backward
+    kernels — the mask/bias math must never diverge between passes."""
+    s = jax.lax.dot_general(
+        q, k[off:off + c], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [block_q, c]
+    if alibi:
+        s = _alibi_add(s, slopes_ref, ki, block_k, col_off=off)
+    if mask_block or masked:
+        keep = None
+        if masked:
+            keep = jnp.broadcast_to(mask_ref[0, 0, off:off + c] > 0, s.shape)
+        if mask_block:
+            ck = _causal_keep(qi, ki, s.shape, block_q, block_k, col_off=off)
+            keep = ck if keep is None else keep & ck
+        s = jnp.where(keep, s, _NEG_INF)
+    return s
+
+
+def _fwd_kernel(*refs, block_q, block_k, causal, masked, squashed, alibi=False,
+                k_splits=1):
     if squashed:
         (qm_ref, km_ref, mask_ref, *rest) = refs
         slopes_ref = rest.pop(0) if alibi else None
@@ -210,35 +240,38 @@ def _fwd_kernel(*refs, block_q, block_k, causal, masked, squashed, alibi=False):
     def _compute(mask_block):
         q = q_ref[0, 0]  # [block_q, D]  (pre-scaled by 1/sqrt(D))
         k = k_ref[0, 0]  # [block_k, D]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [block_q, block_k]
-        if alibi:
-            s = _alibi_add(s, slopes_ref, ki, block_k)
+        v = v_ref[0, 0]
+        sub = _sub_slices(block_k, k_splits)
 
-        if mask_block or masked:
-            keep = None
-            if masked:
-                keep = jnp.broadcast_to(mask_ref[0, 0, :] > 0, s.shape)  # padding keep
-            if mask_block:
-                ck = _causal_keep(qi, ki, s.shape, block_q, block_k)
-                keep = ck if keep is None else keep & ck
-            s = jnp.where(keep, s, _NEG_INF)
+        def _score(off, c):
+            return _sub_score(q, k, mask_ref, slopes_ref, qi, ki, off, c,
+                              block_q=block_q, block_k=block_k, masked=masked,
+                              mask_block=mask_block, alibi=alibi)
 
-        m_prev = jnp.max(m_ref[:], axis=-1, keepdims=True)  # [block_q, 1] (lanes equal)
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        # All-masked rows keep m at -inf; guard exp against (-inf) - (-inf).
-        m_safe = jnp.where(m_cur == _NEG_INF, 0.0, m_cur)
-        p = jnp.exp2(s - m_safe)  # masked entries: exp2(NEG_INF - finite) == 0
+        s_next = _score(*sub[0])
+        for idx, (off, c) in enumerate(sub):
+            s = s_next
+            if idx + 1 < k_splits:
+                # Hoisted ahead of this chunk's softmax: the next QK^T reads
+                # nothing from m/l/acc, so the MXU can run it while the VPU
+                # does the exp2/renormalize passes below.
+                s_next = _score(*sub[idx + 1])
 
-        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp2(m_prev - m_safe))
-        l_prev = jnp.max(l_ref[:], axis=-1, keepdims=True)
-        l_cur = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
-        m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
+            m_prev = jnp.max(m_ref[:], axis=-1, keepdims=True)  # [block_q, 1] (lanes equal)
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            # All-masked rows keep m at -inf; guard exp against (-inf) - (-inf).
+            m_safe = jnp.where(m_cur == _NEG_INF, 0.0, m_cur)
+            p = jnp.exp2(s - m_safe)  # masked entries: exp2(NEG_INF - finite) == 0
+
+            alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp2(m_prev - m_safe))
+            l_prev = jnp.max(l_ref[:], axis=-1, keepdims=True)
+            l_cur = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            l_ref[:] = jnp.broadcast_to(l_cur, l_ref.shape)
+            m_ref[:] = jnp.broadcast_to(m_cur, m_ref.shape)
+            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v[off:off + c], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
 
     if causal and squashed:
         # the grid enumerates only ki <= qi; the diagonal cell masks in-block
@@ -266,7 +299,7 @@ _PARALLEL_SEMANTICS = ("parallel", "parallel", "parallel", "arbitrary")
 
 
 def _flash_fwd(q, k, v, mask, slopes, block_q: int, block_k: int, causal: bool,
-               masked: bool, alibi: bool):
+               masked: bool, alibi: bool, k_splits: int = 1):
     """q,k,v: [B, H(q/kv), S, D] (q pre-scaled). mask: [B, S] int32.
     slopes: [H, _LANES] fp32 (log2e-scaled; ignored unless alibi).
     Returns (out, lse)."""
@@ -287,7 +320,7 @@ def _flash_fwd(q, k, v, mask, slopes, block_q: int, block_k: int, causal: bool,
     ]
     kernel = functools.partial(_fwd_kernel, block_q=block_q, block_k=block_k,
                                causal=causal, masked=masked, squashed=squashed,
-                               alibi=alibi)
+                               alibi=alibi, k_splits=k_splits)
     dec = _DEC_SQUASHED if squashed else _DEC_DENSE
     in_specs = _qkv_in_specs(dec, block_q, block_k, D, G, alibi=alibi)
     qrow = _qrow_specs(dec, block_q, D)
@@ -330,7 +363,8 @@ def _flash_fwd(q, k, v, mask, slopes, block_q: int, block_k: int, causal: bool,
 # --------------------------------------------------------------------------
 
 
-def _bwd_dq_kernel(*refs, block_q, block_k, causal, masked, squashed, alibi=False):
+def _bwd_dq_kernel(*refs, block_q, block_k, causal, masked, squashed, alibi=False,
+                   k_splits=1):
     if squashed:
         (qm_ref, km_ref, mask_ref, *rest) = refs
         slopes_ref = rest.pop(0) if alibi else None
@@ -352,31 +386,35 @@ def _bwd_dq_kernel(*refs, block_q, block_k, causal, masked, squashed, alibi=Fals
     def _compute(mask_block):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        if alibi:
-            s = _alibi_add(s, slopes_ref, ki, block_k)
-
-        if mask_block or masked:
-            keep = None
-            if masked:
-                keep = jnp.broadcast_to(mask_ref[0, 0, :] > 0, s.shape)
-            if mask_block:
-                ck = _causal_keep(qi, ki, s.shape, block_q, block_k)
-                keep = ck if keep is None else keep & ck
-            s = jnp.where(keep, s, _NEG_INF)
-
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = jnp.max(lse_ref[0, 0], axis=-1, keepdims=True)  # [block_q, 1]
-        p = jnp.exp2(s - jnp.where(lse == _NEG_INF, 0.0, lse))
-        # bf16 x bf16 matmul with fp32 accumulation: fp32 operands would run the
-        # MXU at a fraction of its bf16 rate (measured 4x slower on v5e).
-        dp = jax.lax.dot_general(
-            do_ref[0, 0], v_ref[0, 0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - jnp.max(delta_ref[0, 0], axis=-1, keepdims=True))
-        acc_ref[:] += jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
+        delta = jnp.max(delta_ref[0, 0], axis=-1, keepdims=True)
+        sub = _sub_slices(block_k, k_splits)
+
+        def _score(off, c):
+            return _sub_score(q, k, mask_ref, slopes_ref, qi, ki, off, c,
+                              block_q=block_q, block_k=block_k, masked=masked,
+                              mask_block=mask_block, alibi=alibi)
+
+        s_next = _score(*sub[0])
+        for idx, (off, c) in enumerate(sub):
+            s = s_next
+            if idx + 1 < k_splits:
+                s_next = _score(*sub[idx + 1])  # MXU overlaps the VPU passes below
+            p = jnp.exp2(s - lse_safe)
+            # bf16 x bf16 matmul with fp32 accumulation: fp32 operands would run
+            # the MXU at a fraction of its bf16 rate (measured 4x slower on v5e).
+            dp = jax.lax.dot_general(
+                do, v[off:off + c], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta)
+            acc_ref[:] += jax.lax.dot_general(
+                ds.astype(k.dtype), k[off:off + c], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
 
     if causal and squashed:
         pl.when(ki < qi)(lambda: _compute(False))
@@ -394,7 +432,7 @@ def _bwd_dq_kernel(*refs, block_q, block_k, causal, masked, squashed, alibi=Fals
 
 
 def _bwd_dkv_kernel(*refs, block_q, block_k, causal, masked, squashed, nq_total,
-                    alibi=False):
+                    alibi=False, k_splits=1):
     if squashed:
         (qm_ref, km_ref, mask_ref, *rest) = refs
         slopes_ref = rest.pop(0) if alibi else None
@@ -419,32 +457,37 @@ def _bwd_dkv_kernel(*refs, block_q, block_k, causal, masked, squashed, nq_total,
     def _compute(mask_block):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        if alibi:
-            s = _alibi_add(s, slopes_ref, ki, block_k)
-
-        if mask_block or masked:
-            keep = None
-            if masked:
-                keep = jnp.broadcast_to(mask_ref[0, 0, :] > 0, s.shape)
-            if mask_block:
-                ck = _causal_keep(qi, ki, s.shape, block_q, block_k)
-                keep = ck if keep is None else keep & ck
-            s = jnp.where(keep, s, _NEG_INF)
-
-        lse = jnp.max(lse_ref[0, 0], axis=-1, keepdims=True)
-        p = jnp.exp2(s - jnp.where(lse == _NEG_INF, 0.0, lse))
-        # keep every matmul in the input dtype (bf16) with fp32 accumulation —
-        # fp32 operands would cut the MXU rate ~4x (see _bwd_dq_kernel note)
+        v = v_ref[0, 0]
         do = do_ref[0, 0]
-        dv_acc[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        dp = jax.lax.dot_general(do, v_ref[0, 0], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - jnp.max(delta_ref[0, 0], axis=-1, keepdims=True))
-        dk_acc[:] += jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        lse = jnp.max(lse_ref[0, 0], axis=-1, keepdims=True)
+        lse_safe = jnp.where(lse == _NEG_INF, 0.0, lse)
+        delta = jnp.max(delta_ref[0, 0], axis=-1, keepdims=True)
+        sub = _sub_slices(block_k, k_splits)
+
+        def _score(off, c):
+            return _sub_score(q, k, mask_ref, slopes_ref, qi, ki, off, c,
+                              block_q=block_q, block_k=block_k, masked=masked,
+                              mask_block=mask_block, alibi=alibi)
+
+        s_next = _score(*sub[0])
+        for idx, (off, c) in enumerate(sub):
+            s = s_next
+            if idx + 1 < k_splits:
+                s_next = _score(*sub[idx + 1])  # MXU overlaps the VPU passes below
+            p = jnp.exp2(s - lse_safe)
+            # keep every matmul in the input dtype (bf16) with fp32 accumulation —
+            # fp32 operands would cut the MXU rate ~4x (see _bwd_dq_kernel note)
+            dv_acc[off:off + c] += jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(do, v[off:off + c], (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta)
+            dk_acc[off:off + c] += jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
 
     if causal and squashed:
         pl.when(qi > ki)(lambda: _compute(False))
@@ -463,7 +506,7 @@ def _bwd_dkv_kernel(*refs, block_q, block_k, causal, masked, squashed, nq_total,
 
 
 def _flash_bwd(q, k, v, mask, slopes, out, lse, do, block_q: int, block_k: int,
-               causal: bool, masked: bool, alibi: bool):
+               causal: bool, masked: bool, alibi: bool, k_splits: int = 1):
     B, H, S, D = q.shape
     Hkv = k.shape[1]
     G = H // Hkv
@@ -476,10 +519,10 @@ def _flash_bwd(q, k, v, mask, slopes, out, lse, do, block_q: int, block_k: int,
     grad_vma = _vma(q, k, v, mask, do)
     dq_kernel = functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
                                   causal=causal, masked=masked, squashed=squashed,
-                                  alibi=alibi)
+                                  alibi=alibi, k_splits=k_splits)
     dkv_kernel = functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
                                    causal=causal, masked=masked, squashed=squashed,
-                                   nq_total=nq, alibi=alibi)
+                                   nq_total=nq, alibi=alibi, k_splits=k_splits)
     extra = (slopes,) if alibi else ()
     dq_scratch = [pltpu.VMEM((block_q, D), jnp.float32)]
     dkv_scratch = [pltpu.VMEM((block_k, D), jnp.float32),
@@ -561,32 +604,38 @@ def _flash_bwd(q, k, v, mask, slopes, out, lse, do, block_q: int, block_k: int,
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _flash_attention(q, k, v, mask, slopes, block_q, block_k, causal, masked, alibi):
-    out, _ = _flash_core(q, k, v, mask, slopes, block_q, block_k, causal, masked, alibi)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_attention(q, k, v, mask, slopes, block_q, block_k, causal, masked, alibi,
+                     k_splits=1):
+    out, _ = _flash_core(q, k, v, mask, slopes, block_q, block_k, causal, masked,
+                         alibi, k_splits)
     return out
 
 
-def _flash_core(q, k, v, mask, slopes, block_q, block_k, causal, masked, alibi):
+def _flash_core(q, k, v, mask, slopes, block_q, block_k, causal, masked, alibi,
+                k_splits=1):
     scale = q.shape[-1] ** -0.5 * _LOG2E  # base-2 softmax (see module header)
     qs = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)  # [B,H,S,D]
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out, lse = _flash_fwd(qs, kt, vt, mask, slopes, block_q, block_k, causal, masked, alibi)
+    out, lse = _flash_fwd(qs, kt, vt, mask, slopes, block_q, block_k, causal, masked,
+                          alibi, k_splits)
     return out.transpose(0, 2, 1, 3), (qs, kt, vt, lse, out)
 
 
-def _flash_vjp_fwd(q, k, v, mask, slopes, block_q, block_k, causal, masked, alibi):
+def _flash_vjp_fwd(q, k, v, mask, slopes, block_q, block_k, causal, masked, alibi,
+                   k_splits=1):
     out, (qs, kt, vt, lse, out_bhsd) = _flash_core(q, k, v, mask, slopes, block_q,
-                                                   block_k, causal, masked, alibi)
+                                                   block_k, causal, masked, alibi,
+                                                   k_splits)
     return out, (qs, kt, vt, mask, slopes, lse, out_bhsd)
 
 
-def _flash_vjp_bwd(block_q, block_k, causal, masked, alibi, res, g):
+def _flash_vjp_bwd(block_q, block_k, causal, masked, alibi, k_splits, res, g):
     qs, kt, vt, mask, slopes, lse, out_bhsd = res
     do = g.transpose(0, 2, 1, 3)
     dq, dk, dv = _flash_bwd(qs, kt, vt, mask, slopes, out_bhsd, lse, do,
-                            block_q, block_k, causal, masked, alibi)
+                            block_q, block_k, causal, masked, alibi, k_splits)
     # Base-2 gradient bookkeeping (kernels compute the base-e ds = p*(dp-δ)):
     # dq needs scale*log2e*ln2 == plain scale (exact — no ln2 rounding), and
     # dk, accumulated against the log2e-pre-scaled q, needs ln2 applied here
@@ -610,10 +659,21 @@ def flash_causal_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     alibi_slopes: Optional[jax.Array] = None,  # [H] fp32 (bloom ALiBi)
+    k_splits: int = 1,
 ) -> jax.Array:
     B, S, H, D = q.shape
     block_q = min(block_q, max(S, 8))
     block_k = min(block_k, max(S, 8))
+    # k_splits > 1 processes each block_k tile as k_splits sub-chunks with the
+    # next sub-chunk's QK^T hoisted ahead of the previous one's softmax, so the
+    # MXU matmul overlaps the VPU exp2/renormalize passes (the named TF/s
+    # bottleneck, PERF.md). Pure instruction-level restructuring: identical
+    # math, A/B via tools/profile_attn_sweep.py. A fixed k_splits must stay
+    # valid when short sequences clamp block_k, so degrade to the largest
+    # compatible divisor (sub-chunks divide block_k; >=128 lanes on hardware).
+    while k_splits > 1 and (block_k % k_splits != 0
+                            or (not _interpret() and (block_k // k_splits) % 128 != 0)):
+        k_splits -= 1
     Sp = _cdiv(S, max(block_q, block_k)) * max(block_q, block_k)
 
     # masked=False avoids every padding-mask VPU pass in-kernel. Wrapper tail
@@ -643,5 +703,5 @@ def flash_causal_attention(
         slopes = jnp.zeros((H, _LANES), jnp.float32)
 
     out = _flash_attention(q, k, v, keep[:, None, :], slopes,
-                           block_q, block_k, True, masked, alibi)
+                           block_q, block_k, True, masked, alibi, k_splits)
     return out[:, :S]

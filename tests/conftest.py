@@ -20,7 +20,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+from deepspeed_tpu.utils.cpu_backend import force_cpu_backend  # noqa: E402
+
+force_cpu_backend()
 # Persistent compilation cache: the suite is dominated by XLA compiles of
 # near-identical tiny programs (round-2 verdict: 186 tests no longer fit one
 # 550 s run). Cache survives across pytest invocations in the repo tree.
@@ -28,12 +30,6 @@ _CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-try:
-    from jax._src import xla_bridge
-
-    xla_bridge._backend_factories.pop("axon", None)
-except Exception:
-    pass
 
 import pytest  # noqa: E402
 
@@ -153,6 +149,18 @@ NIGHTLY_NODE_SUBSTRINGS = [
     "test_schedule_executor_matches_sequential[2-4]",  # other params stay
     "test_ring_attention_jits_in_train_context",  # zigzag unit tests stay
     "test_paged_pallas_gqa_grouping",         # paged parity params stay
+    # ---- tranche 4 (round 5): engine-level trajectory/composition variants;
+    # default keeps each feature's canonical proof — FPDT: attention fwd+grad
+    # parity + model parity (+ the nightly memory contract); sparse grads:
+    # grad-equals-take + manual-scale regression + the HLO comm-pattern
+    # assertion; LoCo: the EF property test; zpp x ulysses is also covered by
+    # multichip dryrun D every round ----
+    "test_k_splits_matches_unsplit[4-16-16]",  # the two k_splits=2 grid-branch cases stay
+    "test_fpdt_engine_sp2_trajectory",
+    "test_engine_sparse_gradients_trajectory",
+    "test_sparse_gradients_compose_with_zeropp",
+    "test_loco_trajectory_close_to_exact",
+    "test_zpp_composes_with_ulysses_sp",
 ]
 
 

@@ -113,6 +113,34 @@ class TestFlashAttention:
         for rg, pg in zip(ref_grads, pl_grads):
             np.testing.assert_allclose(np.asarray(pg), np.asarray(rg), atol=5e-5, rtol=5e-5)
 
+    # (16, 16) squashed triangle grid; (16, 8) dense grid — both split branches
+    @pytest.mark.parametrize("k_splits,bq,bk", [(2, 16, 16), (2, 16, 8), (4, 16, 16)])
+    def test_k_splits_matches_unsplit(self, k_splits, bq, bk):
+        """k_splits sub-chunked online softmax (MXU/VPU overlap restructuring)
+        matches the unsplit kernel: fwd + all three gradients, with a padding
+        mask so the masked sub-chunk slicing is exercised too."""
+        B, S, H, D = 2, 32, 2, 8
+        q, k, v = _rand(0, (B, S, H, D)), _rand(1, (B, S, H, D)), _rand(2, (B, S, H, D))
+        mask = jnp.ones((B, S), jnp.int32).at[1, 20:].set(0)
+
+        def f(fn):
+            def g(q, k, v):
+                out = fn(q, k, v)
+                return jnp.sum(out * jnp.cos(out.astype(jnp.float32)))
+            return g
+
+        pallas = ops.dispatch("causal_attention", "pallas")
+        base = pallas(q, k, v, mask=mask, block_q=bq, block_k=bk)
+        out = pallas(q, k, v, mask=mask, block_q=bq, block_k=bk, k_splits=k_splits)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(base), atol=2e-6, rtol=2e-6)
+        base_grads = jax.grad(f(lambda q, k, v: pallas(q, k, v, mask=mask, block_q=bq, block_k=bk)),
+                              argnums=(0, 1, 2))(q, k, v)
+        pl_grads = jax.grad(f(lambda q, k, v: pallas(q, k, v, mask=mask, block_q=bq,
+                                                     block_k=bk, k_splits=k_splits)),
+                            argnums=(0, 1, 2))(q, k, v)
+        for rg, pg in zip(base_grads, pl_grads):
+            np.testing.assert_allclose(np.asarray(pg), np.asarray(rg), atol=5e-6, rtol=5e-6)
+
 
 class TestNorms:
     def test_rms_norm(self):

@@ -16,15 +16,30 @@ import numpy as np
 from deepspeed_tpu.ops.pallas.flash_attention import flash_causal_attention
 
 
-def bench(fn, *args, iters=20):
+def bench(fn, *args, iters=20, grad=False):
+    # grad mode differentiates w.r.t. ALL of q/k/v and feeds every gradient
+    # back into the carry — otherwise the dkv kernel is dead code under jit
+    # and the sweep never times it.
+    inner = jax.grad(lambda q, k, v: (fn(q, k, v).astype(jnp.float32) ** 2).sum(),
+                     argnums=(0, 1, 2))
+
     @jax.jit
     def chained(q, k, v):
-        def body(q, _):
-            o = fn(q, k, v)
-            return (o * jnp.asarray(1e-3, o.dtype) + q * jnp.asarray(0.999, q.dtype)), ()
+        def body(carry, _):
+            q, k, v = carry
+            decay = jnp.asarray(0.999, q.dtype)
+            eps = jnp.asarray(1e-3, q.dtype)
+            if grad:
+                dq, dk, dv = inner(q, k, v)
+                new = (q * decay + dq.astype(q.dtype) * eps,
+                       k * decay + dk.astype(k.dtype) * eps,
+                       v * decay + dv.astype(v.dtype) * eps)
+            else:
+                new = (fn(q, k, v) * eps + q * decay, k, v)
+            return new, ()
 
-        out, _ = jax.lax.scan(body, q, None, length=iters)
-        return out
+        (q, k, v), _ = jax.lax.scan(body, (q, k, v), None, length=iters)
+        return q
 
     r = chained(*args)
     _ = np.asarray(r[0, 0, 0, 0])  # warm compile + sync
@@ -36,26 +51,41 @@ def bench(fn, *args, iters=20):
 
 def main():
     B, S, H, D = 4, 1024, 12, 64
-    if len(sys.argv) > 2:
-        B, S = int(sys.argv[1]), int(sys.argv[2])
-    elif len(sys.argv) > 1:
-        B = int(sys.argv[1])
+    grad = "--grad" in sys.argv
+    argv = [a for a in sys.argv if a != "--grad"]
+    if len(argv) > 2:
+        B, S = int(argv[1]), int(argv[2])
+    elif len(argv) > 1:
+        B = int(argv[1])
     q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.bfloat16)
     k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D), jnp.bfloat16)
     v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D), jnp.bfloat16)
     fl = 4 * B * H * S * S * D  # dense fwd flops; causal useful ~ (1+nblk)/(2 nblk)
+    if grad:
+        # fwd (2 matmuls) + dq kernel (3: s, dp, ds@k) + dkv kernel (4: s, dv,
+        # dp, dk) = 18 B·H·S²·D dense matmul flops per step
+        fl = fl * 18 // 4
 
-    for bq, bk in ((256, 256), (256, 512), (512, 256), (512, 512), (512, 1024),
-                   (1024, 512), (1024, 1024)):
+    # k_splits > 1 = sub-chunked online softmax (next QK^T hoisted over the
+    # previous chunk's VPU passes) — the round-5 attack on the per-cell
+    # softmax serialization named in PERF.md.
+    for bq, bk, ks in ((256, 256, 1), (256, 512, 1), (512, 256, 1),
+                       (512, 512, 1), (512, 512, 2), (512, 1024, 1),
+                       (512, 1024, 2), (512, 1024, 4),
+                       (1024, 512, 1), (1024, 512, 2),
+                       (1024, 1024, 1), (1024, 1024, 2), (1024, 1024, 4),
+                       (1024, 2048, 4), (2048, 2048, 4)):
         if bq > S or bk > S:
             continue
-        fn = lambda q, k, v: flash_causal_attention(q, k, v, block_q=bq, block_k=bk)
+        fn = lambda q, k, v: flash_causal_attention(q, k, v, block_q=bq,
+                                                    block_k=bk, k_splits=ks)
         try:
-            t = bench(fn, q, k, v)
+            t = bench(fn, q, k, v, grad=grad)
         except Exception as e:  # noqa: BLE001 - sweep keeps going past bad configs
-            print(f"bq={bq} bk={bk}: FAIL {type(e).__name__}")
+            print(f"bq={bq} bk={bk} ks={ks}: FAIL {type(e).__name__}")
             continue
-        print(f"bq={bq:5d} bk={bk:5d}: {t*1e3:7.3f} ms  dense-rate {fl/t/1e12:6.1f} TF/s")
+        print(f"bq={bq:5d} bk={bk:5d} ks={ks}: {t*1e3:7.3f} ms  "
+              f"dense-rate {fl/t/1e12:6.1f} TF/s")
 
 
 if __name__ == "__main__":
