@@ -16,7 +16,17 @@ import os
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in flags:
+    # The suite is COMPILE-dominated on the single-core driver lane and the
+    # tests assert math, not codegen quality: level 1 compiles the
+    # compile-heavy tests ~2-3x faster (round-5 measurement: heaviest test
+    # 88 s -> ~31 s cold) WITHOUT level 0's interpreter-slow codegen, which
+    # regressed runtime-heavy tests (LoCo EF test 69 s -> 98 s at O0). Keeps
+    # the default tier near the 550 s cold budget. Perf numbers never come
+    # from tests (bench.py runs without this conftest).
+    flags = flags + " --xla_backend_optimization_level=1"
+os.environ["XLA_FLAGS"] = flags
 
 import jax  # noqa: E402
 
@@ -155,12 +165,58 @@ NIGHTLY_NODE_SUBSTRINGS = [
     # grad-equals-take + manual-scale regression + the HLO comm-pattern
     # assertion; LoCo: the EF property test; zpp x ulysses is also covered by
     # multichip dryrun D every round ----
-    "test_k_splits_matches_unsplit[4-16-16]",  # the two k_splits=2 grid-branch cases stay
+    "test_k_splits_matches_unsplit[4-16-16]",  # splits=2 squashed-grid case stays (see tranche 6)
     "test_fpdt_engine_sp2_trajectory",
     "test_engine_sparse_gradients_trajectory",
     "test_sparse_gradients_compose_with_zeropp",
     "test_loco_trajectory_close_to_exact",
     "test_zpp_composes_with_ulysses_sp",
+    # ---- tranche 5 (round 5: the default tier hit 735 s cold after the
+    # round-5 features landed; the moves below are sibling-covered kernel
+    # param variants + duplicate compositions, never a feature's only proof.
+    # Kept defaults named per line) ----
+    "test_fpdt_model_host_offload_parity",     # fpdt_model_parity stays
+    # k_splits: [2-16-16] (squashed triangle grid — the PRODUCTION branch,
+    # block_q == block_k) stays default; the dense-grid [2-16-8] moves
+    # (dense grid + mask + bwd already default via masked_grads[16-8])
+    "test_k_splits_matches_unsplit[2-16-8]",
+    "test_pallas_sparse_matches_dense_masked[fixed-kw1]",    # local/variable/bslongformer stay
+    "test_pallas_sparse_matches_dense_masked[bigbird-kw2]",
+    "TestFlashAttention::test_forward_matches_xla[False-16]",  # ragged -100 pair stays
+    "TestFlashAttention::test_forward_matches_xla[True-16]",
+    "TestFlashAttention::test_padding_mask",   # masked_grads[16-8] (fwd+bwd) stays
+    "test_paged_pallas_matches_xla[2]",        # [1] (MQA) and [8] stay... [8] moved too: gqa covered by alibi[2-8]
+    "test_paged_pallas_matches_xla[8]",
+    "test_paged_pallas_alibi_matches_xla[8-8]",  # [2-8] stays
+    "test_paged_pallas_alibi_matches_xla[2-2]",
+    "TestFlashAlibi::test_forward_matches_xla[16-8]",  # [8-8] stays
+    "test_pipeline_module_matches_pp1[4]",     # [2] stays
+    "test_zero_inference_offload_generate",    # composes_with_woq + nvme tests stay
+    "test_sampling_shapes_and_determinism",    # eos + cached_decode[overrides0] stay
+    "test_attention_pair_bias_and_alibi",      # evoformer_attention test stays
+    "test_fpdt_attention_noncausal_parity",    # causal+alibi combos stay
+    # the venv pip-install trio (20 s module fixture); the metadata
+    # entry-point check stays default
+    "test_editable_install_exposes_all_cli_entry_points",
+    "test_ds_elastic_runs_outside_checkout",
+    "test_dstpu_help_runs_outside_checkout",
+    # ---- tranche 6 (round 5, second pass to the <550 s budget; kept
+    # default sibling named per move) ----
+    "test_sparse_composes_with_alibi_and_padding",  # model-level sparse x alibi x padding stays
+    "test_safe_optimizer_state_roundtrip",     # fragment get_full_grad + get_set_fp32 stay
+    "test_nvme_ram_budget_is_num_buffers_layers",  # nvme_generate_matches_resident stays
+    "test_sparse_lookup_grad_scale_inside_manual_shard_map",  # comm_pattern + grad_equals_take stay
+    "test_fpdt_chunk_major_zero_copy_layout",  # fpdt_longer_than_typical_hbm_tile stays
+    "test_chunked_attention_non_causal_and_offset",  # chunked_attention_alibi + ring tests stay
+    "test_zero_inference_composes_with_woq",   # woq_stacked + nvme_generate stay
+    "TestMoE::test_top1_gating",               # gating_capacity_and_aux + moe_trains stay
+    "test_pipeline_module_interleaved_matches_pp1",  # interleaved_pipeline_gradients stays
+    "test_interleaved_pipeline_matches_sequential",  # ditto (gradients subsumes forward)
+    "test_spmd_pipeline_matches_sequential",   # spmd_pipeline_gradients stays
+    "test_deepspeed_io_curriculum_filters_batches",  # curriculum scheduler unit tests stay
+    "TestUlysses::test_distributed_attention_class",  # sp_matches_dp_baseline stays
+    "TestFlashAlibi::test_masked_forward_matches_xla",  # alibi fwd[8-8] + grads[False-8-8] + masked_grads stay
+    "test_fused_ce_pad_mask_and_uneven_chunks",  # fused_ce_matches_naive stays
 ]
 
 

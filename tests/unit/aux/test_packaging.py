@@ -73,3 +73,25 @@ def test_dstpu_help_runs_outside_checkout(venv_bin):
     r = _run(venv_bin, "dstpu", "--help")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "usage" in r.stdout.lower()
+
+
+def test_entry_point_targets_importable():
+    """Default-tier packaging check (the real `pip install -e .` + venv run
+    is nightly — it costs ~20 s of the cold budget): every [project.scripts]
+    target in pyproject.toml must resolve to a callable."""
+    import importlib
+
+    try:
+        import tomllib  # stdlib from 3.11
+    except ImportError:  # pragma: no cover - declared floor is 3.10
+        tomllib = pytest.importorskip("tomli")
+
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        scripts = tomllib.load(f)["project"]["scripts"]
+    expected = {"dstpu", "ds_report", "ds_bench", "ds_elastic", "ds_io",
+                "ds_nvme_tune", "ds_ssh", "zero_to_fp32"}
+    assert expected <= set(scripts), f"missing console scripts: {expected - set(scripts)}"
+    for name, target in scripts.items():
+        mod, _, attr = target.partition(":")
+        fn = getattr(importlib.import_module(mod), attr)
+        assert callable(fn), f"{name} -> {target} is not callable"

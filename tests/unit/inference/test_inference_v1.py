@@ -55,7 +55,7 @@ def full_forward_greedy(module, params, ids, steps):
 def test_cached_decode_matches_full_forward(overrides):
     cfg, module, params = make_model(**overrides)
     ids = jax.random.randint(jax.random.PRNGKey(1), (2, 7), 0, cfg.vocab_size)
-    steps = 5
+    steps = 3  # prefill + 2 cached decodes: enough to catch any cache drift
     ref = full_forward_greedy(module, params, ids, steps)
 
     cache = init_cache(cfg, 2, 64, jnp.float32)

@@ -142,6 +142,33 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(pg), np.asarray(rg), atol=5e-6, rtol=5e-6)
 
 
+    def test_kernel_kwargs_forwarded_to_pallas_and_dropped_on_xla(self):
+        """The attn_kwargs plumbing (TransformerConfig -> causal_attention ->
+        kernel): scheduling knobs must reach the pallas kernel (identical
+        math, different blocking) and be silently DROPPED when dispatch
+        resolves to the XLA path — an autotuned block config must never make
+        the fallback path raise TypeError."""
+        B, S, H, D = 2, 32, 2, 8
+        q, k, v = _rand(0, (B, S, H, D)), _rand(1, (B, S, H, D)), _rand(2, (B, S, H, D))
+        kw = dict(block_q=16, block_k=16, k_splits=2)
+        ref = ops.causal_attention(q, k, v, impl="xla")
+        # xla impl has no blocking params: kwargs must be dropped, not passed
+        out_xla = ops.causal_attention(q, k, v, impl="xla", **kw)
+        np.testing.assert_allclose(np.asarray(out_xla), np.asarray(ref), rtol=1e-6)
+        # pallas impl must actually honor them (reject an impossible block)
+        out_pl = ops.causal_attention(q, k, v, impl="pallas", **kw)
+        np.testing.assert_allclose(np.asarray(out_pl), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        # model-level: TransformerConfig freezes the dict hashable for jit
+        from deepspeed_tpu.models import TransformerConfig
+
+        cfg = TransformerConfig(vocab_size=32, hidden_size=16,
+                                intermediate_size=32, num_layers=1,
+                                num_heads=2, max_seq_len=32, attn_kwargs=kw)
+        assert cfg.attn_kwargs == tuple(sorted(kw.items()))
+        assert hash(cfg.attn_kwargs) is not None
+
+
 class TestNorms:
     def test_rms_norm(self):
         x = _rand(0, (4, 12, 64))

@@ -264,7 +264,9 @@ def test_model_sparse_alibi_training():
     layout the logits must match the xla path exactly."""
     from deepspeed_tpu.models import CausalLM, TransformerConfig
 
-    kw = dict(vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=2,
+    # 1 layer: the xla-vs-sparse comparison compiles two full models; depth
+    # adds compile time, not coverage (the routing is per-layer-identical)
+    kw = dict(vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=1,
               num_heads=2, max_seq_len=32, position="alibi", fused_ce=False)
     ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 32)), jnp.int32)
     mask = jnp.asarray(np.concatenate([np.ones((2, 30)), np.zeros((2, 2))], 1),
@@ -274,8 +276,12 @@ def test_model_sparse_alibi_training():
     def run(cfg):
         m = CausalLM(cfg)
         params = m.init(jax.random.PRNGKey(0), batch, train=False)["params"]
-        loss, logits = m.apply({"params": params}, batch, train=False)
-        g = jax.grad(lambda p: m.apply({"params": p}, batch, train=False)[0])(params)
+        # jit: eager op-by-op apply+grad of even this tiny model costs ~30 s
+        # of pure dispatch on the single-core lane
+        loss, logits = jax.jit(
+            lambda p: m.apply({"params": p}, batch, train=False))(params)
+        g = jax.jit(jax.grad(
+            lambda p: m.apply({"params": p}, batch, train=False)[0]))(params)
         return loss, logits, g
 
     l_x, logit_x, g_x = run(TransformerConfig(**kw, attn_impl="xla"))

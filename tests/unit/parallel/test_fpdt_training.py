@@ -25,12 +25,12 @@ def _qkv(B=2, S=64, H=4, Hkv=2, D=8, seed=0):
             jax.random.normal(ks[2], (B, S, Hkv, D), jnp.float32))
 
 
-def test_fpdt_attention_fwd_and_grad_parity():
-    """Forward + all three input grads vs dense, with GQA and multiple
-    (causal, alibi) combinations — the backward is the round-5 feature."""
+def _fpdt_parity_combos(combos):
     q, k, v = _qkv()
     slopes = jnp.asarray(np.geomspace(0.25, 0.004, q.shape[2]), jnp.float32)
-    for causal, sl in [(True, None), (True, slopes), (False, None)]:
+    for causal, use_alibi in combos:
+        sl = slopes if use_alibi else None
+
         def ref(q, k, v):
             if causal:
                 return causal_attention(q, k, v, impl="xla", alibi_slopes=sl)
@@ -55,8 +55,23 @@ def test_fpdt_attention_fwd_and_grad_parity():
                 err_msg=f"d{nm} causal={causal} alibi={sl is not None}")
 
 
+def test_fpdt_attention_fwd_and_grad_parity():
+    """Forward + all three input grads vs dense, with GQA, causal and
+    causal+ALiBi — the backward is the round-5 feature."""
+    _fpdt_parity_combos([(True, False), (True, True)])
+
+
+def test_fpdt_attention_noncausal_parity():
+    """Non-causal chunked parity (nightly: the causal combos above exercise
+    the same kernel with the strictly harder tile-skip logic)."""
+    _fpdt_parity_combos([(False, False)])
+
+
+# 1 layer: the model-level test proves the attn_impl wiring; depth adds
+# double-scan VJP compile time (the slowest test in the tier at 2 layers),
+# not coverage — per-layer math is already pinned by the attention parity
 _MODEL_KW = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
-                 num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=64,
+                 num_layers=1, num_heads=4, num_kv_heads=2, max_seq_len=64,
                  fused_ce=False)
 
 
@@ -67,13 +82,13 @@ def _loss_and_grad(cfg, ids):
     def f(p):
         return m.apply({"params": p}, {"input_ids": ids}, train=False)[0]
 
-    return f(params), jax.grad(f)(params)
+    # jit both: eager dispatch of the chunked double-scan VJP dominates the
+    # tier's wall-clock otherwise
+    return jax.jit(f)(params), jax.jit(jax.grad(f))(params)
 
 
-def test_fpdt_model_parity_and_host_offload():
-    """attn_impl='fpdt' trains identically to the xla path; with fpdt_offload
-    the q/k/v/out residuals park in host memory between fwd and bwd
-    (reference host-offloaded SequenceChunk) — same math."""
+def test_fpdt_model_parity():
+    """attn_impl='fpdt' trains identically to the xla path."""
     ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 64)), jnp.int32)
     l_ref, g_ref = _loss_and_grad(TransformerConfig(**_MODEL_KW, attn_impl="xla"), ids)
     l_new, g_new = _loss_and_grad(
@@ -84,8 +99,18 @@ def test_fpdt_model_parity_and_host_offload():
         lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-4, atol=1e-6),
         g_new, g_ref)
 
+
+def test_fpdt_model_host_offload_parity():
+    """With fpdt_offload the q/k/v/out residuals park in host memory between
+    fwd and bwd (reference host-offloaded SequenceChunk) — same math.
+    Nightly tier: same model-level compile as test_fpdt_model_parity plus the
+    host-transfer program; default keeps the attention-level parity + the
+    no-offload model parity."""
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 64)), jnp.int32)
+    l_ref, g_ref = _loss_and_grad(TransformerConfig(**_MODEL_KW, attn_impl="xla"), ids)
     # single-device jit: the host-memory residual transfers compile and the
-    # math is unchanged (multi-device is blocked upstream — see below)
+    # math is unchanged (multi-device is blocked upstream — see
+    # test_fpdt_offload_multidevice_raises)
     l_off, g_off = _loss_and_grad(
         TransformerConfig(**_MODEL_KW, attn_impl="fpdt",
                           fpdt_offload=True, fpdt_q_chunk=16, fpdt_kv_chunk=16), ids)

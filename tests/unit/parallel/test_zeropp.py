@@ -159,18 +159,22 @@ def test_loco_error_feedback_beats_plain_qgz(devices):
     T = 8
 
     def plain(gl):
-        out = jnp.zeros((gl.shape[0] // 8, gl.shape[1]), jnp.float32)
-        for _ in range(T):
-            out = out + _int8_reduce_scatter_dim(gl, 0, ("dp",), 64)
-        return out
+        # lax.scan (not a Python loop): the body compiles ONCE — the unrolled
+        # form was the single slowest test in the default tier (69 s cold)
+        def body(out, _):
+            return out + _int8_reduce_scatter_dim(gl, 0, ("dp",), 64), ()
+
+        out0 = jnp.zeros((gl.shape[0] // 8, gl.shape[1]), jnp.float32)
+        return jax.lax.scan(body, out0, None, length=T)[0]
 
     def loco(gl):
-        err = jnp.zeros_like(gl)
-        out = jnp.zeros((gl.shape[0] // 8, gl.shape[1]), jnp.float32)
-        for _ in range(T):
+        def body(carry, _):
+            out, err = carry
             s, err = _int8_reduce_scatter_dim_loco(gl, err, 0, ("dp",), 1.0, 64)
-            out = out + s
-        return out
+            return (out + s, err), ()
+
+        out0 = jnp.zeros((gl.shape[0] // 8, gl.shape[1]), jnp.float32)
+        return jax.lax.scan(body, (out0, jnp.zeros_like(gl)), None, length=T)[0][0]
 
     spec = P()  # grad replicated over dp; outputs scattered on dim 0
     run = lambda f: shard_map(  # noqa: E731

@@ -87,8 +87,10 @@ def _hlo_for(sparse: bool, mesh):
 
     from deepspeed_tpu.models import CausalLM, TransformerConfig
 
+    # 1 layer: the embed-grad reduce pattern under test is depth-independent
+    # and this helper compiles two full SPMD grad programs (default tier cost)
     cfg = TransformerConfig(
-        vocab_size=512, hidden_size=32, intermediate_size=64, num_layers=2,
+        vocab_size=512, hidden_size=32, intermediate_size=64, num_layers=1,
         num_heads=2, max_seq_len=16, sparse_embedding_grads=sparse)
     model = CausalLM(cfg)
     ids = jax.device_put(jnp.zeros((8, 16), jnp.int32),

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Probe the axon TPU relay every 10 min; append one line per probe to
+# .relay_probe.log. On the FIRST successful probe, fire the full on-chip
+# measurement queue (tools/relay_window.sh) exactly once — relay windows
+# have been minutes long, so the queue must start with zero human latency.
+# Stop by: touch /root/repo/.relay_probe_stop
+LOG=/root/repo/.relay_probe.log
+while [ ! -f /root/repo/.relay_probe_stop ]; do
+  T=$(date -u +%H:%M:%S)
+  if timeout 120 python -c "import jax; x=jax.numpy.ones((128,128)); print(float((x@x).sum()))" >/dev/null 2>&1; then
+    echo "$T UP" >> "$LOG"
+    if [ ! -f /root/repo/.relay_window_done ] && [ ! -f /root/repo/.relay_window_running ]; then
+      touch /root/repo/.relay_window_running
+      /root/repo/tools/relay_window.sh
+      rm -f /root/repo/.relay_window_running
+    fi
+  else
+    echo "$T down" >> "$LOG"
+  fi
+  sleep 600
+done
