@@ -282,9 +282,33 @@ def config_from_hf(hf_config: Dict[str, Any]) -> TransformerConfig:
             parallel_block=True,  # one shared ln_1 feeds attn AND mlp
             tie_embeddings=False,  # tied variant rejected above (bias drop)
         )
+    if mt == "gpt_bigcode":
+        # starcoder/santacoder (reference module_inject bigcode containers):
+        # gpt2 graph but nn.Linear storage ([out, in]) and, with multi_query,
+        # a single shared KV head fused into c_attn
+        h = hf_config["n_embd"]
+        act = hf_config.get("activation_function", "gelu_pytorch_tanh")
+        if act not in ("gelu_pytorch_tanh", "gelu_new", "gelu", "relu"):
+            raise ValueError(f"unsupported gpt_bigcode activation_function {act!r}")
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config.get("n_inner") or 4 * h,
+            num_layers=hf_config["n_layer"],
+            num_heads=hf_config["n_head"],
+            num_kv_heads=1 if hf_config.get("multi_query", True) else None,
+            max_seq_len=hf_config.get("n_positions", 1024),
+            norm="layernorm",
+            # HF gelu_pytorch_tanh == gelu_new == the tanh approx
+            activation={"gelu_pytorch_tanh": "gelu", "gelu_new": "gelu",
+                        "gelu": "gelu_exact", "relu": "relu"}[act],
+            position="learned",
+            norm_eps=float(hf_config.get("layer_norm_epsilon", 1e-5)),
+            tie_embeddings=bool(hf_config.get("tie_word_embeddings", True)),
+        )
     raise ValueError(
         f"unsupported HF model_type {mt!r} (supported: llama/mistral/mixtral/"
-        "qwen2/gpt2/opt/falcon/phi/gpt_neox/bloom/gptj/codegen)")
+        "qwen2/gpt2/opt/falcon/phi/gpt_neox/bloom/gptj/codegen/gpt_bigcode)")
 
 
 def detect_family(state: Dict[str, np.ndarray]) -> str:
@@ -309,8 +333,13 @@ def detect_family(state: Dict[str, np.ndarray]) -> str:
         return "codegen"
     if any("mlp.fc_in" in k for k in keys):
         return "gptj"
-    if any(k.endswith("attn.c_attn.weight") for k in keys):
-        return "gpt2"
+    for k in keys:
+        if k.endswith("attn.c_attn.weight"):
+            # gpt2 stores Conv1D [in, 3*in]; gpt_bigcode stores nn.Linear
+            # [out, in] where out is 3*in (MHA) or in + 2*head_dim (MQA) —
+            # the orientation/width separates them
+            w = state[k]
+            return "gpt2" if w.shape[1] == 3 * w.shape[0] else "gpt_bigcode"
     raise ValueError("cannot detect model family from checkpoint keys")
 
 
@@ -673,6 +702,54 @@ def _convert_codegen(state, cfg: TransformerConfig) -> Dict[str, Any]:
     return _convert_gptj(defused, cfg)
 
 
+def _convert_gpt_bigcode(state, cfg: TransformerConfig) -> Dict[str, Any]:
+    """GPT-BigCode / starcoder (reference ``module_inject`` bigcode
+    containers): the gpt2 graph, but projections are nn.Linear ([out, in] —
+    transposed vs gpt2's Conv1D) and with ``multi_query`` the fused c_attn
+    packs [q(H*hd) | k(hd) | v(hd)] rows sharing ONE kv head."""
+    h, hd, H, Hkv = cfg.hidden_size, cfg.dims_per_head, cfg.num_heads, cfg.kv_heads
+    g = _getter(state, ("", "transformer."))
+
+    def layer(i):
+        p = f"h.{i}."
+        w, b = g(p + "attn.c_attn.weight"), g(p + "attn.c_attn.bias")
+        if Hkv == 1:  # multi_query: [q(H*hd) | k(hd) | v(hd)] row blocks
+            q_w, k_w, v_w = np.split(w, [H * hd, (H + 1) * hd], axis=0)
+            q_b, k_b, v_b = np.split(b, [H * hd, (H + 1) * hd])
+            q_w = q_w.T.reshape(h, H, hd)
+            k_w, v_w = k_w.T.reshape(h, 1, hd), v_w.T.reshape(h, 1, hd)
+        else:  # MHA: PER-HEAD [q_hd | k_hd | v_hd] blocks (HF comment: "the
+            # memory layout is not the same as GPT2")
+            per_head = w.reshape(H, 3 * hd, h)
+            q_w, k_w, v_w = (per_head[:, s].transpose(2, 0, 1)
+                             for s in (slice(0, hd), slice(hd, 2 * hd),
+                                       slice(2 * hd, 3 * hd)))
+            pb = b.reshape(H, 3 * hd)
+            q_b, k_b, v_b = pb[:, :hd], pb[:, hd:2 * hd], pb[:, 2 * hd:]
+        return {
+            "attn_norm": {"scale": g(p + "ln_1.weight"), "bias": g(p + "ln_1.bias")},
+            "mlp_norm": {"scale": g(p + "ln_2.weight"), "bias": g(p + "ln_2.bias")},
+            "attn": {
+                "wq": {"kernel": q_w, "bias": q_b.reshape(H, hd)},
+                "wk": {"kernel": k_w, "bias": k_b.reshape(Hkv, hd)},
+                "wv": {"kernel": v_w, "bias": v_b.reshape(Hkv, hd)},
+                "wo": {"kernel": g(p + "attn.c_proj.weight").T.reshape(H, hd, h),
+                       "bias": g(p + "attn.c_proj.bias")},
+            },
+            "mlp": {
+                "w_up": {"kernel": g(p + "mlp.c_fc.weight").T, "bias": g(p + "mlp.c_fc.bias")},
+                "w_down": {"kernel": g(p + "mlp.c_proj.weight").T, "bias": g(p + "mlp.c_proj.bias")},
+            },
+        }
+
+    return {
+        "embed": {"embedding": g("wte.weight")},
+        "pos_embed": g("wpe.weight"),
+        "final_norm": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+        "layers": _stack(layer, cfg.num_layers),
+    }
+
+
 _CONVERTERS = {
     "llama": _convert_llama,
     "mistral": _convert_llama,
@@ -686,6 +763,7 @@ _CONVERTERS = {
     "bloom": _convert_bloom,
     "gptj": _convert_gptj,
     "codegen": _convert_codegen,
+    "gpt_bigcode": _convert_gpt_bigcode,
 }
 
 

@@ -190,6 +190,32 @@ def test_gpt2_ingestion_logits_parity(tmp_path):
     np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.parametrize("multi_query", [True, False])
+def test_gpt_bigcode_ingestion_logits_parity(tmp_path, multi_query):
+    """starcoder/santacoder-style (round 5; reference module_inject bigcode
+    containers): Linear-oriented c_attn, one shared KV head when multi_query.
+    The MHA variant (nightly) pins the [3h, h]-vs-[h, 3h] family detection."""
+    cfg_hf = transformers.GPTBigCodeConfig(
+        vocab_size=96, n_embd=32, n_layer=2, n_head=4, n_positions=64,
+        multi_query=multi_query, activation_function="gelu_pytorch_tanh")
+    hf_model = transformers.GPTBigCodeForCausalLM(cfg_hf)
+    hf_model.eval()
+    hf_model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg, params = load_hf_checkpoint(str(tmp_path))
+    assert cfg.kv_heads == (1 if multi_query else 4)
+    assert cfg.norm == "layernorm" and cfg.tie_embeddings
+
+    ids = np.random.default_rng(3).integers(0, 96, (2, 10))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+    module = CausalLM(cfg)
+    _, logits = module.apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray, params)},
+        {"input_ids": jnp.asarray(ids, jnp.int32)}, train=False)
+    np.testing.assert_allclose(np.asarray(logits), want, rtol=2e-3, atol=2e-4)
+
+
 def test_mixtral_ingestion_structure(tmp_path):
     """Mixtral converts to the exact tree the in-repo MoE CausalLM expects
     (logits parity is not pinned: HF routes without capacity dropping)."""
