@@ -382,6 +382,49 @@ def _gpt2_inference_model():
     return cfg, params
 
 
+def bench_inference_llama():
+    """Llama-family TTFT/decode evidence (BASELINE tracks the reference's
+    llama serving numbers; same 550M geometry as the training extra so the
+    pair reads together)."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=32000, hidden_size=1536, intermediate_size=6144,
+        num_layers=14, num_heads=16, num_kv_heads=8, head_dim=96,
+        max_seq_len=2048, norm="rmsnorm", activation="silu_glu",
+        position="rope", dtype=jax.numpy.bfloat16,
+    )
+    module = CausalLM(cfg)
+    example = {"input_ids": jax.numpy.zeros((1, 8), jax.numpy.int32)}
+    params = module.init({"params": jax.random.PRNGKey(0)}, example,
+                         train=False)["params"]
+    engine = deepspeed_tpu.init_inference(
+        cfg, params=params,
+        config={"dtype": "bfloat16", "seq_bucket": 256, "max_out_tokens": 256},
+    )
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (1, 200), dtype=np.int32)
+    n_new = 128
+    engine.generate(prompt, max_new_tokens=1, do_sample=False)
+    engine.generate(prompt, max_new_tokens=n_new, do_sample=False)
+    ttfts = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        engine.generate(prompt, max_new_tokens=1, do_sample=False)
+        ttfts.append(time.perf_counter() - t0)
+    p50_ttft = sorted(ttfts)[len(ttfts) // 2]
+    t0 = time.perf_counter()
+    engine.generate(prompt, max_new_tokens=n_new, do_sample=False)
+    dt = time.perf_counter() - t0
+    return {"params_m": round(cfg.num_params() / 1e6),
+            "p50_ttft_ms": round(p50_ttft * 1e3, 2),
+            "decode_tokens_per_sec": round((n_new - 1) / max(dt - p50_ttft, 1e-6), 1)}
+
+
 def bench_inference_v2():
     """FastGen-analog serving evidence (reference claims its ragged/paged v2
     engine, not v1, for the TTFT/throughput headlines): continuous batching
@@ -511,6 +554,7 @@ EXTRA_BENCHES = {
     "mixtral_style_moe": (bench_train_moe, 420),
     "inference_v1_gpt2_125m": (lambda peak: bench_inference(), 420),
     "inference_v2_ragged_gpt2_125m": (lambda peak: bench_inference_v2(), 480),
+    "inference_v1_llama_550m": (lambda peak: bench_inference_llama(), 480),
     "long_context_8k": (bench_train_long_context, 480),
     "fpdt_long_context_32k": (bench_train_fpdt_long_context, 600),
     "nvme_offload_550m": (bench_train_nvme_offload, 600),

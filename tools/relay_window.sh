@@ -31,7 +31,7 @@ echo "bench exit $? at $(stamp)" >> "$LOG"
 echo "sweep done at $(stamp)" >> "$LOG"
 
 # 3. Autotuner artifact on hardware (bench.py consumes it when committed).
-timeout 1800 python tools/run_autotune.py >> "$LOG" 2>&1
+timeout 2700 python tools/run_autotune.py >> "$LOG" 2>&1
 echo "autotune exit $? at $(stamp)" >> "$LOG"
 
 echo "=== relay window queue done $(stamp) ===" >> "$LOG"

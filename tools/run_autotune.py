@@ -85,9 +85,19 @@ def main():
         stage_candidates=stages,
         remat_candidates=(False,),
         model_factory=factory,
-        # the PERF.md round-3 table's model-level knobs
-        model_override_candidates=({}, {"scan_layers": False},
-                                   {"scan_layers": False, "fused_ce": False}),
+        # the PERF.md round-3 table's model-level knobs, plus round-5
+        # flash-kernel scheduling candidates (attn_kwargs flows through
+        # TransformerConfig -> causal_attention -> pallas kernel; dropped on
+        # the XLA path) so the tuner can pick kernel blocking on hardware
+        model_override_candidates=(
+            {}, {"scan_layers": False},
+            {"scan_layers": False, "fused_ce": False},
+            {"scan_layers": False, "fused_ce": False,
+             "attn_kwargs": {"block_q": 512, "block_k": 512, "k_splits": 2}},
+            {"scan_layers": False, "fused_ce": False,
+             "attn_kwargs": {"block_q": 1024, "block_k": 1024, "k_splits": 4}},
+        ) if not args.cpu_smoke else ({}, {"scan_layers": False},
+                                      {"scan_layers": False, "fused_ce": False}),
     )
     best, results = tuner.tune(steps=args.steps, batch_fn=batch_fn)
 
