@@ -337,9 +337,12 @@ def detect_family(state: Dict[str, np.ndarray]) -> str:
         if k.endswith("attn.c_attn.weight"):
             # gpt2 stores Conv1D [in, 3*in]; gpt_bigcode stores nn.Linear
             # [out, in] where out is 3*in (MHA) or in + 2*head_dim (MQA) —
-            # the orientation/width separates them
-            w = state[k]
-            return "gpt2" if w.shape[1] == 3 * w.shape[0] else "gpt_bigcode"
+            # the orientation/width separates them (np.shape also tolerates
+            # non-array placeholders, treated as gpt2)
+            shape = np.shape(state[k])
+            if len(shape) == 2 and shape[1] != 3 * shape[0]:
+                return "gpt_bigcode"
+            return "gpt2"
     raise ValueError("cannot detect model family from checkpoint keys")
 
 

@@ -218,6 +218,8 @@ NIGHTLY_NODE_SUBSTRINGS = [
     "TestFlashAlibi::test_masked_forward_matches_xla",  # alibi fwd[8-8] + grads[False-8-8] + masked_grads stay
     "test_fused_ce_pad_mask_and_uneven_chunks",  # fused_ce_matches_naive stays
     "test_gpt_bigcode_ingestion_logits_parity[False]",  # MQA [True] variant stays
+    "test_woq_stacked_layers_survive_scan",    # r4-bug regression; woq pytree + zero-inference woq composition stay
+    "test_safe_get_set_fp32_param_across_shards",  # fragment get_full_grad + tiled_linear stay
 ]
 
 

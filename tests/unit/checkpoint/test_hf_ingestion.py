@@ -275,7 +275,11 @@ def test_initialize_training_from_hf(tmp_path, devices):
 
 def test_detect_family():
     assert detect_family({"model.layers.0.self_attn.q_proj.weight": 0}) == "llama"
-    assert detect_family({"h.0.attn.c_attn.weight": 0}) == "gpt2"
+    # c_attn orientation separates gpt2 (Conv1D [in, 3in]) from gpt_bigcode
+    # ([out, in] Linear; out = 3in for MHA, in + 2*head_dim for MQA)
+    assert detect_family({"h.0.attn.c_attn.weight": np.zeros((8, 24))}) == "gpt2"
+    assert detect_family({"h.0.attn.c_attn.weight": np.zeros((24, 8))}) == "gpt_bigcode"
+    assert detect_family({"h.0.attn.c_attn.weight": np.zeros((12, 8))}) == "gpt_bigcode"
     assert detect_family({"model.layers.0.block_sparse_moe.gate.weight": 0}) == "mixtral"
     with pytest.raises(ValueError):
         detect_family({"bogus": 0})
