@@ -146,7 +146,10 @@ class DeepSpeedTPUEngine:
             # (the DeepSpeedCPUAdam analog). ``_train_step`` stays None.
             self._train_step = None
             self._offload_grad_step = self._build_offload_grad_step()
-            self._offload_update_step = self._build_offload_update_step()
+            if self._twin_ratio is not None:
+                self._build_twin_flow_steps()
+            else:
+                self._offload_update_step = self._build_offload_update_step()
         else:
             self._train_step = self._build_train_step()
         self._grad_step = None  # built lazily for the forward/backward/step path
@@ -251,6 +254,7 @@ class DeepSpeedTPUEngine:
         self.offload_mode: Optional[str] = None
         self._host_device = None
         self._opt_swapper = None
+        self._twin_ratio: Optional[float] = None
         dev = self._offload_cfg.device if self._offload_cfg else "none"
         param_dev = self._offload_param_cfg.device if self._offload_param_cfg else "none"
         if dev not in ("cpu", "nvme"):
@@ -287,7 +291,36 @@ class DeepSpeedTPUEngine:
             self.offload_mode = "host-jit"
         else:
             self.offload_mode = "memories"
-        log_dist(f"ZeRO-Offload enabled: mode={self.offload_mode} device={dev}", ranks=[0])
+        # Twin-Flow partial offload (reference ZeRO-Offload++,
+        # blogs/deepspeed-offloadpp: ``offload_optimizer.ratio`` = fraction of
+        # parameters whose optimizer step runs on the CPU side; the rest
+        # update on-accelerator and skip the host round-trip entirely).
+        ratio = float(self._offload_cfg.ratio) if self._offload_cfg else 1.0
+        self._twin_ratio = None
+        if ratio > 1.0:
+            raise ValueError(f"offload_optimizer.ratio={ratio}: must be in (0, 1]")
+        if ratio < 1.0:
+            if not 0.0 < ratio:
+                raise ValueError(
+                    f"offload_optimizer.ratio={ratio}: must be in (0, 1] — "
+                    "for a fully on-device optimizer drop the offload_optimizer "
+                    "section instead of ratio<=0")
+            if self.offload_mode != "host-jit":
+                raise ValueError(
+                    f"offload_optimizer.ratio={ratio} (Twin-Flow partial offload) "
+                    f"requires the host-jit cpu offload mode; mode={self.offload_mode!r} "
+                    "(nvme swaps the whole state; 'memories' has no split step)")
+            if self._offload_param_cfg and self._offload_param_cfg.device != "none":
+                raise NotImplementedError(
+                    "offload_param does not compose with Twin-Flow partial "
+                    "optimizer offload (ratio < 1): param offload clears the "
+                    "device bf16 copy every step, which the partial path keeps "
+                    "resident — use ratio=1.0 with offload_param")
+            self._twin_ratio = ratio
+        log_dist(
+            f"ZeRO-Offload enabled: mode={self.offload_mode} device={dev}"
+            + (f" twin_flow_ratio={ratio}" if self._twin_ratio is not None else ""),
+            ranks=[0])
 
     @staticmethod
     def _build_engine_mesh(config) -> Mesh:
@@ -411,7 +444,29 @@ class DeepSpeedTPUEngine:
             from jax.sharding import SingleDeviceSharding
 
             host_sh = SingleDeviceSharding(self._host_device)
-            self.param_sharding = jax.tree_util.tree_map(lambda _: host_sh, param_shapes)
+            if self._twin_ratio is not None:
+                # Twin-Flow: the first `ratio` fraction of master bytes (in
+                # stable tree-flatten order) updates host-side; the rest
+                # keeps its on-mesh master placement and updates in a fused
+                # device program (reference ZeRO-Offload++ Twin-Flow).
+                leaves, treedef = jax.tree_util.tree_flatten(param_shapes)
+                sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in leaves]
+                total = sum(sizes)
+                flags, cum = [], 0
+                for s in sizes:
+                    flags.append(cum < self._twin_ratio * total)
+                    cum += s
+                self._tf_host_mask = jax.tree_util.tree_unflatten(treedef, flags)
+                self.param_sharding = jax.tree_util.tree_map(
+                    lambda m, sh: host_sh if m else sh,
+                    self._tf_host_mask, self._device_param_sharding)
+                n_host = sum(s for s, m in zip(sizes, flags) if m)
+                log_dist(
+                    f"Twin-Flow split: {n_host / max(total, 1):.1%} of "
+                    f"{total / 1e6:.1f}M master params update host-side "
+                    f"(ratio={self._twin_ratio})", ranks=[0])
+            else:
+                self.param_sharding = jax.tree_util.tree_map(lambda _: host_sh, param_shapes)
 
         if master_f32 is not None:
             params = jax.device_put(master_f32, self.param_sharding)
@@ -432,8 +487,27 @@ class DeepSpeedTPUEngine:
             from jax.sharding import SingleDeviceSharding
 
             host_sh = SingleDeviceSharding(self._host_device)
-            self.opt_sharding = jax.tree_util.tree_map(lambda _: host_sh, opt_shapes)
-            opt_state = jax.jit(self.tx.init)(params)  # inputs committed to host => runs on the cpu backend
+            if self._twin_ratio is not None:
+                # Two structure-preserving masked views of the ONE optimizer:
+                # each partition's state keeps the param-tree shape with
+                # optax.MaskedNode holes for the other partition, so the
+                # fragment/checkpoint walkers still see param-shaped moment
+                # trees. Out-of-partition leaves are fed as 0-d dummies the
+                # masked transform never reads.
+                self._tf_dev_mask = jax.tree_util.tree_map(
+                    lambda m: not m, self._tf_host_mask)
+                self._tf_tx_host = optax.masked(self.tx, self._tf_host_mask)
+                self._tf_tx_dev = optax.masked(self.tx, self._tf_dev_mask)
+                host_sub = self._tf_partition(params, host_side=True)
+                dev_sub = self._tf_partition(params, host_side=False)
+                opt_host = jax.jit(self._tf_tx_host.init)(host_sub)  # cpu backend
+                opt_dev = jax.jit(self._tf_tx_dev.init)(dev_sub)
+                opt_state = (opt_host, opt_dev)
+                self.opt_sharding = jax.tree_util.tree_map(
+                    lambda x: x.sharding, opt_state)
+            else:
+                self.opt_sharding = jax.tree_util.tree_map(lambda _: host_sh, opt_shapes)
+                opt_state = jax.jit(self.tx.init)(params)  # inputs committed to host => runs on the cpu backend
             ls_state = make_loss_scale_state(
                 enabled=self.fp16,
                 initial_scale_power=self.config.model.fp16.initial_scale_power,
@@ -984,31 +1058,42 @@ class DeepSpeedTPUEngine:
         def sel(new, old):
             return jax.tree_util.tree_map(lambda n, o: jnp.where(finite, n, o), new, old)
 
-        new_ls = update_loss_scale(
-            state.loss_scale,
-            finite,
-            dynamic=dynamic,
-            scale_window=fp16_cfg.loss_scale_window,
-            min_scale=fp16_cfg.min_loss_scale,
-            init_hysteresis=fp16_cfg.hysteresis,
-            consecutive_hysteresis=fp16_cfg.consecutive_hysteresis,
-        ) if self.fp16 else state.loss_scale
-
+        new_ls, new_step, metrics = self._post_update_bookkeeping(
+            finite, gnorm, state.step, state.loss_scale)
         new_state = TrainState(
-            step=state.step + jnp.where(finite, 1, 0).astype(jnp.int32),
+            step=new_step,
             params=sel(new_params, state.params),
             opt_state=sel(new_opt, state.opt_state),
             loss_scale=new_ls,
             rng=new_rng_data,
             comm_error=state.comm_error,
         )
+        return new_state, metrics
+
+    def _post_update_bookkeeping(self, finite, gnorm, step, ls_state):
+        """Loss-scale advance + step counter + step metrics — shared by
+        ``_update_math`` (fused / host-jit / apply paths) AND the Twin-Flow
+        host program, so the overflow/bookkeeping semantics cannot drift
+        between full and partial offload."""
+        fp16_cfg = self.config.model.fp16
+        dynamic = self.fp16 and fp16_cfg.dynamic
+        new_ls = update_loss_scale(
+            ls_state,
+            finite,
+            dynamic=dynamic,
+            scale_window=fp16_cfg.loss_scale_window,
+            min_scale=fp16_cfg.min_loss_scale,
+            init_hysteresis=fp16_cfg.hysteresis,
+            consecutive_hysteresis=fp16_cfg.consecutive_hysteresis,
+        ) if self.fp16 else ls_state
+        new_step = step + jnp.where(finite, 1, 0).astype(jnp.int32)
         metrics = {
             "grad_norm": gnorm,
-            "lr": jnp.asarray(self.lr_scheduler_fn(state.step), jnp.float32),
-            "loss_scale": state.loss_scale.loss_scale,
+            "lr": jnp.asarray(self.lr_scheduler_fn(step), jnp.float32),
+            "loss_scale": ls_state.loss_scale,
             "overflow": ~finite,
         }
-        return new_state, metrics
+        return new_ls, new_step, metrics
 
     # ----------------------------------------------------- offload split path
     def _build_offload_grad_step(self) -> Callable:
@@ -1078,9 +1163,128 @@ class DeepSpeedTPUEngine:
             state = state._replace(opt_state=self._opt_swapper.swap_in_opt_state(device_put=False))
         return state
 
+    # ------------------------------------------------ Twin-Flow (partial) --
+    def _tf_partition(self, tree, host_side: bool):
+        """One partition's view of a params-shaped tree: out-of-partition
+        leaves become 0-d numpy zeros (uncommitted, never read by the masked
+        optimizer) so each program's inputs live on ONE backend."""
+        keep = self._tf_host_mask if host_side else self._tf_dev_mask
+        return jax.tree_util.tree_map(
+            lambda m, x: x if m else np.zeros((), x.dtype), keep, tree)
+
+    def _tf_merge(self, host_tree, dev_tree):
+        """Re-assemble a full params-shaped tree from the two partition
+        views (dummy leaves from each side are dropped)."""
+        return jax.tree_util.tree_map(
+            lambda m, h, d: h if m else d, self._tf_host_mask, host_tree, dev_tree)
+
+    def _tf_refresh_compute(self, host_16, dev_16):
+        """Merged on-accelerator bf16 compute params: the host partition's
+        refresh crosses H2D into its mesh placement; the device partition's
+        is already there."""
+        host16_dev = jax.tree_util.tree_map(
+            lambda m, x, sh: jax.device_put(x, sh) if m else x,
+            self._tf_host_mask, host_16, self._device_param_sharding)
+        return self._tf_merge(host16_dev, dev_16)
+
+    def _build_twin_flow_steps(self) -> None:
+        """The three Twin-Flow programs (reference ZeRO-Offload++): a device
+        stats pass (finite + global norm over the FULL gradient, so clipping
+        stays mathematically identical to the fused step), a fused on-device
+        update for the device partition, and the host-jit update + bookkeeping
+        for the host partition."""
+        gas = self.config.gradient_accumulation_steps
+        clip = self.config.gradient_clipping
+
+        def stats(grads, inv):
+            finite = all_finite(grads) if self.fp16 else jnp.asarray(True)
+            # norm is 1-homogeneous: norm(g * inv) == norm(g) * inv
+            return finite, global_norm(grads) * inv
+
+        def _clipped(grads_sub, inv, gnorm):
+            g = jax.tree_util.tree_map(lambda x: x * inv, grads_sub)
+            if clip and clip > 0:
+                g, _ = clip_by_global_norm(g, clip, norm=gnorm)
+            return g
+
+        def dev_update(params_sub, opt_dev, grads_sub, inv, finite, gnorm):
+            g = _clipped(grads_sub, inv, gnorm)
+            updates, new_opt = self._tf_tx_dev.update(g, opt_dev, params_sub)
+            new_params = optax.apply_updates(params_sub, updates)
+            sel = lambda n, o: jax.tree_util.tree_map(  # noqa: E731
+                lambda a, b: jnp.where(finite, a, b), n, o)
+            new_params = sel(new_params, params_sub)
+            new_opt = sel(new_opt, opt_dev)
+            return new_params, new_opt, cast_floating(new_params, self.compute_dtype)
+
+        def host_update(params_sub, opt_host, grads_sub, step, ls_state, rng_data,
+                        finite, gnorm):
+            rng = jax.random.wrap_key_data(rng_data)
+            rng, _ = jax.random.split(rng)  # same key advance as the fused step
+            inv = 1.0 / (gas * ls_state.loss_scale)
+            g = _clipped(grads_sub, inv, gnorm)
+            updates, new_opt = self._tf_tx_host.update(g, opt_host, params_sub)
+            new_params = optax.apply_updates(params_sub, updates)
+            sel = lambda n, o: jax.tree_util.tree_map(  # noqa: E731
+                lambda a, b: jnp.where(finite, a, b), n, o)
+            new_params = sel(new_params, params_sub)
+            new_opt = sel(new_opt, opt_host)
+            new_ls, new_step, metrics = self._post_update_bookkeeping(
+                finite, gnorm, step, ls_state)
+            return (new_params, new_opt, new_step, new_ls,
+                    jax.random.key_data(rng), metrics,
+                    cast_floating(new_params, self.compute_dtype))
+
+        self._tf_stats = jax.jit(stats)
+        self._tf_dev_update = jax.jit(dev_update)
+        self._tf_host_update = jax.jit(host_update)  # host-committed inputs => cpu backend
+
+    def _tf_apply_update(self, state: TrainState, grads) -> Dict[str, Any]:
+        """Twin-Flow step tail: device partition updates on-accelerator; only
+        the host partition's gradients cross to the CPU and only its bf16
+        refresh crosses back (the Twin-Flow win over full offload)."""
+        from jax.sharding import SingleDeviceSharding
+
+        host_sh = SingleDeviceSharding(self._host_device)
+        scale = float(jax.device_get(state.loss_scale.loss_scale))
+        inv = 1.0 / (self.config.gradient_accumulation_steps * scale)
+        finite, gnorm = self._tf_stats(grads, inv)
+
+        dev_grads = self._tf_partition(grads, host_side=False)
+        host_grads = jax.tree_util.tree_map(
+            lambda m, x: jax.device_put(x, host_sh) if m else np.zeros((), x.dtype),
+            self._tf_host_mask, grads)
+
+        opt_host, opt_dev = state.opt_state
+        new_dev_params, new_opt_dev, dev_16 = self._tf_dev_update(
+            self._tf_partition(state.params, host_side=False), opt_dev,
+            dev_grads, inv, finite, gnorm)
+        finite_h = jax.device_get(finite)
+        gnorm_h = jax.device_get(gnorm)
+        (new_host_params, new_opt_host, new_step, new_ls, new_rng, metrics,
+         host_16) = self._tf_host_update(
+            self._tf_partition(state.params, host_side=True), opt_host,
+            host_grads, state.step, state.loss_scale, state.rng,
+            finite_h, gnorm_h)
+
+        overflow = bool(jax.device_get(metrics["overflow"]))
+        if not overflow:
+            self._compute_dev = self._tf_refresh_compute(host_16, dev_16)
+        self.state = TrainState(
+            step=new_step,
+            params=self._tf_merge(new_host_params, new_dev_params),
+            opt_state=(new_opt_host, new_opt_dev),
+            loss_scale=new_ls,
+            rng=new_rng,
+            comm_error=state.comm_error,
+        )
+        return metrics
+
     def _offload_apply_update(self, state: TrainState, grads) -> Dict[str, Any]:
         """Host update + device bf16 refresh + NVMe swap-out (shared by the
         train_batch fast path and the forward/backward/step parity path)."""
+        if self._twin_ratio is not None:
+            return self._tf_apply_update(state, grads)
         from jax.sharding import SingleDeviceSharding
 
         host_sh = SingleDeviceSharding(self._host_device)
@@ -1116,10 +1320,15 @@ class DeepSpeedTPUEngine:
     def _materialize_compute_dev(self):
         """Ensure bf16 compute params exist on the accelerator; returns them."""
         if self._compute_dev is None:
-            self._compute_dev = jax.device_put(
-                jax.jit(functools.partial(cast_floating, dtype=self.compute_dtype))(self.state.params),
-                self._device_param_sharding,
-            )
+            cast = jax.jit(functools.partial(cast_floating, dtype=self.compute_dtype))
+            if self._twin_ratio is not None:
+                # mixed master placement: one jit per partition's backend
+                host_16 = cast(self._tf_partition(self.state.params, host_side=True))
+                dev_16 = cast(self._tf_partition(self.state.params, host_side=False))
+                self._compute_dev = self._tf_refresh_compute(host_16, dev_16)
+            else:
+                self._compute_dev = jax.device_put(
+                    cast(self.state.params), self._device_param_sharding)
         return self._compute_dev
 
     def materialize_state(self) -> None:

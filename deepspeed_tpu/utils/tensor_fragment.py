@@ -73,13 +73,23 @@ def safe_set_full_fp32_param(engine, path: PathLike, value) -> None:
 
 
 # --------------------------------------------------------------- opt state
-def _find_moment_tree(opt_state, field: str):
-    """First optax sub-state carrying ``field`` (mu/nu for Adam-family)."""
+def _find_moment_trees(opt_state, field: str):
+    """Every optax sub-state carrying ``field`` (mu/nu for Adam-family).
+
+    Twin-Flow engines hold TWO masked partition states (host, device), each
+    param-tree-shaped with ``optax.MaskedNode`` holes for the other
+    partition — callers probe each tree until the leaf is a real array."""
+    out = []
     for s in jax.tree_util.tree_leaves(
             opt_state, is_leaf=lambda x: hasattr(x, field)):
         if hasattr(s, field):
-            return getattr(s, field)
-    return None
+            out.append(getattr(s, field))
+    return out
+
+
+def _find_moment_tree(opt_state, field: str):
+    trees = _find_moment_trees(opt_state, field)
+    return trees[0] if trees else None
 
 
 def safe_get_full_optimizer_state(engine, path: PathLike, state_name: str) -> Optional[np.ndarray]:
@@ -87,10 +97,11 @@ def safe_get_full_optimizer_state(engine, path: PathLike, state_name: str) -> Op
     field = _OPT_STATE_ALIASES.get(state_name)
     if field is None:
         raise ValueError(f"unknown optimizer state {state_name!r} (use exp_avg/exp_avg_sq)")
-    tree = _find_moment_tree(engine.state.opt_state, field)
-    if tree is None:
-        return None
-    return np.asarray(jax.device_get(_get_leaf(tree, path)))
+    for tree in _find_moment_trees(engine.state.opt_state, field):
+        leaf = _get_leaf(tree, path)
+        if hasattr(leaf, "shape"):  # skip a masked partition's MaskedNode hole
+            return np.asarray(jax.device_get(leaf))
+    return None
 
 
 def safe_set_full_optimizer_state(engine, path: PathLike, state_name: str, value) -> None:
@@ -103,6 +114,10 @@ def safe_set_full_optimizer_state(engine, path: PathLike, state_name: str, value
         if hasattr(node, field):
             tree = jax.tree_util.tree_map(lambda x: x, getattr(node, field))
             old = _get_leaf(tree, path)
+            if not hasattr(old, "dtype"):
+                # a Twin-Flow masked partition whose hole sits at this path:
+                # the real leaf lives in the OTHER partition's state
+                return node
             new = jax.device_put(np.asarray(value, old.dtype).reshape(old.shape), old.sharding)
             _set_leaf(tree, path, new)
             return node._replace(**{field: tree})

@@ -224,6 +224,9 @@ NIGHTLY_NODE_SUBSTRINGS = [
     # parity tests) + InferenceEngineV2 (continuous-batching parity suite);
     # its engine-compile cost stays out of the default tier
     "test_build_hf_engine_v2_from_checkpoint",
+    # Twin-Flow: structure + nvme-reject + fragment-visibility stay default;
+    # the two-engine trajectory comparison is the nightly depth
+    "test_twin_flow_trajectory_matches_fused",
 ]
 
 

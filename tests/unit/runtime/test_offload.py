@@ -47,6 +47,78 @@ def _run_steps(engine, n=3, seed=0):
     return losses
 
 
+def test_twin_flow_partial_offload_structure():
+    """Twin-Flow (reference ZeRO-Offload++ ``offload_optimizer.ratio``):
+    with ratio<1, part of the master state must stay ON the mesh (device
+    partition updates in a fused accelerator program) while the host
+    partition lives on the CPU backend — and a step runs."""
+    eng, *_ = deepspeed_tpu.initialize(
+        model=_model(),
+        config=_cfg({"offload_optimizer": {"device": "cpu", "ratio": 0.5}}),
+    )
+    assert eng.offload_mode == "host-jit" and eng._twin_ratio == 0.5
+    leaves = jax.tree_util.tree_leaves(eng.state.params)
+    kinds = [type(leaf.sharding).__name__ for leaf in leaves]
+    assert "SingleDeviceSharding" in kinds and "NamedSharding" in kinds, kinds
+    # host partition holds ~ratio of the master bytes (greedy split)
+    host_b = sum(l.size for l in leaves if type(l.sharding).__name__ == "SingleDeviceSharding")
+    total_b = sum(l.size for l in leaves)
+    assert 0.2 < host_b / total_b < 0.8, host_b / total_b
+    losses = _run_steps(eng, 2)
+    assert all(np.isfinite(losses))
+    # the fragment API sees THROUGH the masked partition states: a moment is
+    # retrievable for params in both partitions (embed is first in flatten
+    # order => host; the final norm lands in the device partition)
+    from deepspeed_tpu.utils.tensor_fragment import safe_get_full_optimizer_state
+
+    mu_host = safe_get_full_optimizer_state(eng, "embed/embedding", "exp_avg")
+    mu_dev = safe_get_full_optimizer_state(eng, "final_norm/scale", "exp_avg")
+    assert mu_host is not None and float(np.abs(mu_host).max()) > 0
+    assert mu_dev is not None and float(np.abs(mu_dev).max()) > 0
+
+
+def test_twin_flow_trajectory_matches_fused():
+    """ratio=0.5 partial offload reproduces the fused non-offload trajectory
+    (same split semantics: one global grad norm, one loss-scale/step
+    bookkeeping; nightly depth for the new feature)."""
+    twin, *_ = deepspeed_tpu.initialize(
+        model=_model(),
+        config=_cfg({"offload_optimizer": {"device": "cpu", "ratio": 0.5}}),
+    )
+    base, *_ = deepspeed_tpu.initialize(model=_model(), config=_cfg())
+    l0 = _run_steps(base, 3)
+    l1 = _run_steps(twin, 3)
+    np.testing.assert_allclose(l0, l1, rtol=2e-4)
+    # and the masters stay consistent: fp32 state_dict matches closely
+    sd_t = twin.module_state_dict()
+    sd_b = base.module_state_dict()
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5),
+        sd_t, sd_b)
+
+
+def test_twin_flow_ratio_rejected_with_nvme(tmp_path):
+    with pytest.raises(ValueError, match="Twin-Flow"):
+        deepspeed_tpu.initialize(
+            model=_model(),
+            config=_cfg({"offload_optimizer": {
+                "device": "nvme", "nvme_path": str(tmp_path), "ratio": 0.5}}),
+        )
+
+
+def test_twin_flow_ratio_bounds_and_param_offload_rejected():
+    for bad in (0.0, -0.5, 1.5):
+        with pytest.raises(ValueError, match="ratio"):
+            deepspeed_tpu.initialize(
+                model=_model(),
+                config=_cfg({"offload_optimizer": {"device": "cpu", "ratio": bad}}))
+    with pytest.raises(NotImplementedError, match="offload_param"):
+        deepspeed_tpu.initialize(
+            model=_model(),
+            config=_cfg({"offload_optimizer": {"device": "cpu", "ratio": 0.5},
+                         "offload_param": {"device": "cpu"}}, stage=3))
+
+
 def test_offload_optimizer_cpu_trajectory_matches_fused():
     base, *_ = deepspeed_tpu.initialize(model=_model(), config=_cfg())
     off, *_ = deepspeed_tpu.initialize(
