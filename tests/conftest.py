@@ -225,8 +225,9 @@ NIGHTLY_NODE_SUBSTRINGS = [
     # its engine-compile cost stays out of the default tier
     "test_build_hf_engine_v2_from_checkpoint",
     # Twin-Flow: structure + nvme-reject + fragment-visibility stay default;
-    # the two-engine trajectory comparison is the nightly depth
+    # the two-engine trajectory comparisons are the nightly depth
     "test_twin_flow_trajectory_matches_fused",
+    "test_twin_flow_fp16_dynamic_scale_matches_fused",
 ]
 
 

@@ -97,6 +97,24 @@ def test_twin_flow_trajectory_matches_fused():
         sd_t, sd_b)
 
 
+def test_twin_flow_fp16_dynamic_scale_matches_fused():
+    """fp16 dynamic loss scaling under Twin-Flow: the shared bookkeeping
+    (one finite flag, one loss-scale state) must reproduce the fused fp16
+    trajectory including any scale adjustments (nightly depth)."""
+    fp16 = {"fp16": {"enabled": True, "initial_scale_power": 8, "loss_scale_window": 2}}
+
+    twin, *_ = deepspeed_tpu.initialize(
+        model=_model(),
+        config={**_cfg({"offload_optimizer": {"device": "cpu", "ratio": 0.5}}), **fp16})
+    base, *_ = deepspeed_tpu.initialize(model=_model(), config={**_cfg(), **fp16})
+    l0 = _run_steps(base, 4)
+    l1 = _run_steps(twin, 4)
+    np.testing.assert_allclose(l0, l1, rtol=3e-3)
+    assert float(jax.device_get(twin.state.loss_scale.loss_scale)) == \
+        float(jax.device_get(base.state.loss_scale.loss_scale))
+    assert int(jax.device_get(twin.state.step)) == int(jax.device_get(base.state.step))
+
+
 def test_twin_flow_ratio_rejected_with_nvme(tmp_path):
     with pytest.raises(ValueError, match="Twin-Flow"):
         deepspeed_tpu.initialize(
