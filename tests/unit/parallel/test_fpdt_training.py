@@ -67,11 +67,12 @@ def test_fpdt_attention_noncausal_parity():
     _fpdt_parity_combos([(False, False)])
 
 
-# 1 layer: the model-level test proves the attn_impl wiring; depth adds
-# double-scan VJP compile time (the slowest test in the tier at 2 layers),
-# not coverage — per-layer math is already pinned by the attention parity
+# 1 layer + seq 32 (2x2 chunks of 16): the model-level test proves the
+# attn_impl wiring; depth and longer scans add double-scan VJP compile time
+# (the slowest test in the tier at 2 layers/4x4 chunks), not coverage —
+# per-layer math is already pinned by the attention parity
 _MODEL_KW = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
-                 num_layers=1, num_heads=4, num_kv_heads=2, max_seq_len=64,
+                 num_layers=1, num_heads=4, num_kv_heads=2, max_seq_len=32,
                  fused_ce=False)
 
 
@@ -89,7 +90,7 @@ def _loss_and_grad(cfg, ids):
 
 def test_fpdt_model_parity():
     """attn_impl='fpdt' trains identically to the xla path."""
-    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 64)), jnp.int32)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 32)), jnp.int32)
     l_ref, g_ref = _loss_and_grad(TransformerConfig(**_MODEL_KW, attn_impl="xla"), ids)
     l_new, g_new = _loss_and_grad(
         TransformerConfig(**_MODEL_KW, attn_impl="fpdt",
@@ -106,7 +107,7 @@ def test_fpdt_model_host_offload_parity():
     Nightly tier: same model-level compile as test_fpdt_model_parity plus the
     host-transfer program; default keeps the attention-level parity + the
     no-offload model parity."""
-    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 64)), jnp.int32)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 32)), jnp.int32)
     l_ref, g_ref = _loss_and_grad(TransformerConfig(**_MODEL_KW, attn_impl="xla"), ids)
     # single-device jit: the host-memory residual transfers compile and the
     # math is unchanged (multi-device is blocked upstream — see
