@@ -177,6 +177,12 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
 
         self.flops_profiler = FlopsProfiler(engine=self)
+        if self.config.model.memory_breakdown:
+            # reference engine.py:257 logs phased see_memory_usage when the
+            # memory_breakdown knob is set
+            from deepspeed_tpu.utils.memory import see_memory_usage
+
+            see_memory_usage("engine state initialized", force=True)
         log_dist(
             f"engine ready: mesh={dict(self.mesh.shape)} zero_stage={self.zero_config.stage} "
             f"dtype={self.compute_dtype.__name__} batch={self.config.train_batch_size} "
@@ -1448,6 +1454,10 @@ class DeepSpeedTPUEngine:
                 ranks=[0],
             )
             self.flush_monitor()
+            if self.config.model.memory_breakdown:
+                from deepspeed_tpu.utils.memory import see_memory_usage
+
+                see_memory_usage(f"after step {step}", force=True)
         return metrics
 
     def flush_monitor(self) -> None:

@@ -1,4 +1,5 @@
 from deepspeed_tpu.utils.logging import logger, log_dist, print_rank_0
+from deepspeed_tpu.utils.memory import memory_status, see_memory_usage
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from deepspeed_tpu.utils.tensor_fragment import (
     safe_get_full_fp32_param,

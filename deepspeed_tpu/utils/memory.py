@@ -1,0 +1,64 @@
+"""Memory-usage reporting (reference ``runtime/utils.py:771 see_memory_usage``).
+
+TPU-native form: device stats come from PJRT ``memory_stats()`` (HBM
+bytes_in_use / peak) plus the live-buffer census from ``jax.live_arrays``;
+host stats read ``/proc/meminfo`` (psutil is not a baked dependency).
+"""
+
+from __future__ import annotations
+
+import gc
+from typing import Dict, Optional
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+_GB = 1024 ** 3
+
+
+def memory_status() -> Dict[str, float]:
+    """Snapshot of device + host memory in GB (best-effort per backend —
+    CPU PJRT devices report no stats; TPU reports HBM in-use and peak)."""
+    out: Dict[str, float] = {}
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:  # backend without stats support
+        stats = {}
+    if "bytes_in_use" in stats:
+        out["device_in_use_gb"] = round(stats["bytes_in_use"] / _GB, 3)
+    if "peak_bytes_in_use" in stats:
+        out["device_peak_gb"] = round(stats["peak_bytes_in_use"] / _GB, 3)
+    if "bytes_limit" in stats:
+        out["device_limit_gb"] = round(stats["bytes_limit"] / _GB, 3)
+    # live jax buffers (all backends; counts each shard once per process)
+    live = jax.live_arrays()
+    out["live_array_gb"] = round(
+        sum(getattr(a, "nbytes", 0) for a in live) / _GB, 6)
+    out["live_array_count"] = len(live)
+    try:
+        meminfo = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, rest = line.partition(":")
+                meminfo[k.strip()] = int(rest.split()[0]) * 1024  # kB -> B
+        total, avail = meminfo.get("MemTotal", 0), meminfo.get("MemAvailable", 0)
+        if total:
+            out["host_used_gb"] = round((total - avail) / _GB, 2)
+            out["host_total_gb"] = round(total / _GB, 2)
+    except OSError:
+        pass
+    return out
+
+
+def see_memory_usage(message: str, force: bool = False,
+                     ranks: Optional[list] = None) -> Optional[Dict[str, float]]:
+    """Log a memory snapshot (reference ``see_memory_usage`` — same
+    force-gated, rank-0-only contract). Returns the stats dict when logged."""
+    if not force:
+        return None
+    gc.collect()  # drop unreferenced buffers so live_arrays reflects reality
+    stats = memory_status()
+    parts = [f"{k}={v}" for k, v in stats.items()]
+    log_dist(f"{message} | {' '.join(parts)}", ranks=ranks or [0])
+    return stats
