@@ -110,6 +110,16 @@ class DeepSpeedTPUEngine:
         # ZeRO++ knobs validate at construction (dead/lying knobs are worse
         # than errors); quantized collectives do not compose with the
         # split-backend offload step.
+        if (self.config.model.prescale_gradients
+                or self.config.model.gradient_predivide_factor != 1.0):
+            # The compiled step computes the exact gradient mean inside ONE
+            # fused program — there is no separate allreduce to pre/post-scale
+            # around, so these knobs cannot change anything. Raising beats a
+            # lying no-op (fp16 headroom is covered by dynamic loss scaling).
+            raise NotImplementedError(
+                "prescale_gradients / gradient_predivide_factor have no effect "
+                "in the fused SPMD step; remove them (dynamic loss scaling "
+                "handles fp16 overflow headroom)")
         self._zpp = self._zpp_config()
         if self._zpp and self.offload_mode in ("host-jit", "nvme"):
             raise NotImplementedError(
@@ -159,9 +169,14 @@ class DeepSpeedTPUEngine:
         self._pending_losses: list = []
         self._micro_steps = 0
 
+        # wall_clock_breakdown (reference engine timers): the fused TPU step
+        # has no separable fwd/bwd/step phases, so the honest analog is a
+        # per-step wall-clock window (note: with async dispatch an individual
+        # window captures dispatch; true device rates appear at sync points)
         self.throughput_timer = ThroughputTimer(
             batch_size=self.config.train_batch_size,
-            steps_per_output=self.config.model.steps_per_print,
+            steps_per_output=(1 if self.config.model.wall_clock_breakdown
+                              else self.config.model.steps_per_print),
         )
         self.losses = None
         self.monitor = None  # wired by engine_builder when monitoring configured
@@ -183,6 +198,15 @@ class DeepSpeedTPUEngine:
             from deepspeed_tpu.utils.memory import see_memory_usage
 
             see_memory_usage("engine state initialized", force=True)
+        if self.config.model.comms_logger.enabled:
+            # reference comm/config.py CommsConfig -> comm logger wiring
+            from deepspeed_tpu.comm import comm as comm_mod
+
+            cl = self.config.model.comms_logger
+            comm_mod.configure(enabled=True, verbose=cl.verbose, debug=cl.debug)
+        if self.config.model.dump_state:
+            # reference engine.py dump_state: print the resolved config once
+            log_dist(f"engine config: {self.config.model.model_dump()}", ranks=[0])
         log_dist(
             f"engine ready: mesh={dict(self.mesh.shape)} zero_stage={self.zero_config.stage} "
             f"dtype={self.compute_dtype.__name__} batch={self.config.train_batch_size} "

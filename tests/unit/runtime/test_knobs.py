@@ -209,3 +209,37 @@ def test_onebit_universal_checkpoint_excludes_residuals(tmp_path, devices):
     load_universal(ob2, str(tmp_path))
     assert ob2.state.comm_error is not None  # fresh residuals, not restored
     assert np.isfinite(float(ob2.train_batch(batch)["loss"]))
+
+
+def test_round5_knob_wiring(monkeypatch):
+    """Previously-dead knobs now act (or loudly refuse): comms_logger config
+    section configures the logger, dump_state prints the resolved config,
+    prescale_gradients raises (no-op in the fused step), wall_clock_breakdown
+    switches the throughput window to per-step."""
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import comm as comm_mod
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    def spec():
+        cfg = TransformerConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                                num_layers=1, num_heads=2, max_seq_len=16)
+        return causal_lm_spec(cfg, example_seq_len=16)
+
+    base = {"train_micro_batch_size_per_gpu": 1, "steps_per_print": 1000,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}}
+
+    with pytest.raises(NotImplementedError, match="prescale_gradients"):
+        deepspeed_tpu.initialize(model=spec(), config={**base, "prescale_gradients": True})
+    with pytest.raises(NotImplementedError, match="predivide"):
+        deepspeed_tpu.initialize(model=spec(), config={**base, "gradient_predivide_factor": 2.0})
+
+    was_enabled = comm_mod.comms_logger.enabled
+    try:
+        eng, *_ = deepspeed_tpu.initialize(
+            model=spec(),
+            config={**base, "comms_logger": {"enabled": True, "verbose": False},
+                    "wall_clock_breakdown": True, "dump_state": True})
+        assert comm_mod.comms_logger.enabled
+        assert eng.throughput_timer.steps_per_output == 1  # per-step breakdown
+    finally:
+        comm_mod.comms_logger.configure(enabled=was_enabled)
