@@ -559,6 +559,47 @@ def bench_train_fpdt_long_context(peak_flops):
     }
 
 
+def bench_train_fpdt_131k(peak_flops):
+    """FPDT at 131072 tokens on ONE chip (stretch evidence for the
+    reference's 16x-longer-sequences claim; fpdt_layer.py trains 2M tokens on
+    four 40G GPUs with host offload — 131k on a single 16G v5e is the same
+    regime). HBM math: 12 checkpointed [131k, 768] bf16 residuals ~2.4 GiB +
+    fp32 Adam for 125M params ~1.5 GiB + per-chunk score state ~0.2 GiB."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    seq = 131072
+    cfg = TransformerConfig(
+        vocab_size=32000, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, max_seq_len=seq,
+        norm="rmsnorm", activation="silu_glu", position="rope",
+        attn_impl="fpdt", fpdt_q_chunk=2048, fpdt_kv_chunk=2048,
+        remat=True, dtype=jax.numpy.bfloat16, scan_layers=True, fused_ce=True,
+    )
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+        },
+    )
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (1, seq), dtype=np.int32)}
+    tok_per_sec = _train_tokens_per_sec(engine, batch, steps=2, warmup=1)
+    return {
+        "seq_len": seq,
+        "attn_impl": "fpdt",
+        "tokens_per_sec_per_chip": round(tok_per_sec, 1),
+        "mfu": round(tok_per_sec * cfg.flops_per_token(seq) / peak_flops, 4),
+    }
+
+
 # Confidence-ordered registry (safest first): a relay wedge mid-queue loses
 # everything after it, so known-good shapes go first and the big/novel
 # configs last. Each entry: name -> (fn(peak_flops)->dict, timeout_s).
@@ -642,47 +683,6 @@ def _run_isolated(name: str, timeout_s: float):
     # strings are the primary evidence for what went wrong
     tail = " | ".join((err or "").strip().splitlines()[-4:])[-600:]
     return None, f"exit code {proc.returncode}: {tail or 'no JSON on stdout'}"
-
-
-def bench_train_fpdt_131k(peak_flops):
-    """FPDT at 131072 tokens on ONE chip (stretch evidence for the
-    reference's 16x-longer-sequences claim; fpdt_layer.py trains 2M tokens on
-    four 40G GPUs with host offload — 131k on a single 16G v5e is the same
-    regime). HBM math: 12 checkpointed [131k, 768] bf16 residuals ~2.4 GiB +
-    fp32 Adam for 125M params ~1.5 GiB + per-chunk score state ~0.2 GiB."""
-    import jax
-    import numpy as np
-
-    import deepspeed_tpu
-    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
-
-    seq = 131072
-    cfg = TransformerConfig(
-        vocab_size=32000, hidden_size=768, intermediate_size=3072,
-        num_layers=12, num_heads=12, max_seq_len=seq,
-        norm="rmsnorm", activation="silu_glu", position="rope",
-        attn_impl="fpdt", fpdt_q_chunk=2048, fpdt_kv_chunk=2048,
-        remat=True, dtype=jax.numpy.bfloat16, scan_layers=True, fused_ce=True,
-    )
-    engine, *_ = deepspeed_tpu.initialize(
-        model=causal_lm_spec(cfg, example_seq_len=seq),
-        config={
-            "train_micro_batch_size_per_gpu": 1,
-            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-            "zero_optimization": {"stage": 1},
-            "bf16": {"enabled": True},
-            "steps_per_print": 10_000,
-        },
-    )
-    rng = np.random.default_rng(0)
-    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (1, seq), dtype=np.int32)}
-    tok_per_sec = _train_tokens_per_sec(engine, batch, steps=2, warmup=1)
-    return {
-        "seq_len": seq,
-        "attn_impl": "fpdt",
-        "tokens_per_sec_per_chip": round(tok_per_sec, 1),
-        "mfu": round(tok_per_sec * cfg.flops_per_token(seq) / peak_flops, 4),
-    }
 
 
 def _probe_tpu(timeout_s: float = 180.0) -> bool:
