@@ -45,6 +45,33 @@ def build_hf_engine(*args, **kwargs):
     return _build(*args, **kwargs)
 
 
+def init_distributed(*args, **kwargs):
+    """Initialize the multi-host runtime (reference ``deepspeed.init_distributed``
+    ``comm/comm.py:636``; here jax.distributed rendezvous — no-op single-host)."""
+    from deepspeed_tpu.comm.comm import init_distributed as _initd
+
+    return _initd(*args, **kwargs)
+
+
+def add_config_arguments(parser):
+    """Add the standard CLI arguments (reference ``deepspeed.add_config_arguments``
+    ``__init__.py:268``): ``--deepspeed`` enable flag + ``--deepspeed_config``
+    json path, consumable by ``initialize(args=...)``."""
+    group = parser.add_argument_group("DeepSpeed", "deepspeed_tpu configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="enable deepspeed_tpu (helper flag for user code)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="deepspeed_tpu json configuration file")
+    return parser
+
+
+def default_inference_config():
+    """Default inference config dict (reference ``default_inference_config``)."""
+    from deepspeed_tpu.inference.config import InferenceConfig
+
+    return InferenceConfig().model_dump()
+
+
 def tp_model_init(*args, **kwargs):
     """Shard an HF-style param pytree over tp (reference ``deepspeed.tp_model_init``
     __init__.py:369; AutoTP rule inference in ``parallel/autotp.py``)."""

@@ -311,3 +311,18 @@ def test_see_memory_usage_and_breakdown_knob(monkeypatch):
                 "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
                 "steps_per_print": 1000})
     assert ("engine state initialized", True) in calls
+
+
+def test_top_level_api_conveniences():
+    """Reference deepspeed.__init__ surface: add_config_arguments,
+    default_inference_config, init_distributed re-export (round 5)."""
+    import argparse
+
+    import deepspeed_tpu
+
+    p = deepspeed_tpu.add_config_arguments(argparse.ArgumentParser())
+    a = p.parse_args(["--deepspeed", "--deepspeed_config", "/tmp/x.json"])
+    assert a.deepspeed and a.deepspeed_config == "/tmp/x.json"
+    d = deepspeed_tpu.default_inference_config()
+    assert isinstance(d, dict) and "dtype" in d
+    assert callable(deepspeed_tpu.init_distributed)
