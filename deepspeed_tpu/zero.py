@@ -1,0 +1,59 @@
+"""``deepspeed_tpu.zero`` — the user-facing ZeRO namespace (reference
+``deepspeed.zero``: ``Init``, ``GatheredParameters``).
+
+TPU-native mapping:
+
+- ``zero.Init``: in the reference, wrapping model construction shards
+  parameters as they are created so a model larger than one device's memory
+  can materialize (``runtime/zero/partition_parameters.py:Init``). Here
+  sharded construction is ALWAYS on — ``initialize`` traces the init function
+  and materializes leaves directly into their target shardings under jit
+  (``tests/unit/runtime/test_sharded_init.py``) — so ``Init`` is an
+  API-compat context that simply yields; the semantics it exists for are the
+  system default.
+- ``zero.GatheredParameters``: the reference gathers partitioned torch
+  params into full tensors inside the context and re-partitions on exit.
+  The functional analog yields a MUTABLE dict of full numpy arrays
+  (gathered across shards) and writes every leaf back to the engine's
+  (sharded, possibly host-resident) masters on exit — the init-time weight
+  surgery use case. Read-only access is cheaper via
+  ``utils.safe_get_full_fp32_param``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Iterator, Optional
+
+
+@contextlib.contextmanager
+def Init(*args: Any, **kwargs: Any) -> Iterator[None]:  # noqa: N802 - reference name
+    """API-compat construction context (see module docstring): sharded
+    construction is the default under ``initialize``; nothing to toggle."""
+    yield
+
+
+@contextlib.contextmanager
+def GatheredParameters(engine: Any, modifier_rank: Optional[int] = None,
+                       fwd_module: Any = None) -> Iterator[dict]:
+    """Yield the engine's full fp32 master params as nested numpy dicts;
+    write them back (re-sharded / re-placed) on exit.
+
+    ``modifier_rank``/``fwd_module`` accepted for reference signature parity
+    (single-controller JAX has no per-rank modifier distinction).
+    """
+    import jax
+    import numpy as np
+
+    # np.array copy: device_get returns read-only views; the context's whole
+    # point is in-place mutation
+    full = jax.tree_util.tree_map(lambda x: np.array(jax.device_get(x)),
+                                  engine.state.params)
+    yield full
+    placed = jax.tree_util.tree_map(
+        lambda v, old: jax.device_put(np.asarray(v, dtype=old.dtype), old.sharding),
+        full, engine.state.params)
+    engine.state = engine.state._replace(params=placed)
+    # bf16 compute copies derive from the masters: invalidate any cache
+    if getattr(engine, "offload_mode", None) in ("host-jit", "nvme"):
+        engine._compute_dev = None

@@ -73,3 +73,33 @@ def test_universal_checkpoint_streams_atoms(tmp_path, devices):
     l2 = float(e2.train_batch(batch)["loss"])
     l1b = float(e1.train_batch(batch)["loss"])
     np.testing.assert_allclose(l2, l1b, rtol=1e-4)
+
+
+def test_zero_namespace_gathered_parameters(devices):
+    """deepspeed_tpu.zero.GatheredParameters (reference deepspeed.zero):
+    gathered full params are mutable inside the context and the mutation
+    lands back in the sharded masters — and the next step consumes it."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu import zero
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+    from deepspeed_tpu.utils import safe_get_full_fp32_param
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=16, intermediate_size=32,
+                            num_layers=1, num_heads=2, max_seq_len=16)
+    with zero.Init():  # API-compat context
+        spec = causal_lm_spec(cfg, example_seq_len=16)
+    eng, *_ = deepspeed_tpu.initialize(
+        model=spec,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3}, "mesh": {"fsdp": 8},
+                "steps_per_print": 1000})
+    with zero.GatheredParameters(eng) as params:
+        assert isinstance(params["embed"]["embedding"], np.ndarray)
+        params["embed"]["embedding"][:] = 0.125
+    got = safe_get_full_fp32_param(eng, "embed/embedding")
+    np.testing.assert_allclose(got, 0.125)
+    m = eng.train_batch({"input_ids": np.zeros((1, 16), np.int32)})
+    assert np.isfinite(float(m["loss"]))
