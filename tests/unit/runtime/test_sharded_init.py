@@ -101,5 +101,5 @@ def test_zero_namespace_gathered_parameters(devices):
         params["embed"]["embedding"][:] = 0.125
     got = safe_get_full_fp32_param(eng, "embed/embedding")
     np.testing.assert_allclose(got, 0.125)
-    m = eng.train_batch({"input_ids": np.zeros((1, 16), np.int32)})
+    m = eng.train_batch({"input_ids": np.zeros((eng.train_batch_size, 16), np.int32)})
     assert np.isfinite(float(m["loss"]))
