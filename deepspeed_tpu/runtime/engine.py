@@ -91,6 +91,17 @@ class DeepSpeedTPUEngine:
         self.zero_config = self.config.zero_config
         self.compute_dtype = self.config.compute_dtype
         self.fp16 = self.config.fp16_enabled
+        # Gradient-accumulation dtype (reference bf16_optimizer grad-accum
+        # dtype knob): bf16.accumulate_grads_in_fp32=false carries the
+        # micro-step accumulator in bf16 — half the grad-buffer HBM during
+        # the scan; the optimizer math still runs fp32 (_update_math upcasts
+        # at the accumulation boundary). fp16 keeps fp32 accumulation
+        # (overflow detection semantics).
+        bf16_cfg = self.config.model.bf16
+        self._accum_dtype = (
+            jnp.bfloat16
+            if bf16_cfg.enabled and not bf16_cfg.accumulate_grads_in_fp32
+            else jnp.float32)
         seed = seed if seed is not None else self.config.model.seed
         self._configure_offload()
 
@@ -940,6 +951,9 @@ class DeepSpeedTPUEngine:
         zpp_fn = self._build_zpp_micro_fn(*self._zpp) if self._zpp else None
         zpp_loco = self._zpp[3] if self._zpp else None
         ob_fn = self._build_onebit_fn(self._onebit) if self._onebit else None
+        # ZeRO++ micro-grads come back fp32 from the quantized collectives —
+        # a bf16 carry would flip dtypes mid-scan
+        accum_dtype = jnp.float32 if zpp_fn is not None else self._accum_dtype
 
         def train_step(state: TrainState, batch):
             rng = jax.random.wrap_key_data(state.rng)
@@ -993,14 +1007,14 @@ class DeepSpeedTPUEngine:
                     )
                 else:
                     (_, loss), grads = grad_fn(compute_params, micro_batch, jax.random.fold_in(step_rng, i))
-                    grads = cast_floating(grads, jnp.float32)
-                acc = jax.tree_util.tree_map(lambda a, g: a + g, acc, grads)
+                    grads = cast_floating(grads, accum_dtype)
+                acc = jax.tree_util.tree_map(lambda a, g: (a + g).astype(accum_dtype), acc, grads)
                 # shard the accumulator (stage>=2 => reduce-scatter per micro-batch)
                 acc = jax.lax.with_sharding_constraint(acc, grad_pspecs)
                 return (acc, i + 1), loss
 
             zero_grads = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+                lambda p: jnp.zeros(p.shape, accum_dtype), state.params
             )
             zero_grads = jax.lax.with_sharding_constraint(zero_grads, grad_pspecs)
 
@@ -1073,6 +1087,9 @@ class DeepSpeedTPUEngine:
         dynamic = self.fp16 and fp16_cfg.dynamic
         scale = state.loss_scale.loss_scale
 
+        # bf16-accumulated grads upcast here, at the accumulation boundary:
+        # norm/clip/optimizer math is always fp32 (no-op for fp32 grads)
+        grads = cast_floating(grads, jnp.float32)
         if not grads_are_unscaled:
             inv = 1.0 / (gas * scale)
             grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
@@ -1134,6 +1151,9 @@ class DeepSpeedTPUEngine:
         (reference ``zero/stage3.py:2082`` optimizer-swap step boundary)."""
         gas = self.config.gradient_accumulation_steps
         grad_pspecs = self.grad_sharding
+        # Twin-Flow's stats/partition programs assume fp32 grads; plain
+        # offload honors the bf16-accumulation knob (upcast in _update_math)
+        accum_dtype = jnp.float32 if self._twin_ratio is not None else self._accum_dtype
 
         def grad_step(compute_params, batch, scale, step_rng):
             step_rng = jax.random.wrap_key_data(step_rng)
@@ -1147,13 +1167,13 @@ class DeepSpeedTPUEngine:
             def micro_step(carry, micro_batch):
                 acc, i = carry
                 (_, loss), grads = grad_fn(compute_params, micro_batch, jax.random.fold_in(step_rng, i))
-                grads = cast_floating(grads, jnp.float32)
-                acc = jax.tree_util.tree_map(lambda a, g: a + g, acc, grads)
+                grads = cast_floating(grads, accum_dtype)
+                acc = jax.tree_util.tree_map(lambda a, g: (a + g).astype(accum_dtype), acc, grads)
                 acc = jax.lax.with_sharding_constraint(acc, grad_pspecs)
                 return (acc, i + 1), loss
 
             zero_grads = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), compute_params
+                lambda p: jnp.zeros(p.shape, accum_dtype), compute_params
             )
             zero_grads = jax.lax.with_sharding_constraint(zero_grads, grad_pspecs)
             if gas == 1:
@@ -1575,7 +1595,11 @@ class DeepSpeedTPUEngine:
                         return loss.astype(jnp.float32) * scale, loss
 
                     (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params, micro, rng)
-                    grads = jax.lax.with_sharding_constraint(cast_floating(grads, jnp.float32), grad_pspecs)
+                    # same dtype rule as the compiled steps (Twin-Flow stays fp32)
+                    acc_dt = (jnp.float32 if self._twin_ratio is not None
+                              else self._accum_dtype)
+                    grads = jax.lax.with_sharding_constraint(
+                        cast_floating(grads, acc_dt), grad_pspecs)
                     return loss, grads
 
             if offload_split:

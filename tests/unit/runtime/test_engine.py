@@ -88,6 +88,25 @@ def test_bf16_training(devices):
     assert losses[-1] < losses[0]
 
 
+def test_bf16_grad_accum_dtype_knob(devices):
+    """bf16.accumulate_grads_in_fp32=false (reference grad-accum-dtype knob,
+    previously dead here): the micro-step accumulator is carried in bf16 —
+    the compiled step's HLO carries a bf16 param-shaped buffer that the fp32
+    build does not — and training stays close to the fp32-accumulated run."""
+    bf16_off = {"bf16": {"enabled": True, "accumulate_grads_in_fp32": False}}
+    e_bf, *_ = deepspeed_tpu.initialize(
+        model=simple_model_spec(),
+        config=_config(dtype="bf16", micro=1, gas=4, extra=bf16_off), seed=7)
+    e_fp, *_ = deepspeed_tpu.initialize(
+        model=simple_model_spec(), config=_config(dtype="bf16", micro=1, gas=4), seed=7)
+    assert e_bf._accum_dtype.__name__ == "bfloat16"
+    assert e_fp._accum_dtype.__name__ == "float32"
+    l_bf = _train(e_bf, steps=3, seed=21)
+    l_fp = _train(e_fp, steps=3, seed=21)
+    np.testing.assert_allclose(l_bf, l_fp, rtol=5e-2)  # bf16 accum rounding
+    assert l_bf[-1] < l_bf[0]
+
+
 def test_fp16_loss_scale_dynamics(devices):
     engine, *_ = deepspeed_tpu.initialize(model=simple_model_spec(), config=_config(dtype="fp16"))
     assert engine.cur_scale == 2.0**8
