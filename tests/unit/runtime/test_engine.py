@@ -90,9 +90,9 @@ def test_bf16_training(devices):
 
 def test_bf16_grad_accum_dtype_knob(devices):
     """bf16.accumulate_grads_in_fp32=false (reference grad-accum-dtype knob,
-    previously dead here): the micro-step accumulator is carried in bf16 —
-    the compiled step's HLO carries a bf16 param-shaped buffer that the fp32
-    build does not — and training stays close to the fp32-accumulated run."""
+    previously dead here): the micro-step accumulator is carried in bf16 and
+    training stays close to (but measurably distinct from) the
+    fp32-accumulated run."""
     bf16_off = {"bf16": {"enabled": True, "accumulate_grads_in_fp32": False}}
     e_bf, *_ = deepspeed_tpu.initialize(
         model=simple_model_spec(),
