@@ -208,8 +208,12 @@ def bench_train_moe(peak_flops):
 
 
 def _bench_train_dense(peak_flops, *, hidden, inter, layers, heads, kv_heads,
-                       seq, micro, zero, steps=4, warmup=2):
-    """Shared harness for the >=1B dense configs (round-3 verdict item 2)."""
+                       seq, micro, zero, steps=4, warmup=2, bf16_accum=False):
+    """Shared harness for the >=1B dense configs (round-3 verdict item 2).
+
+    bf16_accum: carry the grad accumulator in bf16 — for the offload configs
+    this HALVES the D2H gradient transfer, the dominant offload cost (the
+    reference's CPU optimizer likewise receives 16-bit gradients)."""
     import jax
     import numpy as np
 
@@ -222,13 +226,16 @@ def _bench_train_dense(peak_flops, *, hidden, inter, layers, heads, kv_heads,
         max_seq_len=seq, norm="rmsnorm", activation="silu_glu", position="rope",
         remat=True, dtype=jax.numpy.bfloat16, scan_layers=False, fused_ce=True,
     )
+    bf16_section = {"enabled": True}
+    if bf16_accum:
+        bf16_section["accumulate_grads_in_fp32"] = False
     engine, *_ = deepspeed_tpu.initialize(
         model=causal_lm_spec(cfg, example_seq_len=seq),
         config={
             "train_micro_batch_size_per_gpu": micro,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
             "zero_optimization": zero or {"stage": 3},
-            "bf16": {"enabled": True},
+            "bf16": bf16_section,
             "gradient_clipping": 1.0,
             "steps_per_print": 10_000,
         },
@@ -264,7 +271,7 @@ def bench_train_dense_2b_offload(peak_flops):
     First on-chip evidence for the offload path (round-3 verdict weak item 2)."""
     return _bench_train_dense(
         peak_flops, hidden=2560, inter=10240, layers=18, heads=20, kv_heads=10,
-        seq=2048, micro=1, steps=3, warmup=1,
+        seq=2048, micro=1, steps=3, warmup=1, bf16_accum=True,
         zero={"stage": 3, "offload_optimizer": {"device": "cpu"}})
 
 
@@ -277,7 +284,7 @@ def bench_train_dense_2b_twinflow(peak_flops):
     ~6 GiB + remat activations."""
     return _bench_train_dense(
         peak_flops, hidden=2560, inter=10240, layers=18, heads=20, kv_heads=10,
-        seq=2048, micro=1, steps=3, warmup=1,
+        seq=2048, micro=1, steps=3, warmup=1, bf16_accum=True,
         zero={"stage": 3, "offload_optimizer": {"device": "cpu", "ratio": 0.75}})
 
 
@@ -329,7 +336,8 @@ def bench_train_nvme_offload(peak_flops):
             peak_flops, hidden=1536, inter=6144, layers=14, heads=16, kv_heads=8,
             seq=2048, micro=1, steps=3, warmup=1,
             zero={"stage": 3,
-                  "offload_optimizer": {"device": "nvme", "nvme_path": folder}})
+                  "offload_optimizer": {"device": "nvme", "nvme_path": folder}},
+            bf16_accum=True)
         from deepspeed_tpu.nvme.perf import run_io_benchmark
 
         io = run_io_benchmark(folder, size_mb=256, num_threads=4)

@@ -115,6 +115,25 @@ def test_twin_flow_fp16_dynamic_scale_matches_fused():
     assert int(jax.device_get(twin.state.step)) == int(jax.device_get(base.state.step))
 
 
+def test_offload_bf16_grad_transfer_close_to_fp32():
+    """bf16 grad accumulation x CPU offload: grads cross to the host in bf16
+    (half the D2H bytes — what the offload bench configs use) and the
+    trajectory stays close to the fp32-accumulated offload run (nightly)."""
+    import jax.numpy as jnp
+
+    def run(accum_fp32):
+        cfg = _cfg({"offload_optimizer": {"device": "cpu"}})
+        cfg["bf16"] = {"enabled": True, "accumulate_grads_in_fp32": accum_fp32}
+        cfg["gradient_accumulation_steps"] = 2
+        eng, *_ = deepspeed_tpu.initialize(model=_model(), config=cfg, seed=4)
+        return eng, _run_steps(eng, 3)
+
+    e_bf, l_bf = run(False)
+    _, l_fp = run(True)
+    assert e_bf._accum_dtype is jnp.bfloat16
+    np.testing.assert_allclose(l_bf, l_fp, rtol=5e-2)
+
+
 def test_twin_flow_ratio_rejected_with_nvme(tmp_path):
     with pytest.raises(ValueError, match="Twin-Flow"):
         deepspeed_tpu.initialize(
