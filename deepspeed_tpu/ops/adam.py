@@ -1,0 +1,37 @@
+"""Importable optimizer constructors (reference ``deepspeed.ops.adam``:
+``FusedAdam`` ``ops/adam/fused_adam.py:18``, ``DeepSpeedCPUAdam``
+``ops/adam/cpu_adam.py``; lamb analogs in ``ops/lamb``).
+
+Reference users pass these class instances to ``deepspeed.initialize``;
+here each is a thin factory returning the corresponding optax
+``GradientTransformation`` (the engine accepts it via ``optimizer=``).
+"Fused" is literal on TPU — the transformation is traced into the ONE
+compiled train step; "CPU" placement is decided by
+``zero_optimization.offload_optimizer``, exactly as the reference decides
+it by which class you pick — so both spellings build the same math and the
+config picks the backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from deepspeed_tpu.runtime.optimizers import get_optimizer
+
+
+def _factory(name: str):
+    def build(params: Any = None, lr: float = 1e-3, **kwargs) -> Any:
+        kwargs = dict(kwargs)
+        kwargs.pop("model_params", None)  # reference positional-compat
+        tx, _ = get_optimizer(name, {"lr": lr, **kwargs})
+        return tx
+
+    build.__name__ = name
+    return build
+
+
+FusedAdam = _factory("adam")
+DeepSpeedCPUAdam = _factory("adamw")  # reference CPUAdam defaults adamw_mode=True
+FusedLamb = _factory("lamb")
+OnebitAdam = _factory("onebitadam")
+OnebitLamb = _factory("onebitlamb")
