@@ -55,6 +55,84 @@ GPT2_HEADLINE_DIMS = dict(
 )
 
 
+def _telemetry_enabled() -> bool:
+    """Telemetry opt-in for bench runs (DSTPU_TELEMETRY=1). Default OFF so
+    the headline timed loop carries zero instrumentation overhead. The
+    truthy-spelling parse lives in ONE place: telemetry.env_enabled."""
+    from deepspeed_tpu import telemetry
+
+    return telemetry.env_enabled()
+
+
+def _telemetry_section(engine, batch, steps=5):
+    """5-step instrumented run + trace export.
+
+    The phase breakdown comes from the telemetry registry — the SAME numbers
+    the engine's spans recorded, not a second ad-hoc timing pass (single
+    source of truth). The loop uses the reference-style
+    forward/backward/step API so the trace holds real fwd/bwd/step spans
+    (train_batch's fused program has no separable phases); a tiny facade
+    all_reduce probe guarantees at least one comm collective span with
+    payload-bytes metadata even on a single-chip mesh."""
+    import os
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu import telemetry
+
+    tr = telemetry.get_tracer()
+    tr.configure(enabled=True)
+    # drop spans/counters from the timed headline loop: the section's
+    # breakdown must describe exactly this 5-step run (the fused-dispatch
+    # 'step' spans recorded by train_batch would otherwise blend with the
+    # optimizer-only parity 'step' spans below into a meaningless mix)
+    tr.reset()
+
+    # comm probe: one facade collective over all local devices (ds_bench's
+    # smallest sibling) — records op/axis/dtype/bytes/world tags at trace time
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import deepspeed_tpu.comm as dist
+
+    # jax.shard_map is the function on new jax, a MODULE holding it on some
+    # versions, and absent (experimental only) on older ones — same guarded
+    # resolution as tests/unit/test_telemetry.py
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is not None and not callable(shard_map):
+        shard_map = shard_map.shard_map
+    if shard_map is None:
+        from jax.experimental.shard_map import shard_map
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("dp",))
+    probe = shard_map(lambda v: dist.all_reduce(v, "dp"),
+                      mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    np.asarray(jax.jit(probe)(jnp.ones((len(devs), 256), jnp.float32)))
+
+    gas = engine.config.gradient_accumulation_steps
+    micro = {k: np.asarray(v)[: max(1, np.asarray(v).shape[0] // gas)]
+             for k, v in batch.items()}
+    for _ in range(steps):
+        engine.forward(micro)            # "fwd" span (eval forward)
+        for _ in range(gas):
+            engine.backward(batch=micro)  # "bwd" span (fwd+bwd grad program)
+        engine.step()                     # "step" span (optimizer update)
+    engine.flush_monitor()
+
+    out_dir = os.environ.get("DSTPU_TELEMETRY_DIR", "telemetry_out")
+    trace_path = telemetry.export_chrome_trace(os.path.join(out_dir, "bench_trace.json"))
+    jsonl_path = telemetry.export_jsonl(os.path.join(out_dir, "bench_events.jsonl"))
+    comm = {k: v for k, v in tr.registry.counters().items() if k.startswith("comm/")}
+    return {
+        "phases": tr.phase_summary(),
+        "comm": comm,
+        "memory": tr.sample_memory(),
+        "trace": trace_path,
+        "events": jsonl_path,
+    }
+
+
 def _autotune_overrides():
     """Model-level knobs from a committed AUTOTUNE.json (tools/run_autotune.py
     on real hardware — round-3 verdict item 9). Falls back to the PERF.md
@@ -115,16 +193,20 @@ def bench_train_gpt2(on_tpu, peak_flops):
             "bf16": {"enabled": True},
             "gradient_clipping": 1.0,
             "steps_per_print": 10_000,
+            # opt-in (DSTPU_TELEMETRY=1): span tracing through the engine's
+            # config block; disabled (default) the hooks are attribute checks
+            **({"telemetry": {"enabled": True}} if _telemetry_enabled() else {}),
         },
     )
     rng = np.random.default_rng(0)
     batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
     tok_per_sec = _train_tokens_per_sec(engine, batch, steps, warmup)
     mfu = tok_per_sec * cfg.flops_per_token(seq) / peak_flops
+    telem = _telemetry_section(engine, batch) if _telemetry_enabled() else None
     # provenance: a tuned micro changes the workload shape — stamp it so
     # trend tooling never attributes the delta to a code change
     stamp = ({"overrides": overrides, "micro": micro} if on_tpu and autotuned else None)
-    return tok_per_sec, mfu, seq, stamp
+    return tok_per_sec, mfu, seq, stamp, telem
 
 
 def bench_train_llama_z3(peak_flops):
@@ -281,10 +363,16 @@ def bench_train_dense_2b_twinflow(peak_flops):
     as ``dense_2b_offload_host`` but with ratio=0.75 — the hottest 25% of
     master bytes update on-chip in a fused program and skip the host
     round-trip. HBM math: bf16 w+g ~7.8 GiB + 0.5B on-chip fp32 states
-    ~6 GiB + remat activations."""
+    ~6 GiB + remat activations.
+
+    bf16_accum stays False here: the Twin-Flow stats/partition programs
+    require fp32 gradient accumulation (the engine warns and keeps fp32 if
+    asked otherwise), so unlike ``dense_2b_offload_host`` the D2H gradient
+    transfer is NOT halved — Twin-Flow's win is moving less state, not
+    thinner gradients."""
     return _bench_train_dense(
         peak_flops, hidden=2560, inter=10240, layers=18, heads=20, kv_heads=10,
-        seq=2048, micro=1, steps=3, warmup=1, bf16_accum=True,
+        seq=2048, micro=1, steps=3, warmup=1, bf16_accum=False,
         zero={"stage": 3, "offload_optimizer": {"device": "cpu", "ratio": 0.75}})
 
 
@@ -648,9 +736,10 @@ def _child_main(name: str) -> None:
         raise SystemExit(2)
     peak_flops = PEAK_FLOPS_TPU
     if name == "_headline":
-        tok_per_sec, mfu, seq, stamp = bench_train_gpt2(True, peak_flops)
+        tok_per_sec, mfu, seq, stamp, telem = bench_train_gpt2(True, peak_flops)
         out = {"tok_per_sec": tok_per_sec, "mfu": mfu, "seq": seq,
-               "autotuned": stamp}
+               "autotuned": stamp,
+               **({"telemetry": telem} if telem else {})}
     else:
         out = EXTRA_BENCHES[name][0](peak_flops)
     print(json.dumps(out), flush=True)
@@ -758,6 +847,7 @@ def _main_tpu() -> None:
         "unit": "tokens/s/chip",
         "vs_baseline": round(headline["mfu"] / 0.45, 4),
         **({"autotuned": stamp} if stamp else {}),
+        **({"telemetry": headline["telemetry"]} if headline.get("telemetry") else {}),
         "extras": extras,
     }
     print(json.dumps(result))
@@ -819,7 +909,7 @@ def main() -> None:
 
     # The TPU path (with extras) lives in _main_tpu(); reaching here means
     # CPU smoke only.
-    tok_per_sec, mfu, seq, autotuned_stamp = bench_train_gpt2(on_tpu, peak_flops)
+    tok_per_sec, mfu, seq, autotuned_stamp, telem = bench_train_gpt2(on_tpu, peak_flops)
 
     extras = {}
     result = {
@@ -833,6 +923,7 @@ def main() -> None:
         # relay for a 15x regression (round-3 verdict, weak item 1).
         **({"degraded": True} if not on_tpu else {}),
         **({"autotuned": autotuned_stamp} if autotuned_stamp else {}),
+        **({"telemetry": telem} if telem else {}),
         **({"extras": extras} if extras else {}),
     }
     print(json.dumps(result))

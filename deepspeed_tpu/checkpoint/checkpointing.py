@@ -19,6 +19,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 LATEST_FILE = "latest"
@@ -28,10 +29,30 @@ def _tag(step: int) -> str:
     return f"global_step{step}"
 
 
+def _canonical_opt_state(engine, opt_state):
+    """Partitioning-independent opt_state for the checkpoint boundary
+    (Twin-Flow engines merge their masked partition pair; everyone else is
+    identity — see ``engine.canonical_opt_state``)."""
+    canon = getattr(engine, "canonical_opt_state", None)
+    return canon(opt_state) if canon is not None else opt_state
+
+
+def _departition_opt_state(engine, opt_state):
+    canon = getattr(engine, "opt_state_from_canonical", None)
+    return canon(opt_state) if canon is not None else opt_state
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict] = None, save_latest: bool = True,
                     checkpoint_engine=None) -> str:
     tag = tag or _tag(engine.global_steps)
+    with telemetry.span("checkpoint:save", tag=tag):
+        return _save_checkpoint(engine, save_dir, tag, client_state, save_latest,
+                                checkpoint_engine)
+
+
+def _save_checkpoint(engine, save_dir, tag, client_state, save_latest,
+                     checkpoint_engine) -> str:
     path = os.path.abspath(os.path.join(save_dir, tag))
     os.makedirs(save_dir, exist_ok=True)
 
@@ -39,10 +60,20 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     payload = {
         "step": state.step,
         "params": state.params,
-        "opt_state": state.opt_state,
+        "opt_state": _canonical_opt_state(engine, state.opt_state),
         "loss_scale": state.loss_scale._asdict(),
         "rng": state.rng,
     }
+    if getattr(engine, "_twin_ratio", None) is not None:
+        # Twin-Flow leaves live on MIXED placements (host-committed masters +
+        # mesh-sharded device partition). Save host numpy instead: restoring
+        # a host-committed array into a donated mesh-sharded target corrupts
+        # the heap on this jax/orbax stack (observed: glibc double-linked-
+        # list corruption on the second post-restore step), and a checkpoint
+        # should not encode placement anyway. The masters are host-resident
+        # already, so this costs one D2H of the small device partition.
+        payload = jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x)), payload)
     if checkpoint_engine is None:
         checkpoint_engine = getattr(engine, "checkpoint_engine", None)
     if checkpoint_engine is None:
@@ -86,6 +117,13 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
                     checkpoint_engine=None) -> Tuple[Optional[str], Dict]:
+    with telemetry.span("checkpoint:load", tag=tag or "latest"):
+        return _load_checkpoint(engine, load_dir, tag, load_optimizer_states,
+                                checkpoint_engine)
+
+
+def _load_checkpoint(engine, load_dir, tag, load_optimizer_states,
+                     checkpoint_engine) -> Tuple[Optional[str], Dict]:
     if checkpoint_engine is None:
         checkpoint_engine = getattr(engine, "checkpoint_engine", None)
     if checkpoint_engine is not None and getattr(checkpoint_engine, "async_save", False):
@@ -110,7 +148,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     target = {
         "step": state.step,
         "params": state.params,
-        "opt_state": state.opt_state,
+        # canonical (partition-independent) form; re-partitioned below
+        "opt_state": _canonical_opt_state(engine, state.opt_state),
         "loss_scale": state.loss_scale._asdict(),
         "rng": state.rng,
     }
@@ -126,7 +165,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.state = TrainState(
         step=restored["step"],
         params=restored["params"],
-        opt_state=restored["opt_state"] if load_optimizer_states else state.opt_state,
+        opt_state=(_departition_opt_state(engine, restored["opt_state"])
+                   if load_optimizer_states else state.opt_state),
         loss_scale=LossScaleState(**restored["loss_scale"]),
         rng=restored["rng"],
         # error-feedback residuals are per-run scratch (reference reinitializes

@@ -88,7 +88,20 @@ def save_universal(engine, save_dir: str, tag: Optional[str] = None) -> str:
     tag = tag or f"global_step{engine.global_steps}"
     path = os.path.join(save_dir, UNIVERSAL_DIR, tag)
     os.makedirs(path, exist_ok=True)
-    atoms = _fp32_state_tree(engine.state)
+    state = engine.state
+    canon = getattr(engine, "canonical_opt_state", None)
+    if canon is not None:
+        # Twin-Flow masked partitions merge to the param-shaped moment tree:
+        # atom paths must be partitioning-independent (the format's contract)
+        state = state._replace(opt_state=canon(state.opt_state))
+    atoms = _fp32_state_tree(state)
+    if getattr(engine, "_twin_ratio", None) is not None:
+        # mixed host/mesh placements -> host numpy atoms (same rationale as
+        # checkpointing.save_checkpoint: a checkpoint must not encode
+        # placement, and cross-placement orbax restores have bitten us)
+        atoms = jax.tree_util.tree_map(
+            lambda x: None if x is None else np.asarray(jax.device_get(x)),
+            atoms, is_leaf=lambda x: x is None)
     n_atoms = len(jax.tree_util.tree_leaves(atoms))
 
     import orbax.checkpoint as ocp
@@ -135,6 +148,11 @@ def load_universal(engine, load_dir: str, tag: Optional[str] = None,
 
     state_dict = dict(engine.state._asdict())
     comm_error = state_dict.pop("comm_error", None)  # per-run scratch, not saved
+    canon = getattr(engine, "canonical_opt_state", None)
+    if canon is not None:
+        # restore against the canonical (partition-independent) structure;
+        # re-partitioned into the target engine's Twin-Flow split below
+        state_dict["opt_state"] = canon(state_dict["opt_state"])
 
     def widen_dtype(x):
         if x is None:
@@ -164,6 +182,9 @@ def load_universal(engine, load_dir: str, tag: Optional[str] = None,
         narrow, restored, state_dict, is_leaf=lambda x: x is None
     )
     restored["comm_error"] = comm_error  # fresh per-run residuals
+    departition = getattr(engine, "opt_state_from_canonical", None)
+    if departition is not None:
+        restored["opt_state"] = departition(restored["opt_state"])
     engine.state = type(engine.state)(**restored)
     log_dist(f"loaded universal checkpoint {path} (streamed)", ranks=[0])
     return path
@@ -174,6 +195,9 @@ def _load_universal_npz(engine, path: str, npz_file: str, strict: bool) -> str:
     data = np.load(npz_file)
     state_dict = dict(engine.state._asdict())
     comm_error = state_dict.pop("comm_error", None)  # per-run scratch
+    canon = getattr(engine, "canonical_opt_state", None)
+    if canon is not None:
+        state_dict["opt_state"] = canon(state_dict["opt_state"])
     flat_target = _flatten(state_dict)
     missing = [k for k in flat_target if k not in data.files and flat_target[k] is not None]
     # v1 checkpoints written before comm_error became per-run scratch may
@@ -194,6 +218,9 @@ def _load_universal_npz(engine, path: str, npz_file: str, strict: bool) -> str:
 
     restored = jax.tree_util.tree_map_with_path(_restore, state_dict)
     restored["comm_error"] = comm_error
+    departition = getattr(engine, "opt_state_from_canonical", None)
+    if departition is not None:
+        restored["opt_state"] = departition(restored["opt_state"])
     engine.state = type(engine.state)(**restored)
     log_dist(f"loaded universal checkpoint {path}", ranks=[0])
     return path

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -142,6 +143,12 @@ def _axis_size(axis) -> int:
             return int(np.prod([jax.lax.axis_size(a) for a in axis]))
         return int(jax.lax.axis_size(axis))
     except Exception:
+        pass
+    try:
+        # older jax has no lax.axis_size; a unit psum over a bound axis is
+        # statically the axis size at trace time
+        return int(jax.lax.psum(1, axis))
+    except Exception:
         return 1
 
 
@@ -153,8 +160,26 @@ def _nbytes(x) -> int:
 
 
 def _record(op_name: str, axis, x):
+    """Record one collective into the comms logger AND the telemetry
+    subsystem; returns a span context wrapping the ``jax.lax`` call.
+
+    Collectives here are in-program ops, so both records happen at TRACE
+    time: the span duration is host tracing time (one per compiled program,
+    not per execution), while the (op, axis, dtype, bytes, world) tags are
+    the exact per-execution collective workload of the traced step.
+    """
     axis_str = "+".join(axis) if isinstance(axis, (tuple, list)) else str(axis)
-    comms_logger.record(op_name, axis_str, _nbytes(x), _axis_size(axis))
+    nbytes, world = _nbytes(x), _axis_size(axis)
+    comms_logger.record(op_name, axis_str, nbytes, world)
+    tracer = telemetry.get_tracer()
+    if not tracer.enabled:
+        return telemetry.NOOP_SPAN
+    tracer.count("comm/count")
+    tracer.count("comm/bytes", nbytes)
+    tracer.count(f"comm/bytes/{op_name}", nbytes)
+    dtype = str(getattr(x, "dtype", "unknown"))
+    return tracer.span(f"comm:{op_name}", cat="comm", op=op_name, axis=axis_str,
+                       bytes=nbytes, dtype=dtype, world=world)
 
 
 # --------------------------------------------------------------------------
@@ -164,40 +189,40 @@ def _record(op_name: str, axis, x):
 
 def all_reduce(x, axis, op: str = "sum"):
     """psum/pmax/pmin over a named axis (reference ``all_reduce`` ``comm/comm.py``)."""
-    _record(f"all_reduce_{op}", axis, x)
-    if op == "sum":
-        return jax.lax.psum(x, axis)
-    if op == "max":
-        return jax.lax.pmax(x, axis)
-    if op == "min":
-        return jax.lax.pmin(x, axis)
-    if op in ("mean", "avg"):
-        return jax.lax.pmean(x, axis)
-    raise ValueError(f"unsupported reduce op {op!r}")
+    with _record(f"all_reduce_{op}", axis, x):
+        if op == "sum":
+            return jax.lax.psum(x, axis)
+        if op == "max":
+            return jax.lax.pmax(x, axis)
+        if op == "min":
+            return jax.lax.pmin(x, axis)
+        if op in ("mean", "avg"):
+            return jax.lax.pmean(x, axis)
+        raise ValueError(f"unsupported reduce op {op!r}")
 
 
 def all_gather(x, axis, *, concat_axis: int = 0, tiled: bool = True):
     """all_gather over a named axis (reference ``all_gather_into_tensor``)."""
-    _record("all_gather", axis, x)
-    return jax.lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
+    with _record("all_gather", axis, x):
+        return jax.lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis, *, scatter_axis: int = 0, tiled: bool = True):
     """psum_scatter (reference ``reduce_scatter_tensor``)."""
-    _record("reduce_scatter", axis, x)
-    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=tiled)
+    with _record("reduce_scatter", axis, x):
+        return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=tiled)
 
 
 def all_to_all(x, axis, *, split_axis: int, concat_axis: int, tiled: bool = True):
     """all_to_all (reference ``all_to_all_single``; backbone of Ulysses + MoE)."""
-    _record("all_to_all", axis, x)
-    return jax.lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
+    with _record("all_to_all", axis, x):
+        return jax.lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
 
 
 def ppermute(x, axis, perm):
     """collective_permute (reference p2p ``send``/``recv``, ``pipe/p2p.py``)."""
-    _record("ppermute", axis, x)
-    return jax.lax.ppermute(x, axis, perm)
+    with _record("ppermute", axis, x):
+        return jax.lax.ppermute(x, axis, perm)
 
 
 def broadcast(x, axis, root: int = 0):
@@ -206,9 +231,9 @@ def broadcast(x, axis, root: int = 0):
     In-program equivalent of reference ``broadcast`` (``comm/comm.py``): select
     the root slice post-all_gather; XLA lowers this to a broadcast.
     """
-    _record("broadcast", axis, x)
-    gathered = jax.lax.all_gather(x, axis, axis=0)
-    return gathered[root]
+    with _record("broadcast", axis, x):
+        gathered = jax.lax.all_gather(x, axis, axis=0)
+        return gathered[root]
 
 
 # --------------------------------------------------------------------------

@@ -197,6 +197,29 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     output_file: Optional[str] = None
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """telemetry section — the unified observability substrate
+    (``deepspeed_tpu/telemetry``): span tracer + metrics registry + trace
+    exporters. TPU-native; the closest reference analog is the union of
+    ``wall_clock_breakdown``, the comms logger, and the monitor scalars,
+    sharing one registry here. Zero overhead when disabled (the default)."""
+
+    enabled: bool = False
+    # Drain the device queue at span boundaries so spans measure true device
+    # time instead of async dispatch. Serializes the dispatch pipeline — for
+    # diagnosis runs, not production steps.
+    sync_spans: bool = False
+    # Bounded in-memory event buffer; overflow counts dropped_events.
+    max_events: int = 100_000
+    # Chrome trace-event JSON (open at https://ui.perfetto.dev), written at
+    # monitor flushes and by explicit telemetry.export_chrome_trace() calls.
+    trace_path: Optional[str] = None
+    # Structured event log, one JSON object per line.
+    jsonl_path: Optional[str] = None
+    # Per-step device-memory gauges (PJRT memory_stats / jax.live_arrays).
+    memory_watermarks: bool = True
+
+
 class CommsLoggerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     verbose: bool = False
@@ -258,6 +281,7 @@ class EngineConfig(DeepSpeedConfigModel):
     wandb: WandbConfig = Field(default_factory=WandbConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
     gradient_compression: GradientCompressionConfig = Field(default_factory=GradientCompressionConfig)

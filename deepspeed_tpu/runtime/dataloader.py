@@ -14,6 +14,8 @@ from typing import Any, Iterator, Optional, Sequence
 
 import numpy as np
 
+from deepspeed_tpu import telemetry
+
 
 class RepeatingLoader:
     """Wrap an iterable to restart on StopIteration (reference :41)."""
@@ -90,6 +92,10 @@ class DeepSpeedTPUDataLoader:
         return self.num_batches
 
     def _materialize(self, idx) -> Any:
+        with telemetry.span("data:materialize", cat="data", batch_size=len(idx)):
+            return self._materialize_inner(idx)
+
+    def _materialize_inner(self, idx) -> Any:
         if self._arrays is not None:
             if isinstance(self._arrays, dict):
                 return {k: v[idx] for k, v in self._arrays.items()}

@@ -215,6 +215,21 @@ class DeepSpeedTPUEngine:
 
             cl = self.config.model.comms_logger
             comm_mod.configure(enabled=True, verbose=cl.verbose, debug=cl.debug)
+        # Telemetry (telemetry/): the config block configures the process-
+        # global tracer; the engine keeps a direct handle for its hot-path
+        # spans. When the block is absent the env var (DSTPU_TELEMETRY=1) may
+        # still have enabled the tracer — every span call is a single
+        # attribute check when it hasn't.
+        from deepspeed_tpu import telemetry as telemetry_mod
+
+        tcfg = self.config.model.telemetry
+        if tcfg.enabled:
+            telemetry_mod.configure(
+                enabled=True, sync_spans=tcfg.sync_spans,
+                max_events=tcfg.max_events,
+                memory_watermarks=tcfg.memory_watermarks,
+                trace_path=tcfg.trace_path, jsonl_path=tcfg.jsonl_path)
+        self._tracer = telemetry_mod.get_tracer()
         if self.config.model.dump_state:
             # reference engine.py dump_state: print the resolved config once
             log_dist(f"engine config: {self.config.model.model_dump()}", ranks=[0])
@@ -358,6 +373,18 @@ class DeepSpeedTPUEngine:
                     "device bf16 copy every step, which the partial path keeps "
                     "resident — use ratio=1.0 with offload_param")
             self._twin_ratio = ratio
+            if self._accum_dtype == jnp.bfloat16:
+                # A silently-dead knob is worse than a warning (the
+                # prescale_gradients stance): Twin-Flow's stats/partition
+                # programs require fp32 gradients, so the bf16-accumulation
+                # request cannot be honored on this path.
+                logger.warning(
+                    "bf16.accumulate_grads_in_fp32=false is ignored with "
+                    f"Twin-Flow partial offload (offload_optimizer.ratio={ratio}): "
+                    "the split stats/partition programs accumulate gradients in "
+                    "fp32 — the host gradient transfer is NOT halved. Drop the "
+                    "knob, or use ratio=1.0 (full offload) to keep bf16 "
+                    "accumulation.")
         log_dist(
             f"ZeRO-Offload enabled: mode={self.offload_mode} device={dev}"
             + (f" twin_flow_ratio={ratio}" if self._twin_ratio is not None else ""),
@@ -1360,10 +1387,14 @@ class DeepSpeedTPUEngine:
         step_rng = jax.random.split(jax.random.wrap_key_data(state.rng))[1]
         self._materialize_compute_dev()
         scale = self._dev_replicated(jnp.float32(jax.device_get(state.loss_scale.loss_scale)))
-        grads, losses = self._offload_grad_step(
-            self._compute_dev, placed, scale, self._dev_replicated(jax.random.key_data(step_rng))
-        )
-        metrics = dict(self._offload_apply_update(state, grads))
+        # the split step HAS separable phases: device grad program vs host
+        # optimizer update — the telemetry spans reflect that
+        with self._tracer.span("fwd_bwd", offload=True):
+            grads, losses = self._offload_grad_step(
+                self._compute_dev, placed, scale, self._dev_replicated(jax.random.key_data(step_rng))
+            )
+        with self._tracer.span("step", offload=True):
+            metrics = dict(self._offload_apply_update(state, grads))
         metrics["loss"] = jnp.mean(losses.astype(jnp.float32))
         return metrics
 
@@ -1387,6 +1418,63 @@ class DeepSpeedTPUEngine:
         if self.offload_mode == "nvme" and self._opt_on_nvme:
             self.state = self.state._replace(opt_state=self._opt_swapper.swap_in_opt_state(device_put=False))
             self._opt_on_nvme = False
+
+    # ------------------------------------- checkpoint-canonical opt_state --
+    def canonical_opt_state(self, opt_state: Any = None) -> Any:
+        """Checkpoint-boundary canonical form of ``opt_state``.
+
+        Twin-Flow stores the optimizer state as a tuple of two
+        ``optax.masked`` partition states whose ``MaskedNode`` hole placement
+        depends on ``offload_optimizer.ratio`` and tree-flatten order — a
+        partitioning artifact that must never leak into checkpoints (the
+        reference's universal format is partitioning-independent fp32 atoms).
+        This merges the two complementary partitions back into the single
+        param-shaped moment tree ``self.tx.init(params)`` would produce, so a
+        checkpoint saved under any ratio restores under any other ratio or
+        into a non-Twin-Flow engine. Identity for non-Twin-Flow engines.
+        """
+        opt_state = self.state.opt_state if opt_state is None else opt_state
+        if self._twin_ratio is None:
+            return opt_state
+        opt_host, opt_dev = opt_state
+        hole = lambda x: isinstance(x, optax.MaskedNode)  # noqa: E731
+        return jax.tree_util.tree_map(
+            lambda h, d: d if isinstance(h, optax.MaskedNode) else h,
+            opt_host.inner_state, opt_dev.inner_state, is_leaf=hole)
+
+    def opt_state_from_canonical(self, canonical: Any) -> Any:
+        """Inverse of ``canonical_opt_state``: re-partition a param-shaped
+        moment tree into this engine's Twin-Flow ``(host, device)`` masked
+        pair (hole placement taken from the live state, so the split follows
+        THIS engine's ratio, not the saving engine's). Identity when
+        Twin-Flow is off."""
+        if self._twin_ratio is None:
+            return canonical
+        from jax.sharding import SingleDeviceSharding
+
+        host_sh = SingleDeviceSharding(self._host_device)
+        hole = lambda x: isinstance(x, optax.MaskedNode)  # noqa: E731
+
+        def refill(template, host_side):
+            def fill(t, c):
+                if isinstance(t, optax.MaskedNode):
+                    return t
+                # The live partition states come from jit-ing the masked
+                # inits, whose outputs are UNCOMMITTED — the device program
+                # mixes mesh-committed params with them, which only composes
+                # while the moments stay uncommitted. Restored arrays arrive
+                # committed (orbax places them), so rebuild each leaf the way
+                # init placed it: host partition committed to the host
+                # backend, device partition uncommitted on the default device.
+                v = jnp.asarray(np.asarray(jax.device_get(c)))
+                return jax.device_put(v, host_sh) if host_side else v
+
+            inner = jax.tree_util.tree_map(
+                fill, template.inner_state, canonical, is_leaf=hole)
+            return optax.MaskedState(inner)
+
+        opt_host, opt_dev = self.state.opt_state
+        return (refill(opt_host, True), refill(opt_dev, False))
 
     # ------------------------------------------------------------- data path
     def _leaf_batch_sharding(self, x, leading_none: int = 0) -> NamedSharding:
@@ -1440,13 +1528,18 @@ class DeepSpeedTPUEngine:
         iterator yielding micro-batches (leading dim = micro*dp_world), the
         reference ``PipelineEngine.train_batch(data_iter)`` convention.
         """
+        with self._tracer.span("train_batch", step=self._batch_count):
+            return self._train_batch_inner(batch, data_iter)
+
+    def _train_batch_inner(self, batch: Any, data_iter: Optional[Iterator]) -> Dict[str, Any]:
         if (batch is None) == (data_iter is None):
             raise ValueError("provide exactly one of batch= or data_iter=")
         set_mesh(self.mesh)  # models read the active mesh at trace time
-        if batch is not None:
-            placed = self._shard_global_batch(batch)
-        else:
-            placed = self._stack_micro_batches(data_iter)
+        with self._tracer.span("data"):
+            if batch is not None:
+                placed = self._shard_global_batch(batch)
+            else:
+                placed = self._stack_micro_batches(data_iter)
         prof = self.flops_profiler
         fp_cfg = prof.config
         config_fire = (fp_cfg.enabled and prof.result is None
@@ -1474,7 +1567,11 @@ class DeepSpeedTPUEngine:
             prof.print_model_profile(top=fp_cfg.top_modules)
         else:
             self.throughput_timer.start()
-            self.state, metrics = self._train_step(self.state, placed)
+            # the fused program has no separable fwd/bwd/step phases — this
+            # span is the whole optimizer step (dispatch time unless
+            # telemetry.sync_spans drains the device queue)
+            with self._tracer.span("step", fused=True):
+                self.state, metrics = self._train_step(self.state, placed)
             self.throughput_timer.stop()
         # Metrics stay device-side: fetching them here would block the host on
         # the step and break JAX async dispatch (measured 743 ms -> 102 ms per
@@ -1484,11 +1581,16 @@ class DeepSpeedTPUEngine:
         self._batch_count += 1
         step = self._batch_count
         if self.monitor is not None:
-            self._monitor_pending.append((step, {
+            scalars = {
                 "Train/loss": metrics["loss"],
                 "Train/lr": metrics["lr"],
                 **({"Train/loss_scale": metrics["loss_scale"]} if self.fp16 else {}),
-            }))
+            }
+            if self._tracer.enabled:
+                # host-side floats only (counter deltas, memory watermarks,
+                # last phase wall times) — never a device fetch
+                scalars.update(self._tracer.step_scalars())
+            self._monitor_pending.append((step, scalars))
         if step % self.config.model.steps_per_print == 0:
             # periodic sync point: one fetch per steps_per_print batches
             fetched = jax.device_get(metrics)
@@ -1505,13 +1607,17 @@ class DeepSpeedTPUEngine:
         return metrics
 
     def flush_monitor(self) -> None:
-        """Write buffered scalars to the monitor (one bulk device fetch)."""
+        """Write buffered scalars to the monitor (one bulk device fetch) and
+        any configured telemetry exports."""
+        if self._tracer.enabled:
+            self._tracer.maybe_export()
         if self.monitor is None or not self._monitor_pending:
             self._monitor_pending = []
             return
-        pending, self._monitor_pending = self._monitor_pending, []
-        for step, scalars in jax.device_get(pending):
-            self.monitor.write_scalars(int(step), {k: float(v) for k, v in scalars.items()})
+        with self._tracer.span("flush_monitor"):
+            pending, self._monitor_pending = self._monitor_pending, []
+            for step, scalars in jax.device_get(pending):
+                self.monitor.write_scalars(int(step), {k: float(v) for k, v in scalars.items()})
 
     def __del__(self):  # pragma: no cover - interpreter teardown ordering
         try:
@@ -1522,6 +1628,10 @@ class DeepSpeedTPUEngine:
     # --- forward / backward / step parity path ----------------------------
     def forward(self, batch: Any) -> Any:
         """Inference/eval forward returning model outputs (loss by default)."""
+        with self._tracer.span("fwd"):
+            return self._forward_inner(batch)
+
+    def _forward_inner(self, batch: Any) -> Any:
         set_mesh(self.mesh)
         offload_split = self._train_step is None
         if self._eval_step is None:
@@ -1554,6 +1664,10 @@ class DeepSpeedTPUEngine:
         recomputes forward+backward for the micro-batch (``batch`` or the one
         passed to the last ``forward``). ``train_batch`` is the efficient path.
         """
+        with self._tracer.span("bwd", micro_step=self._micro_steps):
+            return self._backward_inner(loss, batch)
+
+    def _backward_inner(self, loss: Any, batch: Any) -> None:
         if self._onebit:
             raise NotImplementedError(
                 "1-bit compressed gradients are only wired into train_batch "
@@ -1628,6 +1742,10 @@ class DeepSpeedTPUEngine:
         (reference ``engine.step`` :2338 — no-op until gas micro-batches seen)."""
         if self._micro_steps < self.config.gradient_accumulation_steps:
             return {}
+        with self._tracer.span("step"):
+            return self._step_inner()
+
+    def _step_inner(self) -> Dict[str, Any]:
         if self._pending_grads is None:
             raise RuntimeError("step() called with no accumulated gradients")
         if self._train_step is None:  # offload split: update runs on the host

@@ -1,0 +1,58 @@
+"""deepspeed_tpu.telemetry: unified observability substrate.
+
+One process-global ``Tracer`` (nestable wall-clock spans, bounded buffer)
+plus a shared ``MetricsRegistry`` (counters/gauges/histograms) and two
+exporters (Chrome trace-event JSON for Perfetto, JSONL for tooling).
+
+Wired into:
+  - ``runtime/engine.py``   — train_batch/data/step + fwd/bwd/step parity
+    phases, per-step monitor scalars, device-memory watermarks
+  - ``comm/comm.py``        — every facade collective as a trace-time span
+    tagged with op/axis/dtype/payload bytes/participant count, plus
+    ``comm/bytes`` + ``comm/count`` counters
+  - ``checkpoint/``         — save/load spans
+  - ``runtime/dataloader.py`` — batch materialization spans
+
+Enable via the ``telemetry`` config block (see ``config/config.py``) or the
+``DSTPU_TELEMETRY=1`` env var; export dir defaults to ``DSTPU_TELEMETRY_DIR``
+(else ``./telemetry_out``). Disabled (the default) every hook is a single
+attribute check — zero measurable overhead. See ``docs/telemetry.md``.
+"""
+
+from deepspeed_tpu.telemetry.exporters import (
+    chrome_trace_events,
+    export_chrome_trace,
+    export_jsonl,
+)
+from deepspeed_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from deepspeed_tpu.telemetry.tracer import (
+    NOOP_SPAN,
+    Tracer,
+    configure,
+    enabled,
+    env_enabled,
+    get_tracer,
+    span,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NOOP_SPAN",
+    "Tracer",
+    "chrome_trace_events",
+    "configure",
+    "enabled",
+    "env_enabled",
+    "export_chrome_trace",
+    "export_jsonl",
+    "get_tracer",
+    "span",
+]

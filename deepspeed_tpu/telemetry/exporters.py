@@ -1,0 +1,109 @@
+"""Trace exporters: Chrome trace-event JSON (Perfetto) and JSONL.
+
+Chrome trace-event format reference:
+https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU
+— spans are "X" (complete) events with microsecond ``ts``/``dur``; memory
+watermarks are "C" (counter) events which Perfetto renders as plotted tracks.
+Open the output at https://ui.perfetto.dev (or chrome://tracing).
+
+The JSONL exporter writes one structured event per line (the raw tracer event
+schema plus ``pid``), for downstream tooling that wants greppable records
+rather than a viewer format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+def _resolve(tracer) -> Any:
+    if tracer is None:
+        from deepspeed_tpu.telemetry.tracer import get_tracer
+
+        tracer = get_tracer()
+    return tracer
+
+
+def chrome_trace_events(tracer=None) -> Dict[str, Any]:
+    """Tracer buffer -> a Chrome trace-event JSON object (in memory)."""
+    tracer = _resolve(tracer)
+    pid = os.getpid()
+    out: List[Dict[str, Any]] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "args": {"name": "deepspeed_tpu"},
+        }
+    ]
+    for ev in tracer.events():
+        ts_us = ev["ts"] * 1e6
+        if ev["kind"] == "span":
+            rec: Dict[str, Any] = {
+                "name": ev["name"],
+                "cat": ev.get("cat", "span"),
+                "ph": "X",
+                "ts": ts_us,
+                "dur": ev["dur"] * 1e6,
+                "pid": pid,
+                "tid": ev["tid"],
+            }
+            if "args" in ev:
+                rec["args"] = ev["args"]
+        elif ev["kind"] == "instant":
+            rec = {
+                "name": ev["name"],
+                "cat": ev.get("cat", "event"),
+                "ph": "i",
+                "s": "t",
+                "ts": ts_us,
+                "pid": pid,
+                "tid": ev["tid"],
+            }
+            if "args" in ev:
+                rec["args"] = ev["args"]
+        else:  # counter
+            rec = {
+                "name": ev["name"],
+                "ph": "C",
+                "ts": ts_us,
+                "pid": pid,
+                "args": {"value": ev["value"]},
+            }
+        out.append(rec)
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "dropped_events": tracer.dropped_events,
+            "metrics": tracer.registry.snapshot(),
+        },
+    }
+
+
+def export_chrome_trace(path: Optional[str] = None, tracer=None) -> str:
+    """Write the Chrome trace JSON; returns the path written."""
+    tracer = _resolve(tracer)
+    path = path or tracer.trace_path or os.path.join(
+        os.environ.get("DSTPU_TELEMETRY_DIR", "telemetry_out"), "trace.json")
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(chrome_trace_events(tracer), f)
+    return path
+
+
+def export_jsonl(path: Optional[str] = None, tracer=None) -> str:
+    """Write one JSON object per event; returns the path written."""
+    tracer = _resolve(tracer)
+    path = path or tracer.jsonl_path or os.path.join(
+        os.environ.get("DSTPU_TELEMETRY_DIR", "telemetry_out"), "events.jsonl")
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    pid = os.getpid()
+    with open(path, "w") as f:
+        for ev in tracer.events():
+            f.write(json.dumps({"pid": pid, **ev}) + "\n")
+    return path

@@ -1,0 +1,148 @@
+"""Metrics registry: counters, gauges, histograms.
+
+The numeric half of the telemetry subsystem (the ``Tracer`` in ``tracer.py``
+is the temporal half). Closest reference analogs are the scattered aggregates
+in ``utils/comms_logging.py`` (bytes/counts per op) and the monitor scalars —
+here they share ONE registry so the ``MonitorMaster`` backends, ``bench.py``'s
+phase breakdown, and the exporters all read the same numbers.
+
+Thread-safe end to end: creation AND mutation run under the registry's lock
+(spans may close on any thread — the tracer records per-thread ids), so
+concurrent increments never drop. Contention is negligible: updates happen
+per span/collective, not per tensor element.
+
+Creation is get-or-create so call sites never coordinate.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+
+class Counter:
+    """Monotonic accumulator (e.g. ``comm/bytes``)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self.value = 0.0
+        self._lock = lock
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self.value += v
+
+
+class Gauge:
+    """Last-write-wins sample (e.g. ``mem/device_bytes_in_use``)."""
+
+    __slots__ = ("name", "value", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self.value = 0.0
+        self._lock = lock
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = v
+
+
+class Histogram:
+    """Streaming summary (count/total/min/max/last) — enough for phase
+    breakdowns without bucket bookkeeping."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "last", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.last = 0.0
+        self._lock = lock
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.last = v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            if self.count == 0:
+                return {"count": 0, "total": 0.0, "mean": 0.0, "min": 0.0, "max": 0.0}
+            return {
+                "count": self.count,
+                "total": self.total,
+                "mean": self.total / self.count,
+                "min": self.min,
+                "max": self.max,
+            }
+
+
+class MetricsRegistry:
+    """Get-or-create registry of named metrics (one shared lock — see module
+    docstring)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name, self._lock)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name, self._lock)
+            return g
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, self._lock)
+            return h
+
+    def peek_histogram(self, name: str) -> Optional[Histogram]:
+        """Read-only lookup — never creates (keeps snapshots free of
+        zero-count entries from probes)."""
+        with self._lock:
+            return self._histograms.get(name)
+
+    def snapshot(self) -> Dict[str, object]:
+        """Flat dict of every metric's current value(s)."""
+        with self._lock:
+            out: Dict[str, object] = {}
+            for n, c in self._counters.items():
+                out[n] = c.value
+            for n, g in self._gauges.items():
+                out[n] = g.value
+            for n, h in self._histograms.items():
+                out[n] = h.summary()
+            return out
+
+    def counters(self) -> Dict[str, float]:
+        with self._lock:
+            return {n: c.value for n, c in self._counters.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()

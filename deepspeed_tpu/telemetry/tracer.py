@@ -1,0 +1,303 @@
+"""Span tracer: nestable named wall-clock spans with bounded buffering.
+
+The temporal half of the telemetry subsystem. Design constraints, in order:
+
+1. **Zero overhead when disabled.** ``span()`` on a disabled tracer returns a
+   shared no-op context after one attribute check — no allocation, no lock.
+   Engine hot paths call it unconditionally.
+2. **Honest on an async-dispatch runtime.** JAX dispatch is asynchronous, so a
+   host-side span around a compiled-step call measures *dispatch*, not device
+   time, unless the device queue is drained. ``sync_spans=True`` drains at
+   both span boundaries (the ``utils/timer.py`` ``_sync`` contract) — true
+   device-time spans at the cost of serializing the pipeline. The default
+   (False) keeps spans free and labels what they are.
+3. **Bounded memory.** At most ``max_events`` events are buffered; overflow
+   increments ``dropped_events`` instead of growing without bound.
+
+Spans on the same thread nest by timestamp containment, which is exactly how
+the Chrome trace-event viewer (Perfetto) reconstructs flame graphs — no
+explicit parent pointers needed. Every completed span also feeds the
+``span/<name>`` histogram in the shared ``MetricsRegistry`` so phase
+breakdowns come from the same source of truth as the trace.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+
+def _drain_device() -> None:
+    """Drain async dispatch so host wall-clock brackets device work
+    (same contract as ``utils/timer.py:_sync``)."""
+    try:
+        import jax
+
+        (jax.device_put(0.0) + 0).block_until_ready()
+    except Exception:  # pragma: no cover - backendless environments
+        pass
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager returned by disabled tracers."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    """A live span; records itself into the tracer on ``__exit__``."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        if self._tracer.sync_spans:
+            _drain_device()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._tracer.sync_spans:
+            _drain_device()
+        self._tracer._finish_span(self)
+        return False
+
+
+class Tracer:
+    """Nestable span recorder + shared metrics registry.
+
+    One global instance (``get_tracer()``) serves the whole process so the
+    engine, comm facade, dataloader, and checkpoint paths need no plumbing —
+    the same pattern as ``comm.comms_logger``.
+    """
+
+    def __init__(self, enabled: bool = False, sync_spans: bool = False,
+                 max_events: int = 100_000, memory_watermarks: bool = True):
+        self.enabled = enabled
+        self.sync_spans = sync_spans
+        self.max_events = max_events
+        self.memory_watermarks = memory_watermarks
+        self.trace_path: Optional[str] = None
+        self.jsonl_path: Optional[str] = None
+        self.dropped_events = 0
+        self.registry = MetricsRegistry()
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._origin = time.perf_counter()
+        self._last_counts: Dict[str, float] = {}
+
+    # ------------------------------------------------------------ config
+    def configure(self, enabled: bool = True, sync_spans: Optional[bool] = None,
+                  max_events: Optional[int] = None,
+                  memory_watermarks: Optional[bool] = None,
+                  trace_path: Optional[str] = None,
+                  jsonl_path: Optional[str] = None) -> "Tracer":
+        self.enabled = enabled
+        if sync_spans is not None:
+            self.sync_spans = sync_spans
+        if max_events is not None:
+            self.max_events = max_events
+        if memory_watermarks is not None:
+            self.memory_watermarks = memory_watermarks
+        if trace_path is not None:
+            self.trace_path = trace_path
+        if jsonl_path is not None:
+            self.jsonl_path = jsonl_path
+        return self
+
+    def reset(self) -> None:
+        """Drop buffered events and registry contents (config is kept)."""
+        with self._lock:
+            self._events = []
+            self.dropped_events = 0
+            self._origin = time.perf_counter()
+            self._last_counts = {}
+        self.registry.reset()
+
+    # ------------------------------------------------------------- spans
+    def span(self, name: str, cat: str = "span", **args: Any):
+        """Context manager recording one named span; no-op when disabled."""
+        if not self.enabled:
+            return NOOP_SPAN
+        return _Span(self, name, cat, args or None)
+
+    def _finish_span(self, s: _Span) -> None:
+        t1 = time.perf_counter()
+        dur_s = t1 - s._t0
+        ev = {
+            "kind": "span",
+            "name": s.name,
+            "cat": s.cat,
+            "ts": s._t0 - self._origin,
+            "dur": dur_s,
+            "tid": threading.get_ident(),
+        }
+        if s.args:
+            ev["args"] = s.args
+        self._append(ev)
+        self.registry.histogram(f"span/{s.name}").observe(dur_s)
+
+    def instant(self, name: str, cat: str = "event", **args: Any) -> None:
+        """Record a zero-duration marker event."""
+        if not self.enabled:
+            return
+        ev = {
+            "kind": "instant",
+            "name": name,
+            "cat": cat,
+            "ts": time.perf_counter() - self._origin,
+            "tid": threading.get_ident(),
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def count(self, name: str, value: float = 1.0) -> None:
+        """Increment a registry counter (no trace event; cheap)."""
+        if not self.enabled:
+            return
+        self.registry.counter(name).add(value)
+
+    def sample_counter(self, name: str, value: float) -> None:
+        """Set a gauge AND emit a Chrome 'C' counter event (a plotted track
+        in Perfetto) — used for memory watermarks."""
+        if not self.enabled:
+            return
+        self.registry.gauge(name).set(value)
+        self._append({
+            "kind": "counter",
+            "name": name,
+            "ts": time.perf_counter() - self._origin,
+            "value": value,
+        })
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped_events += 1
+                return
+            self._events.append(ev)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    # --------------------------------------------------------- summaries
+    def phase_summary(self) -> Dict[str, Dict[str, float]]:
+        """``{span_name: {count, total_ms, mean_ms, min_ms, max_ms}}`` from
+        the registry — the single source of truth ``bench.py`` reports."""
+        out: Dict[str, Dict[str, float]] = {}
+        for name, val in self.registry.snapshot().items():
+            if not name.startswith("span/") or not isinstance(val, dict):
+                continue
+            out[name[len("span/"):]] = {
+                "count": val["count"],
+                "total_ms": round(val["total"] * 1e3, 3),
+                "mean_ms": round(val["mean"] * 1e3, 3),
+                "min_ms": round(val["min"] * 1e3, 3),
+                "max_ms": round(val["max"] * 1e3, 3),
+            }
+        return out
+
+    def sample_memory(self) -> Dict[str, float]:
+        """Device-memory watermark sample: PJRT ``memory_stats()`` where the
+        backend reports it (TPU HBM), else the ``jax.live_arrays`` census
+        (CPU test meshes). Feeds gauges + Perfetto counter tracks."""
+        if not (self.enabled and self.memory_watermarks):
+            return {}
+        out: Dict[str, float] = {}
+        try:
+            import jax
+
+            stats = {}
+            try:
+                stats = jax.local_devices()[0].memory_stats() or {}
+            except Exception:
+                stats = {}
+            if "bytes_in_use" in stats:
+                out["device_bytes_in_use"] = float(stats["bytes_in_use"])
+                if "peak_bytes_in_use" in stats:
+                    out["device_peak_bytes_in_use"] = float(stats["peak_bytes_in_use"])
+            else:
+                out["live_array_bytes"] = float(
+                    sum(getattr(a, "nbytes", 0) for a in jax.live_arrays()))
+        except Exception:  # pragma: no cover - backendless environments
+            return {}
+        for k, v in out.items():
+            self.sample_counter(f"mem/{k}", v)
+        return out
+
+    def step_scalars(self, prefix: str = "Telemetry/") -> Dict[str, float]:
+        """Per-step scalars for the ``MonitorMaster``: counter deltas since
+        the previous call (comm bytes/counts...), memory watermarks, and the
+        last completed step-phase wall times. All host-side floats — never
+        blocks the dispatch pipeline."""
+        if not self.enabled:
+            return {}
+        out: Dict[str, float] = {}
+        for name, value in self.registry.counters().items():
+            delta = value - self._last_counts.get(name, 0.0)
+            self._last_counts[name] = value
+            out[prefix + name] = float(delta)
+        for k, v in self.sample_memory().items():
+            out[f"{prefix}mem/{k}"] = v
+        for phase in ("train_batch", "data", "step", "fwd_bwd", "fwd", "bwd"):
+            h = self.registry.peek_histogram(f"span/{phase}")
+            if h is not None and h.count:
+                out[f"{prefix}span/{phase}_ms"] = round(h.last * 1e3, 3)
+        return out
+
+    # ----------------------------------------------------------- export
+    def maybe_export(self) -> None:
+        """Write configured exports (no-op when no path is configured)."""
+        from deepspeed_tpu.telemetry import exporters
+
+        if self.trace_path:
+            exporters.export_chrome_trace(self.trace_path, tracer=self)
+        if self.jsonl_path:
+            exporters.export_jsonl(self.jsonl_path, tracer=self)
+
+
+def env_enabled() -> bool:
+    """True when DSTPU_TELEMETRY opts telemetry in from the environment —
+    the ONE place the accepted truthy spellings live (bench.py consults
+    this too; don't re-implement the parse)."""
+    return os.environ.get("DSTPU_TELEMETRY", "").lower() in ("1", "true", "yes")
+
+
+_tracer = Tracer(enabled=env_enabled())
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def configure(**kwargs) -> Tracer:
+    """Configure the process-global tracer (see ``Tracer.configure``)."""
+    return _tracer.configure(**kwargs)
+
+
+def span(name: str, cat: str = "span", **args: Any):
+    return _tracer.span(name, cat=cat, **args)
+
+
+def enabled() -> bool:
+    return _tracer.enabled

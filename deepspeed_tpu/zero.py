@@ -33,15 +33,37 @@ def Init(*args: Any, **kwargs: Any) -> Iterator[None]:  # noqa: N802 - reference
     yield
 
 
-@contextlib.contextmanager
-def GatheredParameters(engine: Any, modifier_rank: Optional[int] = None,
-                       fwd_module: Any = None) -> Iterator[dict]:
+def GatheredParameters(engine: Any, modifier_rank: Optional[int] = None,  # noqa: N802
+                       fwd_module: Any = None):
     """Yield the engine's full fp32 master params as nested numpy dicts;
     write them back (re-sharded / re-placed) on exit.
+
+    TPU-native signature divergence (documented in
+    ``docs/migrating-from-deepspeed.md``): the first argument is the ENGINE
+    returned by ``deepspeed_tpu.initialize`` — params here are a pytree owned
+    by the engine, not module-attached tensors, so the reference's
+    ``GatheredParameters(params, modifier_rank=...)`` parameter-list form has
+    no analog. Validated eagerly so migrating code fails with a clear
+    TypeError instead of an opaque ``AttributeError`` later.
 
     ``modifier_rank``/``fwd_module`` accepted for reference signature parity
     (single-controller JAX has no per-rank modifier distinction).
     """
+    if not hasattr(engine, "state"):
+        raise TypeError(
+            "GatheredParameters expects the ENGINE returned by "
+            "deepspeed_tpu.initialize() as its first argument, got "
+            f"{type(engine).__name__!r}. This diverges from the reference "
+            "deepspeed.zero.GatheredParameters(params, modifier_rank=...): on "
+            "TPU, parameters are a pytree owned by the engine (not module-"
+            "attached tensors), so the context gathers from — and writes back "
+            "to — the engine's masters. See docs/migrating-from-deepspeed.md."
+        )
+    return _gathered_parameters(engine)
+
+
+@contextlib.contextmanager
+def _gathered_parameters(engine: Any) -> Iterator[dict]:
     import jax
     import numpy as np
 

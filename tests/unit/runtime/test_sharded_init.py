@@ -103,3 +103,18 @@ def test_zero_namespace_gathered_parameters(devices):
     np.testing.assert_allclose(got, 0.125)
     m = eng.train_batch({"input_ids": np.zeros((eng.train_batch_size, 16), np.int32)})
     assert np.isfinite(float(m["loss"]))
+
+
+def test_gathered_parameters_rejects_param_list():
+    """Reference-signature misuse fails EAGERLY with a clear TypeError: the
+    reference's GatheredParameters(params, modifier_rank=...) takes a
+    parameter list, the TPU-native form takes the engine — passing anything
+    without `.state` must not surface later as an opaque AttributeError
+    (ADVICE round 5; divergence documented in migrating-from-deepspeed.md)."""
+    import pytest
+
+    from deepspeed_tpu import zero
+
+    for bad in ([np.zeros((2, 2))], {"w": np.zeros(3)}, None):
+        with pytest.raises(TypeError, match="ENGINE.*deepspeed_tpu.initialize"):
+            zero.GatheredParameters(bad, modifier_rank=0)
