@@ -96,14 +96,8 @@ def _telemetry_section(engine, batch, steps=5):
 
     import deepspeed_tpu.comm as dist
 
-    # jax.shard_map is the function on new jax, a MODULE holding it on some
-    # versions, and absent (experimental only) on older ones — same guarded
-    # resolution as tests/unit/test_telemetry.py
-    shard_map = getattr(jax, "shard_map", None)
-    if shard_map is not None and not callable(shard_map):
-        shard_map = shard_map.shard_map
-    if shard_map is None:
-        from jax.experimental.shard_map import shard_map
+    # one resolution of the moved/renamed shard_map API for the whole tree
+    from deepspeed_tpu.utils.compat import shard_map
     devs = np.array(jax.devices())
     mesh = Mesh(devs, ("dp",))
     probe = shard_map(lambda v: dist.all_reduce(v, "dp"),
@@ -120,7 +114,7 @@ def _telemetry_section(engine, batch, steps=5):
         engine.step()                     # "step" span (optimizer update)
     engine.flush_monitor()
 
-    out_dir = os.environ.get("DSTPU_TELEMETRY_DIR", "telemetry_out")
+    out_dir = telemetry.default_output_dir()
     trace_path = telemetry.export_chrome_trace(os.path.join(out_dir, "bench_trace.json"))
     jsonl_path = telemetry.export_jsonl(os.path.join(out_dir, "bench_events.jsonl"))
     comm = {k: v for k, v in tr.registry.counters().items() if k.startswith("comm/")}
@@ -196,6 +190,11 @@ def bench_train_gpt2(on_tpu, peak_flops):
             # opt-in (DSTPU_TELEMETRY=1): span tracing through the engine's
             # config block; disabled (default) the hooks are attribute checks
             **({"telemetry": {"enabled": True}} if _telemetry_enabled() else {}),
+            # flight recorder + recompile/step-time watch: a wedged or crashed
+            # bench run leaves telemetry_out/flight_record.jsonl behind (dump
+            # on unhandled exception / SIGTERM). health probes stay OFF so
+            # the headline timed loop compiles the identical step program.
+            "diagnostics": {"enabled": True, "health": {"enabled": False}},
         },
     )
     rng = np.random.default_rng(0)
@@ -320,6 +319,9 @@ def _bench_train_dense(peak_flops, *, hidden, inter, layers, heads, kv_heads,
             "bf16": bf16_section,
             "gradient_clipping": 1.0,
             "steps_per_print": 10_000,
+            # post-mortem artifact for the big/novel configs (these are the
+            # runs that have wedged the relay before; see EXTRA_BENCHES)
+            "diagnostics": {"enabled": True, "health": {"enabled": False}},
         },
     )
     rng = np.random.default_rng(0)

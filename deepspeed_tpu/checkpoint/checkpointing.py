@@ -172,6 +172,9 @@ def _load_checkpoint(engine, load_dir, tag, load_optimizer_states,
         # error-feedback residuals are per-run scratch (reference reinitializes
         # worker/server error buffers on load as well)
         comm_error=state.comm_error,
+        # health-probe EMAs are per-run scratch too: the restored run re-warms
+        # its spike baselines rather than trusting another run's statistics
+        health=state.health,
     )
 
     client_state: Dict[str, Any] = {}

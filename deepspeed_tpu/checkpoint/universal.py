@@ -74,6 +74,10 @@ def _fp32_state_tree(state) -> Dict[str, Any]:
 
     d = dict(state._asdict())
     d.pop("comm_error", None)
+    # health-probe EMAs are per-run scratch too (checkpointing.py treats them
+    # the same on regular loads): a universal checkpoint written with
+    # diagnostics off must restore into an engine with them on, and vice versa
+    d.pop("health", None)
     return jax.tree_util.tree_map(widen, d)
 
 
@@ -148,6 +152,7 @@ def load_universal(engine, load_dir: str, tag: Optional[str] = None,
 
     state_dict = dict(engine.state._asdict())
     comm_error = state_dict.pop("comm_error", None)  # per-run scratch, not saved
+    health = state_dict.pop("health", None)  # per-run scratch, not saved
     canon = getattr(engine, "canonical_opt_state", None)
     if canon is not None:
         # restore against the canonical (partition-independent) structure;
@@ -182,6 +187,7 @@ def load_universal(engine, load_dir: str, tag: Optional[str] = None,
         narrow, restored, state_dict, is_leaf=lambda x: x is None
     )
     restored["comm_error"] = comm_error  # fresh per-run residuals
+    restored["health"] = health  # fresh per-run health baselines
     departition = getattr(engine, "opt_state_from_canonical", None)
     if departition is not None:
         restored["opt_state"] = departition(restored["opt_state"])
@@ -195,6 +201,7 @@ def _load_universal_npz(engine, path: str, npz_file: str, strict: bool) -> str:
     data = np.load(npz_file)
     state_dict = dict(engine.state._asdict())
     comm_error = state_dict.pop("comm_error", None)  # per-run scratch
+    health = state_dict.pop("health", None)  # per-run scratch
     canon = getattr(engine, "canonical_opt_state", None)
     if canon is not None:
         state_dict["opt_state"] = canon(state_dict["opt_state"])
@@ -203,7 +210,8 @@ def _load_universal_npz(engine, path: str, npz_file: str, strict: bool) -> str:
     # v1 checkpoints written before comm_error became per-run scratch may
     # carry its atoms; they are skipped, not a mismatch
     extra = [k for k in data.files
-             if k not in flat_target and not k.startswith("['comm_error']")]
+             if k not in flat_target
+             and not k.startswith(("['comm_error']", "['health']"))]
     if (missing or extra) and strict:
         raise ValueError(f"universal checkpoint mismatch: missing={missing[:5]} extra={extra[:5]}")
 
@@ -218,6 +226,7 @@ def _load_universal_npz(engine, path: str, npz_file: str, strict: bool) -> str:
 
     restored = jax.tree_util.tree_map_with_path(_restore, state_dict)
     restored["comm_error"] = comm_error
+    restored["health"] = health
     departition = getattr(engine, "opt_state_from_canonical", None)
     if departition is not None:
         restored["opt_state"] = departition(restored["opt_state"])

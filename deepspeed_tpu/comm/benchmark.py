@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.comm.comm import CommsLogger
+from deepspeed_tpu.utils.compat import shard_map
 from deepspeed_tpu.topology.mesh import build_mesh
 
 OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all")
@@ -66,7 +67,7 @@ def run_collective_bench(
             jnp.ones((elems,), dtype), NamedSharding(mesh, P(axis))
         )
         f = jax.jit(
-            jax.shard_map(fn, mesh=mesh, in_specs=P(axis),
+            shard_map(fn, mesh=mesh, in_specs=P(axis),
                           out_specs=P() if op == "all_reduce" else P(axis),
                           check_vma=False)
         )

@@ -138,16 +138,12 @@ def log_summary():
 
 
 def _axis_size(axis) -> int:
+    from deepspeed_tpu.utils.compat import axis_size
+
     try:
-        if isinstance(axis, (tuple, list)):
-            return int(np.prod([jax.lax.axis_size(a) for a in axis]))
-        return int(jax.lax.axis_size(axis))
-    except Exception:
-        pass
-    try:
-        # older jax has no lax.axis_size; a unit psum over a bound axis is
-        # statically the axis size at trace time
-        return int(jax.lax.psum(1, axis))
+        # compat resolves the axis-size API move (unit-psum fallback on
+        # older jax); outside a bound axis context the size is unknowable
+        return axis_size(axis)
     except Exception:
         return 1
 

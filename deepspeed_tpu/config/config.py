@@ -220,6 +220,74 @@ class TelemetryConfig(DeepSpeedConfigModel):
     memory_watermarks: bool = True
 
 
+class HealthConfig(DeepSpeedConfigModel):
+    """In-jit training-health probes (``diagnostics/health.py``): per-leaf-
+    group nonfinite counts plus grad-norm/loss EMA z-score spike detection,
+    traced into the compiled step next to the existing overflow/grad-norm
+    math. Per-signal policy: ``log`` (record in metrics), ``skip_step`` (gate
+    the optimizer update off inside the program — the fp16 overflow-skip
+    select, extended), ``abort`` (skip AND raise ``TrainingHealthError``
+    host-side; the one policy that syncs the dispatch pipeline per step)."""
+
+    enabled: bool = True
+    nonfinite_policy: str = "log"  # log | skip_step | abort
+    grad_spike_policy: str = "log"
+    loss_spike_policy: str = "log"
+    grad_spike_zscore: float = 6.0
+    loss_spike_zscore: float = 6.0
+    ema_beta: float = 0.98
+    # healthy steps absorbed into the EMAs before z-scores may fire
+    warmup_steps: int = 20
+
+
+class RecompileDetectConfig(DeepSpeedConfigModel):
+    """Recompile detection on the engine's jitted callables
+    (``diagnostics/recompile.py``): compile-cache growth tracking + argument
+    shape-diff attribution, with storm escalation when recompiles cluster."""
+
+    enabled: bool = True
+    storm_threshold: int = 3  # recompiles within the window => storm error
+    storm_window_s: float = 60.0
+
+
+class StepTimeConfig(DeepSpeedConfigModel):
+    """Step-time anomaly detection (``diagnostics/anomaly.py``): rolling
+    median + MAD straggler flags and sustained-regression detection over the
+    per-step wall times; results land as ``anomaly/`` registry gauges."""
+
+    enabled: bool = True
+    window: int = 64
+    straggler_mads: float = 6.0
+    regression_factor: float = 1.3
+    min_samples: int = 8
+
+
+class FlightRecorderConfig(DeepSpeedConfigModel):
+    """Crash flight recorder (``diagnostics/flight_recorder.py``): bounded
+    ring of recent step records (metric snapshot + health verdicts), dumped
+    to JSONL + Perfetto on unhandled exception, SIGTERM/SIGUSR1, or an
+    explicit ``engine.diagnostics.dump()``."""
+
+    enabled: bool = True
+    capacity: int = 16  # step records kept in the ring
+    dump_dir: Optional[str] = None  # default: $DSTPU_TELEMETRY_DIR or ./telemetry_out
+    install_signal_handlers: bool = True  # SIGTERM/SIGUSR1 -> dump (process-wide, once)
+    dump_on_exception: bool = True  # sys.excepthook chain -> dump
+
+
+class DiagnosticsConfig(DeepSpeedConfigModel):
+    """diagnostics section — the watching half of observability
+    (``deepspeed_tpu/diagnostics``), built on the telemetry core. Disabled
+    (the default) the engine compiles the identical program as without the
+    block and every hook is one attribute check."""
+
+    enabled: bool = False
+    health: HealthConfig = Field(default_factory=HealthConfig)
+    recompile: RecompileDetectConfig = Field(default_factory=RecompileDetectConfig)
+    step_time: StepTimeConfig = Field(default_factory=StepTimeConfig)
+    flight_recorder: FlightRecorderConfig = Field(default_factory=FlightRecorderConfig)
+
+
 class CommsLoggerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     verbose: bool = False
@@ -282,6 +350,7 @@ class EngineConfig(DeepSpeedConfigModel):
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+    diagnostics: DiagnosticsConfig = Field(default_factory=DiagnosticsConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
     gradient_compression: GradientCompressionConfig = Field(default_factory=GradientCompressionConfig)

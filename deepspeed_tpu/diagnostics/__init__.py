@@ -1,0 +1,51 @@
+"""deepspeed_tpu.diagnostics: the production half of observability.
+
+PR 1's telemetry core records what happened (spans, metrics, traces); this
+package WATCHES it and the training math itself:
+
+  - ``health``          — in-jit training-health probes (per-leaf-group
+    nonfinite counts, grad-norm / loss z-score spike detection) with
+    per-signal ``log | skip_step | abort`` policies, folded into the engine's
+    compiled step next to the existing overflow/grad-norm math
+  - ``recompile``       — recompile detection on jitted callables (compile-
+    cache growth + argument shape-diff attribution, storm escalation); also
+    verifies the inference engines' "bucketing means no recompile" claim
+  - ``anomaly``         — rolling median+MAD step-time straggler/regression
+    detection over the step wall times the telemetry spans already measure
+  - ``flight_recorder`` — bounded ring of recent step records dumped to
+    JSONL (+ Perfetto trace) on unhandled exception, SIGTERM/SIGUSR1, or an
+    explicit ``engine.diagnostics.dump()``
+
+Enable via the ``diagnostics`` config block (see ``config/config.py``);
+disabled (the default) the engine carries no health state, compiles the same
+program as before, and every hook is one attribute check. See
+``docs/diagnostics.md``.
+"""
+
+from deepspeed_tpu.diagnostics.anomaly import StepTimeAnomalyDetector
+from deepspeed_tpu.diagnostics.flight_recorder import (
+    FlightRecorder,
+    dump_all,
+    install_process_hooks,
+)
+from deepspeed_tpu.diagnostics.health import (
+    HealthMonitor,
+    HealthState,
+    group_nonfinite_counts,
+)
+from deepspeed_tpu.diagnostics.manager import DiagnosticsManager, TrainingHealthError
+from deepspeed_tpu.diagnostics.recompile import RecompileDetector, diff_signatures
+
+__all__ = [
+    "DiagnosticsManager",
+    "FlightRecorder",
+    "HealthMonitor",
+    "HealthState",
+    "RecompileDetector",
+    "StepTimeAnomalyDetector",
+    "TrainingHealthError",
+    "diff_signatures",
+    "dump_all",
+    "group_nonfinite_counts",
+    "install_process_hooks",
+]

@@ -1,0 +1,93 @@
+"""Step-time anomaly detection: rolling median + MAD over step wall times.
+
+T3-style transparent runtime tracking (arXiv:2401.16677) argues the runtime
+itself should notice when steps slow down, not a human reading dashboards
+hours later. Two detectors over one rolling window:
+
+  - **straggler**: a single step beyond ``median + k * MAD`` (MAD is robust —
+    one slow step cannot inflate its own threshold the way a stddev would);
+  - **regression**: the median of the most recent quarter of the window drifts
+    past ``regression_factor`` x the window median — a sustained slowdown
+    (thermal throttling, a neighbor job, a recompile storm), not a blip.
+
+Results land as registry gauges (``anomaly/...``) so they ride the existing
+telemetry export/monitor paths, plus tracer instants for the Perfetto view.
+All host-side floats — never touches the device.
+"""
+
+from __future__ import annotations
+
+import collections
+import statistics
+from typing import Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class StepTimeAnomalyDetector:
+    def __init__(
+        self,
+        window: int = 64,
+        straggler_mads: float = 6.0,
+        regression_factor: float = 1.3,
+        min_samples: int = 8,
+        name: str = "step",
+        tracer=None,
+    ):
+        self.window = int(window)
+        self.straggler_mads = float(straggler_mads)
+        self.regression_factor = float(regression_factor)
+        self.min_samples = max(int(min_samples), 4)
+        self.name = name
+        self._durs: collections.deque = collections.deque(maxlen=self.window)
+        self.stragglers = 0
+        self._regressing = False
+        if tracer is None:
+            from deepspeed_tpu.telemetry import get_tracer
+
+            tracer = get_tracer()
+        self._tracer = tracer
+
+    def observe(self, dur_s: float, step: Optional[int] = None) -> Dict[str, float]:
+        """Record one step duration; returns this step's anomaly flags."""
+        flags = {"straggler": False, "regression": False}
+        prior = list(self._durs)
+        self._durs.append(float(dur_s))
+        if len(prior) < self.min_samples:
+            return flags
+        med = statistics.median(prior)
+        mad = statistics.median(abs(x - med) for x in prior)
+        # MAD floor: identical timings give MAD 0 and any jitter would flag
+        mad = max(mad, 0.01 * med, 1e-6)
+        if dur_s > med + self.straggler_mads * mad:
+            flags["straggler"] = True
+            self.stragglers += 1
+            logger.warning(
+                f"[anomaly/{self.name}] straggler step"
+                + (f" {step}" if step is not None else "")
+                + f": {dur_s * 1e3:.1f} ms vs median {med * 1e3:.1f} ms "
+                f"(MAD {mad * 1e3:.2f} ms)")
+            self._tracer.instant(f"straggler:{self.name}", cat="diagnostics",
+                                 dur_ms=round(dur_s * 1e3, 3),
+                                 median_ms=round(med * 1e3, 3))
+        recent_n = max(len(self._durs) // 4, self.min_samples // 2)
+        recent = list(self._durs)[-recent_n:]
+        recent_med = statistics.median(recent)
+        regressing = recent_med > self.regression_factor * med
+        flags["regression"] = regressing
+        if regressing and not self._regressing:
+            logger.warning(
+                f"[anomaly/{self.name}] sustained step-time regression: recent "
+                f"median {recent_med * 1e3:.1f} ms vs window median "
+                f"{med * 1e3:.1f} ms (> {self.regression_factor:.2f}x)")
+            self._tracer.instant(f"regression:{self.name}", cat="diagnostics",
+                                 recent_ms=round(recent_med * 1e3, 3),
+                                 median_ms=round(med * 1e3, 3))
+        self._regressing = regressing
+
+        reg = self._tracer.registry
+        reg.gauge(f"anomaly/{self.name}_median_ms").set(med * 1e3)
+        reg.gauge(f"anomaly/{self.name}_mad_ms").set(mad * 1e3)
+        reg.gauge(f"anomaly/{self.name}_straggler").set(float(flags["straggler"]))
+        reg.gauge(f"anomaly/{self.name}_regression").set(float(regressing))
+        return flags

@@ -1,0 +1,203 @@
+"""Crash flight recorder: a bounded ring of recent step records.
+
+Black-box recorder semantics: every step appends its (device-resident) metric
+snapshot + health verdicts to a ring of the last N steps — an append and
+nothing else, so recording costs no device fetch and no sync. Only ``dump()``
+pays: ONE bulk ``jax.device_get`` over the ring, then JSONL to disk plus (when
+the tracer is enabled) a Perfetto trace next to it, so a dead run always
+leaves a post-mortem:
+
+  - unhandled exception (``sys.excepthook`` chain)
+  - SIGTERM (preemption — dump, then chain to the prior handler) and SIGUSR1
+    (inspect a live run without stopping it)
+  - an explicit ``engine.diagnostics.dump()``
+
+Process-wide hooks are installed ONCE and dispatch to every live recorder
+through a WeakSet — engines come and go (tests build dozens) without handler
+stacking or teardown ordering hazards.
+
+Dump schema (JSONL, one object per line):
+  {"kind": "header", "reason", "time_unix", "pid", "context", "n_records"}
+  {"kind": "step_record", "step", "t_unix", "metrics": {...}, "health": {...}}
+  {"kind": "span" | "instant" | "counter", ...}   # recent tracer events
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_RECORDERS: "weakref.WeakSet[FlightRecorder]" = weakref.WeakSet()
+_HOOKS_LOCK = threading.Lock()
+_HOOKS_INSTALLED = False
+_PREV_EXCEPTHOOK = None
+_PREV_SIGNAL_HANDLERS: Dict[int, Any] = {}
+
+
+def _to_plain(x: Any) -> Any:
+    """Host python value for one fetched metric leaf (JSON-serializable)."""
+    import numpy as np
+
+    arr = np.asarray(x)
+    if arr.size == 1:
+        v = arr.reshape(()).item()
+        if isinstance(v, float) and not np.isfinite(v):
+            return repr(v)  # JSON has no NaN/Inf; keep the information
+        return v
+    return arr.tolist()
+
+
+def dump_all(reason: str) -> List[str]:
+    """Dump every live recorder; never raises (post-mortem best effort)."""
+    paths = []
+    for rec in list(_RECORDERS):
+        try:
+            paths.append(rec.dump(reason=reason))
+        except Exception as e:  # noqa: BLE001 - must not mask the real crash
+            logger.warning(f"flight recorder dump failed: {type(e).__name__}: {e}")
+    return paths
+
+
+def _excepthook(exc_type, exc, tb):
+    if not issubclass(exc_type, (KeyboardInterrupt, SystemExit)):
+        dump_all(reason=f"exception:{exc_type.__name__}")
+    (_PREV_EXCEPTHOOK or sys.__excepthook__)(exc_type, exc, tb)
+
+
+def _signal_handler(signum, frame):
+    name = signal.Signals(signum).name
+    dump_all(reason=f"signal:{name}")
+    prev = _PREV_SIGNAL_HANDLERS.get(signum)
+    if callable(prev):
+        prev(signum, frame)
+    elif signum != signal.SIGUSR1:
+        # restore + re-raise so default termination semantics survive the dump
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def install_process_hooks(signals: bool = True, excepthook: bool = True) -> None:
+    """Install the dump-on-death hooks once per process (idempotent)."""
+    global _HOOKS_INSTALLED, _PREV_EXCEPTHOOK
+    with _HOOKS_LOCK:
+        if _HOOKS_INSTALLED:
+            return
+        _HOOKS_INSTALLED = True
+        if excepthook:
+            _PREV_EXCEPTHOOK = sys.excepthook
+            sys.excepthook = _excepthook
+        if signals and threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM, signal.SIGUSR1):
+                try:
+                    _PREV_SIGNAL_HANDLERS[sig] = signal.signal(sig, _signal_handler)
+                except (ValueError, OSError):  # non-main thread / exotic runtime
+                    pass
+
+
+class FlightRecorder:
+    def __init__(
+        self,
+        capacity: int = 16,
+        dump_dir: Optional[str] = None,
+        tracer=None,
+        max_trace_events: int = 512,
+    ):
+        self.capacity = max(int(capacity), 1)
+        self.dump_dir = dump_dir
+        self.max_trace_events = max_trace_events
+        self._ring: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._context: Dict[str, Any] = {}
+        if tracer is None:
+            from deepspeed_tpu.telemetry import get_tracer
+
+            tracer = get_tracer()
+        self._tracer = tracer
+        _RECORDERS.add(self)
+
+    def set_context(self, **kwargs: Any) -> None:
+        """Static run facts for the dump header (mesh, stages, dtype, ...)."""
+        self._context.update(kwargs)
+
+    def record(self, step: int, metrics: Dict[str, Any], **extra: Any) -> None:
+        """Append one step record. Metric values may be device arrays — they
+        are fetched only at dump time, so this never blocks dispatch."""
+        rec = {"step": int(step), "t_unix": time.time(), "metrics": dict(metrics)}
+        if extra:
+            rec.update(extra)
+        with self._lock:
+            self._ring.append(rec)
+            if len(self._ring) > self.capacity:
+                del self._ring[: len(self._ring) - self.capacity]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # ------------------------------------------------------------------ dump
+    def _resolve_path(self, path: Optional[str]) -> str:
+        if path:
+            return path
+        from deepspeed_tpu.telemetry import default_output_dir
+
+        return os.path.join(self.dump_dir or default_output_dir(),
+                            "flight_record.jsonl")
+
+    def dump(self, reason: str = "manual", path: Optional[str] = None) -> str:
+        """Fetch the ring (one bulk transfer) and write the JSONL post-mortem.
+        Returns the path written."""
+        import jax
+
+        with self._lock:
+            ring = [dict(r) for r in self._ring]
+        fetched = jax.device_get([r["metrics"] for r in ring])
+        path = self._resolve_path(path)
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            header = {
+                "kind": "header",
+                "reason": reason,
+                "time_unix": time.time(),
+                "pid": os.getpid(),
+                "context": self._context,
+                "n_records": len(ring),
+            }
+            f.write(json.dumps(header) + "\n")
+            for rec, metrics in zip(ring, fetched):
+                plain = {k: _to_plain(v) for k, v in metrics.items()}
+                health = {k[len("health/"):]: v for k, v in plain.items()
+                          if k.startswith("health/")}
+                row = {
+                    "kind": "step_record",
+                    "step": rec["step"],
+                    "t_unix": rec["t_unix"],
+                    "metrics": {k: v for k, v in plain.items()
+                                if not k.startswith("health/")},
+                    "health": health,
+                }
+                for k, v in rec.items():
+                    if k not in ("step", "t_unix", "metrics"):
+                        row[k] = v
+                f.write(json.dumps(row) + "\n")
+            for ev in self._tracer.events()[-self.max_trace_events:]:
+                f.write(json.dumps({"pid": os.getpid(), **ev}) + "\n")
+        if self._tracer.enabled:
+            try:
+                from deepspeed_tpu.telemetry import export_chrome_trace
+
+                export_chrome_trace(
+                    os.path.splitext(path)[0] + "_trace.json", tracer=self._tracer)
+            except Exception as e:  # noqa: BLE001 - trace export is best-effort
+                logger.warning(f"flight-recorder trace export failed: {e}")
+        logger.warning(f"flight recorder: dumped {len(ring)} step records to "
+                       f"{path} (reason: {reason})")
+        return path

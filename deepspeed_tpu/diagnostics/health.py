@@ -1,0 +1,199 @@
+"""Training-health probes that run INSIDE the compiled train step.
+
+The reference treats overflow detection as a first-class runtime feature
+(``zero/stage_1_and_2.py:2038 _has_inf_or_nan`` fused into the step;
+``FP16_Optimizer.step``'s skip path). This module generalizes that machinery
+to health *signals* beyond fp16 overflow, all traced into the one jitted
+program so detection costs no extra device->host fetch:
+
+  - **nonfinite**: per-leaf-group NaN/Inf element counts over the (unscaled)
+    gradients. Catches bf16 NaN storms, which the fp16 loss-scaler machinery
+    never sees (bf16 runs with ``all_finite`` compiled out).
+  - **grad_spike**: z-score of the global grad norm against EMA mean/variance
+    carried in the train state (``HealthState``).
+  - **loss_spike**: same detector over the step loss (fused-step path only;
+    the offload host program receives gradients, not losses).
+
+Each signal has a policy: ``log`` (record only), ``skip_step`` (gate the
+optimizer update off inside the jitted program — the fp16 overflow-skip
+``jnp.where`` select, extended), or ``abort`` (skip AND raise host-side; the
+per-step abort fetch is the one policy that synchronizes the dispatch
+pipeline, a latency-for-certainty trade the config opts into).
+
+Verdicts travel in the step ``metrics`` dict as device scalars under
+``health/``; nothing here forces a transfer — the engine's existing periodic
+fetch, the monitor flush, and the flight-recorder dump are the sync points.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+POLICIES = ("log", "skip_step", "abort")
+
+# Signals detectable without history run even at count=0; EMA z-scores need
+# warmup_steps healthy samples before they may fire.
+SIGNALS = ("nonfinite", "grad_spike", "loss_spike")
+
+
+class HealthState(NamedTuple):
+    """EMA state carried in ``TrainState.health`` (device scalars)."""
+
+    count: jax.Array  # i32: healthy steps absorbed into the EMAs
+    gnorm_ema: jax.Array  # f32 EMA of the global grad norm
+    gnorm_sq_ema: jax.Array  # f32 EMA of its square (for variance)
+    loss_ema: jax.Array
+    loss_sq_ema: jax.Array
+
+
+def _group_key(path) -> str:
+    """Top-level tree key for a leaf path ('' for scalar/leaf-only trees)."""
+    if not path:
+        return "params"
+    entry = path[0]
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry).strip("[].'\"")
+
+
+def group_nonfinite_counts(tree: Any) -> Dict[str, jax.Array]:
+    """Per-top-level-group count of nonfinite elements (i32 device scalars).
+
+    Grouping by the first path element matches how model params are organized
+    (flax module name / layer dict key), so a NaN storm names the subtree it
+    started in rather than just "somewhere".
+    """
+    counts: Dict[str, jax.Array] = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            continue
+        key = _group_key(path)
+        c = jnp.sum(~jnp.isfinite(leaf)).astype(jnp.int32)
+        counts[key] = counts[key] + c if key in counts else c
+    return counts
+
+
+class HealthMonitor:
+    """Builds the in-jit probes and holds the (static) per-signal policies."""
+
+    def __init__(self, config, fp16: bool = False):
+        self.config = config
+        self.fp16 = fp16
+        self.policies = {
+            "nonfinite": config.nonfinite_policy,
+            "grad_spike": config.grad_spike_policy,
+            "loss_spike": config.loss_spike_policy,
+        }
+        for sig, pol in self.policies.items():
+            if pol not in POLICIES:
+                raise ValueError(
+                    f"diagnostics.health.{sig}_policy={pol!r}: must be one of {POLICIES}")
+        self.skip_signals = tuple(
+            s for s, p in self.policies.items() if p in ("skip_step", "abort"))
+        self.abort_signals = tuple(
+            s for s, p in self.policies.items() if p == "abort")
+
+    # ------------------------------------------------------------------ state
+    def init_state(self) -> HealthState:
+        # distinct arrays per field: shared zeros would alias buffers and trip
+        # the fused step's donation ("same buffer donated twice")
+        return HealthState(
+            count=jnp.zeros((), jnp.int32),
+            gnorm_ema=jnp.zeros((), jnp.float32),
+            gnorm_sq_ema=jnp.zeros((), jnp.float32),
+            loss_ema=jnp.zeros((), jnp.float32),
+            loss_sq_ema=jnp.zeros((), jnp.float32),
+        )
+
+    # ------------------------------------------------------------------ probe
+    def _zscore(self, x, ema, sq_ema, count):
+        warm = count >= self.config.warmup_steps
+        var = jnp.maximum(sq_ema - jnp.square(ema), 0.0)
+        z = (x - ema) * jax.lax.rsqrt(var + 1e-12)
+        # NaN x compares False against any threshold, so a nonfinite value
+        # never double-fires as a "spike"; warmup gates the cold-start noise.
+        return jnp.where(warm, z, 0.0)
+
+    def _ema_step(self, ema, x, count):
+        beta = jnp.float32(self.config.ema_beta)
+        # first healthy sample seeds the EMA exactly (no zero-bias ramp)
+        return jnp.where(count == 0, x, beta * ema + (1.0 - beta) * x)
+
+    def probe(
+        self,
+        hstate: HealthState,
+        grads: Any,
+        gnorm: jax.Array,
+        loss: Optional[jax.Array] = None,
+        finite: Optional[jax.Array] = None,
+    ) -> Tuple[HealthState, Dict[str, jax.Array], jax.Array, jax.Array]:
+        """All health signals for one step — traced into the caller's program.
+
+        Returns ``(new_hstate, metrics, skip, abort)`` where ``metrics`` holds
+        the device-scalar verdicts (``health/...``), ``skip`` gates the
+        optimizer update (signals whose policy is skip_step/abort), and
+        ``abort`` marks signals whose policy asks the host to raise.
+        """
+        cfg = self.config
+        gnorm = gnorm.astype(jnp.float32)
+        finite = jnp.asarray(True) if finite is None else finite
+
+        group_counts = group_nonfinite_counts(grads)
+        nonfinite_total = sum(group_counts.values()) if group_counts else jnp.zeros((), jnp.int32)
+        nonfinite_any = nonfinite_total > 0
+
+        gz = self._zscore(gnorm, hstate.gnorm_ema, hstate.gnorm_sq_ema, hstate.count)
+        grad_spike = gz > cfg.grad_spike_zscore
+
+        if loss is not None:
+            loss = loss.astype(jnp.float32)
+            lz = self._zscore(loss, hstate.loss_ema, hstate.loss_sq_ema, hstate.count)
+            loss_spike = lz > cfg.loss_spike_zscore
+        else:
+            lz = jnp.zeros((), jnp.float32)
+            loss_spike = jnp.asarray(False)
+
+        signals = {
+            "nonfinite": nonfinite_any,
+            "grad_spike": grad_spike,
+            "loss_spike": loss_spike,
+        }
+        false = jnp.asarray(False)
+        skip = false
+        for s in self.skip_signals:
+            skip = skip | signals[s]
+        abort = false
+        for s in self.abort_signals:
+            abort = abort | signals[s]
+
+        # EMAs absorb only clean, finite steps: one poisoned step must not
+        # shift the baseline the next steps are judged against.
+        healthy = finite & ~nonfinite_any & ~grad_spike & ~loss_spike & jnp.isfinite(gnorm)
+        absorb = lambda ema, x: jnp.where(  # noqa: E731
+            healthy, self._ema_step(ema, x, hstate.count), ema)
+        new_hstate = HealthState(
+            count=hstate.count + jnp.where(healthy, 1, 0).astype(jnp.int32),
+            gnorm_ema=absorb(hstate.gnorm_ema, gnorm),
+            gnorm_sq_ema=absorb(hstate.gnorm_sq_ema, jnp.square(gnorm)),
+            loss_ema=absorb(hstate.loss_ema, loss) if loss is not None else hstate.loss_ema,
+            loss_sq_ema=(absorb(hstate.loss_sq_ema, jnp.square(loss))
+                         if loss is not None else hstate.loss_sq_ema),
+        )
+
+        metrics: Dict[str, jax.Array] = {
+            "health/nonfinite_total": nonfinite_total,
+            "health/nonfinite_any": nonfinite_any,
+            "health/grad_zscore": gz,
+            "health/grad_spike": grad_spike,
+            "health/loss_zscore": lz,
+            "health/loss_spike": loss_spike,
+            "health/skip": skip,
+            "health/abort": abort,
+        }
+        for g, c in group_counts.items():
+            metrics[f"health/nonfinite/{g}"] = c
+        return new_hstate, metrics, skip, abort

@@ -1,0 +1,142 @@
+"""DiagnosticsManager: one handle wiring the four diagnostics into an engine.
+
+The engine constructs one manager when the ``diagnostics`` config block is
+enabled and keeps ``engine.diagnostics = None`` otherwise — every hot-path
+hook is a single ``is not None`` check, the telemetry zero-overhead contract.
+
+Responsibilities:
+  - hold the :class:`HealthMonitor` whose probes the engine traces into its
+    compiled step (``engine._update_math``)
+  - wrap the engine's jitted callables with :class:`RecompileDetector`
+  - feed step wall times to :class:`StepTimeAnomalyDetector`
+  - append every step's metric snapshot to the :class:`FlightRecorder` and
+    honor the ``abort`` policy (the one per-step device fetch diagnostics
+    ever does, and only when an abort policy is configured)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from deepspeed_tpu.diagnostics.anomaly import StepTimeAnomalyDetector
+from deepspeed_tpu.diagnostics.flight_recorder import (
+    FlightRecorder,
+    install_process_hooks,
+)
+from deepspeed_tpu.diagnostics.health import HealthMonitor
+from deepspeed_tpu.diagnostics.recompile import RecompileDetector
+from deepspeed_tpu.utils.logging import logger
+
+
+class TrainingHealthError(RuntimeError):
+    """Raised by the ``abort`` policy; carries the offending step's verdicts."""
+
+    def __init__(self, message: str, step: int, verdicts: Dict[str, Any],
+                 dump_path: Optional[str] = None):
+        super().__init__(message)
+        self.step = step
+        self.verdicts = verdicts
+        self.dump_path = dump_path
+
+
+class DiagnosticsManager:
+    def __init__(self, config, fp16: bool = False, tracer=None):
+        self.config = config
+        if tracer is None:
+            from deepspeed_tpu.telemetry import get_tracer
+
+            tracer = get_tracer()
+        self._tracer = tracer
+
+        self.health: Optional[HealthMonitor] = None
+        if config.health.enabled:
+            self.health = HealthMonitor(config.health, fp16=fp16)
+
+        self._detectors: Dict[str, RecompileDetector] = {}
+        self.step_time: Optional[StepTimeAnomalyDetector] = None
+        if config.step_time.enabled:
+            self.step_time = StepTimeAnomalyDetector(
+                window=config.step_time.window,
+                straggler_mads=config.step_time.straggler_mads,
+                regression_factor=config.step_time.regression_factor,
+                min_samples=config.step_time.min_samples,
+                tracer=tracer,
+            )
+
+        self.flight_recorder: Optional[FlightRecorder] = None
+        if config.flight_recorder.enabled:
+            self.flight_recorder = FlightRecorder(
+                capacity=config.flight_recorder.capacity,
+                dump_dir=config.flight_recorder.dump_dir,
+                tracer=tracer,
+            )
+            install_process_hooks(
+                signals=config.flight_recorder.install_signal_handlers,
+                excepthook=config.flight_recorder.dump_on_exception,
+            )
+
+        self._abort_armed = bool(self.health and self.health.abort_signals)
+        self._skips_seen = 0
+
+    # -------------------------------------------------------------- recompile
+    def wrap_jit(self, name: str, fn: Callable,
+                 arg_names: Optional[Sequence[str]] = None) -> Callable:
+        """Wrap a jitted callable with a recompile detector (identity when
+        recompile checking is off)."""
+        if not self.config.recompile.enabled or fn is None:
+            return fn
+        det = self._detectors.get(name)
+        if det is None:
+            det = self._detectors[name] = RecompileDetector(
+                name,
+                arg_names=arg_names,
+                storm_threshold=self.config.recompile.storm_threshold,
+                storm_window_s=self.config.recompile.storm_window_s,
+                tracer=self._tracer,
+            )
+        return det.wrap(fn)
+
+    def detector(self, name: str) -> Optional[RecompileDetector]:
+        return self._detectors.get(name)
+
+    # -------------------------------------------------------------- per step
+    def after_step(self, step: int, metrics: Dict[str, Any],
+                   step_time_s: Optional[float] = None) -> None:
+        """Host-side per-step hook: ring append + step-time observe + abort.
+
+        ``metrics`` leaves stay device-side except under the abort policy,
+        which fetches the scalar verdicts (an explicit sync the config chose).
+        """
+        if self.flight_recorder is not None:
+            extra = {}
+            if step_time_s is not None:
+                extra["step_time_ms"] = round(step_time_s * 1e3, 3)
+            self.flight_recorder.record(step, metrics, **extra)
+        if self.step_time is not None and step_time_s is not None:
+            self.step_time.observe(step_time_s, step=step)
+        if self._abort_armed and "health/abort" in metrics:
+            import jax
+
+            if bool(jax.device_get(metrics["health/abort"])):
+                fetched = jax.device_get(
+                    {k: v for k, v in metrics.items() if k.startswith("health/")})
+                verdicts = {k: (v.item() if hasattr(v, "item") else v)
+                            for k, v in fetched.items()}
+                dump_path = self.dump(reason="health_abort")
+                bad = [s for s in ("nonfinite_any", "grad_spike", "loss_spike")
+                       if verdicts.get(f"health/{s}")]
+                raise TrainingHealthError(
+                    f"training health abort at step {step}: "
+                    f"{', '.join(bad) or 'health signal'} fired "
+                    f"(verdicts: {verdicts})"
+                    + (f"; flight record: {dump_path}" if dump_path else ""),
+                    step=step, verdicts=verdicts, dump_path=dump_path)
+
+    # ------------------------------------------------------------------ dump
+    def dump(self, reason: str = "manual", path: Optional[str] = None) -> Optional[str]:
+        """Explicit flight-recorder dump; returns the path (None when the
+        recorder is disabled)."""
+        if self.flight_recorder is None:
+            logger.warning("diagnostics.dump(): flight recorder is disabled")
+            return None
+        return self.flight_recorder.dump(reason=reason, path=path)

@@ -1,0 +1,192 @@
+"""Recompile detector for jitted callables.
+
+XLA recompiles silently: a drifting input shape (an unpadded batch, a new
+sequence bucket, a weak-typed scalar) turns a cached dispatch into a full
+compile, and the only symptom is a mysteriously slow step. This detector
+wraps a jitted callable and, per call, compares ``fn._cache_size()`` before
+and after — growth IS a compile. On every compile past the first it warns
+with the argument-level shape diff against the previous call (naming the
+operand that changed), emits a telemetry instant + counter, and escalates to
+a storm error when compiles cluster in time.
+
+The per-call overhead is one ``_cache_size()`` call plus a shape walk of the
+argument tree — nanoseconds against a training step or a generate request —
+and the wrapper is only installed when diagnostics/recompile checking is
+enabled. Attribute access (``.lower`` for AOT compilation, etc.) forwards to
+the wrapped function, so profiler paths keep working.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def _leaf_sig(x: Any):
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None:
+        # python scalars / static args: the value itself keys the jit cache
+        return ("static", type(x).__name__, repr(x)[:64])
+    return (tuple(shape), str(dtype))
+
+
+def _tree_sig(args: Tuple, kwargs: Dict, arg_names: Optional[Sequence[str]]) -> Dict[str, Any]:
+    import jax
+
+    sig: Dict[str, Any] = {}
+    for i, a in enumerate(args):
+        name = arg_names[i] if arg_names and i < len(arg_names) else f"arg{i}"
+        for path, leaf in jax.tree_util.tree_leaves_with_path(a):
+            sig[name + jax.tree_util.keystr(path)] = _leaf_sig(leaf)
+    for k, v in kwargs.items():
+        for path, leaf in jax.tree_util.tree_leaves_with_path(v):
+            sig[k + jax.tree_util.keystr(path)] = _leaf_sig(leaf)
+    return sig
+
+
+def diff_signatures(old: Dict[str, Any], new: Dict[str, Any]) -> List[str]:
+    """Human-readable operand-level diff, changed arguments named first."""
+    out = []
+    for k in new:
+        if k in old and old[k] != new[k]:
+            out.append(f"{k}: {old[k]} -> {new[k]}")
+    for k in new:
+        if k not in old:
+            out.append(f"{k}: (new) {new[k]}")
+    for k in old:
+        if k not in new:
+            out.append(f"{k}: {old[k]} -> (gone)")
+    return out
+
+
+class _WrappedJit:
+    """Callable proxy recording cache growth; forwards everything else.
+
+    Cost discipline: on a cache hit the wrapper does exactly two
+    ``_cache_size()`` probes (a C++ int read) — the argument-tree shape walk
+    only runs when a compile actually happened, so wrapping the train step
+    adds no per-leaf host work to steady-state dispatch. ``_last_sig`` is the
+    signature captured at the previous compile; diffing against it names what
+    drifted since the program that was running."""
+
+    def __init__(self, fn: Callable, detector: "RecompileDetector", label: str):
+        self._fn = fn
+        self._detector = detector
+        self._label = label
+        self._last_sig: Optional[Dict[str, Any]] = None
+        self._compiles_seen = 0
+
+    def __call__(self, *args, **kwargs):
+        det = self._detector
+        before = self._cache_size()
+        out = self._fn(*args, **kwargs)
+        after = self._cache_size()
+        if before is None or after is None:
+            # no cache introspection (non-pjit callable, private-API drift):
+            # "unknown" must read as no-information, never as a compile —
+            # else every call would fire a spurious recompile warning
+            return out
+        if after > before:
+            sig = _tree_sig(args, kwargs, det.arg_names)
+            det._on_compile(self._label, self._last_sig, sig,
+                            first=(self._compiles_seen == 0), cache_size=after)
+            self._last_sig = sig
+            self._compiles_seen += 1
+        return out
+
+    def _cache_size(self) -> Optional[int]:
+        try:
+            return self._fn._cache_size()
+        except Exception:  # noqa: BLE001 - non-pjit callables (tests, shims)
+            return None
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def unwrap_jit(fn: Callable) -> Callable:
+    """The underlying jitted callable of a detector-wrapped fn (identity for
+    anything else) — for AOT paths (``.lower``/``make_jaxpr``) that should
+    not count their tracing as dispatch."""
+    return fn._fn if isinstance(fn, _WrappedJit) else fn
+
+
+class RecompileDetector:
+    """Tracks compiles across one or more wrapped jitted callables.
+
+    ``events`` keeps one record per compile (kind: initial/recompile/storm)
+    so tests and tooling can assert on detector state without scraping logs.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        arg_names: Optional[Sequence[str]] = None,
+        storm_threshold: int = 3,
+        storm_window_s: float = 60.0,
+        tracer=None,
+    ):
+        self.name = name
+        self.arg_names = tuple(arg_names) if arg_names else None
+        self.storm_threshold = max(int(storm_threshold), 2)
+        self.storm_window_s = float(storm_window_s)
+        self.compiles = 0
+        self.recompiles = 0
+        self.events: List[Dict[str, Any]] = []
+        self._recent: collections.deque = collections.deque(maxlen=self.storm_threshold)
+        self._storm_reported = False
+        if tracer is None:
+            from deepspeed_tpu.telemetry import get_tracer
+
+            tracer = get_tracer()
+        self._tracer = tracer
+
+    def wrap(self, fn: Callable, label: Optional[str] = None) -> Callable:
+        return _WrappedJit(fn, self, label or self.name)
+
+    # ------------------------------------------------------------------ hooks
+    def _on_compile(self, label: str, old_sig, new_sig, first: bool,
+                    cache_size: Optional[int]) -> None:
+        now = time.monotonic()
+        self.compiles += 1
+        self._tracer.count(f"recompile/{self.name}")
+        ev: Dict[str, Any] = {"label": label, "t": now, "cache_size": cache_size}
+        if first:
+            # the initial compile of a program is expected, not a defect
+            ev.update(kind="initial", diff=[])
+            self.events.append(ev)
+            logger.debug(f"[{self.name}] initial compile of {label}")
+            return
+        self.recompiles += 1
+        diff = diff_signatures(old_sig or {}, new_sig or {})
+        ev.update(kind="recompile", diff=diff)
+        self.events.append(ev)
+        detail = "; ".join(diff[:6]) if diff else (
+            "no argument shape/dtype change — weak types, donation, or "
+            "non-hashable static state are the usual suspects")
+        msg = (f"[{self.name}] RECOMPILE #{self.recompiles} of {label}"
+               + (f" (jit cache size {cache_size})" if cache_size else "")
+               + f": {detail}")
+        logger.warning(msg)
+        ev["message"] = msg
+        self._tracer.instant(f"recompile:{self.name}", cat="diagnostics",
+                             label=label, diff=diff[:6])
+        self._recent.append(now)
+        if (len(self._recent) == self.storm_threshold
+                and now - self._recent[0] <= self.storm_window_s):
+            if not self._storm_reported:
+                self._storm_reported = True
+                storm = (f"[{self.name}] recompile STORM: {self.storm_threshold} "
+                         f"recompiles within {now - self._recent[0]:.1f}s — every "
+                         "step is paying a compile; pad/bucket the varying input")
+                logger.error(storm)
+                self.events.append({"kind": "storm", "label": label, "t": now,
+                                    "message": storm})
+                self._tracer.instant(f"recompile_storm:{self.name}", cat="diagnostics",
+                                     label=label)
+        else:
+            self._storm_reported = False

@@ -65,6 +65,14 @@ class InferenceConfig(DeepSpeedConfigModel):
     # are selected via the ops registry rather than module swapping
     seq_bucket: int = 64  # pad prompt lengths up to a multiple (compile reuse)
     kv_cache_dtype: Optional[str] = None  # default: same as dtype
+    # Recompile detection (diagnostics/recompile.py) on the engine's jitted
+    # programs: the seq_bucket claim above ("recompiles are rare") is checked,
+    # not hoped — a recompile of an already-compiled program warns with the
+    # offending argument shape diff, and runaway bucket-cache growth warns
+    # too. Host-side, one cache-size check per call; disable to shave that.
+    recompile_warnings: bool = True
+    # distinct compiled generate programs before the cache-growth warning
+    max_generate_buckets: int = 16
 
     @property
     def jax_dtype(self) -> Any:

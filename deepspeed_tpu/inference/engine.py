@@ -32,7 +32,7 @@ from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig, causal
 from deepspeed_tpu.parallel.autotp import place_parameters
 from deepspeed_tpu.inference.ragged import _round_up
 from deepspeed_tpu.topology.mesh import build_mesh, set_mesh
-from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.logging import log_dist, logger
 
 
 class InferenceEngine:
@@ -128,7 +128,21 @@ class InferenceEngine:
                 p = dequantize_params(p, dtype)  # flax path needs plain arrays
             return self.module.apply({"params": p}, batch, train=False)
 
+        # Recompile detection (diagnostics/recompile.py): the seq_bucket
+        # design claims recompiles are rare — with the detector that claim is
+        # checked on every dispatch, and a violation names the argument that
+        # drifted (e.g. an unbucketed mask shape).
+        self._fwd_detector = self._gen_detector = None
+        if config.recompile_warnings:
+            from deepspeed_tpu.diagnostics.recompile import RecompileDetector
+
+            self._fwd_detector = RecompileDetector(
+                "inference.forward", arg_names=("params", "batch"))
+            self._gen_detector = RecompileDetector(
+                "inference.generate", arg_names=("params", "ids", "mask", "rng"))
         self._forward = jax.jit(fwd)
+        if self._fwd_detector is not None:
+            self._forward = self._fwd_detector.wrap(self._forward)
 
     # ------------------------------------------------------------------
     def close(self) -> None:
@@ -265,9 +279,24 @@ class InferenceEngine:
             return np.concatenate([ids, new], axis=1)
         key = (B, S_pad, max_new_tokens, tuple(sorted(sample_cfg.items())), eos_token_id, pad_token_id)
         if key not in self._generate_cache:
-            self._generate_cache[key] = self._build_generate(
+            gen_fn = self._build_generate(
                 B, S_pad, max_new_tokens, sample_cfg, eos_token_id, pad_token_id
             )
+            if self._gen_detector is not None:
+                # each bucket's first compile is expected (that IS the
+                # bucketing design); a compile after that on the same bucket
+                # is a real recompile and warns with the shape diff
+                gen_fn = self._gen_detector.wrap(
+                    gen_fn, label=f"generate[B={B},S={S_pad},new={max_new_tokens}]")
+                n = len(self._generate_cache) + 1
+                if n > self.config.max_generate_buckets:
+                    logger.warning(
+                        f"generate compile cache at {n} programs (> "
+                        f"max_generate_buckets={self.config.max_generate_buckets}):"
+                        " unbounded (B, S_pad, max_new_tokens) variety defeats "
+                        "the bucketing — coarsen seq_bucket or fix "
+                        "max_new_tokens")
+            self._generate_cache[key] = gen_fn
         rng = jax.random.PRNGKey(seed)
         new = np.asarray(self._generate_cache[key](self.params, jnp.asarray(padded), jnp.asarray(mask), rng))
         return np.concatenate([ids, new], axis=1)

@@ -352,6 +352,18 @@ class PipelineEngine(DeepSpeedTPUEngine):
         pp = mesh.shape["pp"] if mesh is not None else getattr(config.mesh_config, "pp", 1)
         spec = spec_from_pipeline_module(module, pp)
         super().__init__(model=spec, config=config, mesh=mesh, **kwargs)
+        # diagnostics ride the base engine (the pipelined loss is traced into
+        # the same fused step the health probes/recompile detector watch);
+        # stamp the pipeline topology into any crash dump's header so a
+        # post-mortem names the schedule, not just the mesh
+        if self.diagnostics is not None and self.diagnostics.flight_recorder is not None:
+            self.diagnostics.flight_recorder.set_context(
+                engine="pipeline",
+                pipeline_stages=pp,
+                num_layers=len(module.layer_specs),
+                num_microbatches=getattr(module, "num_microbatches", None),
+                virtual_stages=getattr(module, "virtual_stages", 1),
+            )
 
     def train_batch(self, batch: Any = None, data_iter: Optional[Any] = None):
         return super().train_batch(batch=batch, data_iter=data_iter)

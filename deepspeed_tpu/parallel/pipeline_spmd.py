@@ -151,7 +151,9 @@ def spmd_pipeline(
             lambda v: lax.psum(jnp.where(i == S - 1, v, jnp.zeros_like(v)), "pp"), out_buf
         )
 
-    return jax.shard_map(
+    from deepspeed_tpu.utils.compat import shard_map
+
+    return shard_map(
         run,
         mesh=mesh,
         axis_names={"pp"},
@@ -291,7 +293,9 @@ def spmd_pipeline_interleaved(
             lambda v: lax.psum(jnp.where(i == S - 1, v, jnp.zeros_like(v)), "pp"), out_buf
         )
 
-    return jax.shard_map(
+    from deepspeed_tpu.utils.compat import shard_map
+
+    return shard_map(
         run,
         mesh=mesh,
         axis_names={"pp"},

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.comm import comm as dist
+from deepspeed_tpu.utils.compat import axis_size as _axis_size_compat
 from deepspeed_tpu.ops.quant import dequantize_int8, quantize_int8
 
 DEFAULT_BLOCK = 2048
@@ -44,7 +45,7 @@ def quantized_reduce_scatter(grad: jax.Array, axis: str, block_size: int = DEFAU
     rank's reduced shard [N / world], averaged over ranks. Exact math:
     quantize per destination shard -> all_to_all -> dequantize -> mean.
     """
-    n = jax.lax.axis_size(axis)
+    n = _axis_size_compat(axis)
     flat = grad.reshape(-1)
     N = flat.shape[0]
     assert N % n == 0, f"grad numel {N} not divisible by axis size {n}"
@@ -87,7 +88,7 @@ def quantized_all_gather(x: jax.Array, axis: str, block_size: int = DEFAULT_BLOC
     # Gather the *padded* blocked buffers so per-rank block boundaries survive.
     vals_g = dist.all_gather(vals.reshape(1, M_p), axis, concat_axis=0)  # [n, M_p]
     scales_g = dist.all_gather(scales.reshape(1, -1), axis, concat_axis=0)
-    n = jax.lax.axis_size(axis)
+    n = _axis_size_compat(axis)
     deq = dequantize_int8(
         vals_g.reshape(-1), scales_g.reshape(-1), (n, M_p), dtype=x.dtype,
         block_size=block,

@@ -23,7 +23,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from deepspeed_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deepspeed_tpu.topology.mesh import get_mesh
@@ -167,6 +167,9 @@ def ring_attention(
         local, mesh=mesh,
         in_specs=(spec_q, spec_q, spec_q),
         out_specs=spec_q,
+        # the masked-hop lax.cond trips 0.4.x replication checking (upstream
+        # suggests exactly this flag); the math is replication-safe
+        check_vma=False,
     )
     return fn(q, k, v)
 
@@ -308,5 +311,6 @@ def _ring_zigzag(q, k, v, mesh, axis: str, P_ring: int, slopes2=None):
 
     batch_axes = _live_batch_axes(mesh)
     spec = P(batch_axes, axis, None, None)
-    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                   check_vma=False)
     return fn(q, k, v)

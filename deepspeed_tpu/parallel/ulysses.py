@@ -105,7 +105,7 @@ class DistributedAttention:
                 "use the constraint-based ulysses_shard path for uneven heads"
             )
 
-        from jax import shard_map
+        from deepspeed_tpu.utils.compat import shard_map
 
         batch_axes = _live_batch_axes(mesh)
         in_spec = P(batch_axes, "sp", None, None)

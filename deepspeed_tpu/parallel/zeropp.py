@@ -73,7 +73,9 @@ def leaf_comm_plan(spec: Optional[PartitionSpec], live_axes: Tuple[str, ...]) ->
 
 
 def _axis_size(axes) -> int:
-    return int(np.prod([jax.lax.axis_size(a) for a in (axes if isinstance(axes, tuple) else (axes,))]))
+    from deepspeed_tpu.utils.compat import axis_size
+
+    return axis_size(axes if isinstance(axes, tuple) else (axes,))
 
 
 def _int8_all_gather_dim(x: jax.Array, dim: int, axes, block: int) -> jax.Array:

@@ -147,6 +147,24 @@ class ProfileResult:
             "per_op_flops": dict(self.per_op_flops),
         }
 
+    def publish_to_telemetry(self, tracer=None) -> None:
+        """Feed achieved-TFLOPS/MFU into the shared ``MetricsRegistry`` so
+        MFU rides the same trace (Perfetto counter tracks), CSV/monitor
+        scalars, and flight-recorder dumps as step time and comm bytes.
+        No-op when telemetry is disabled (the zero-overhead contract)."""
+        if tracer is None:
+            from deepspeed_tpu.telemetry import get_tracer
+
+            tracer = get_tracer()
+        if not tracer.enabled:
+            return
+        # sample_counter = registry gauge + a plotted Perfetto counter track
+        tracer.sample_counter("flops/mfu", self.mfu)
+        tracer.sample_counter("flops/achieved_tflops", self.achieved_tflops)
+        tracer.registry.gauge("flops/flops_per_step").set(self.flops_per_step)
+        tracer.registry.gauge("flops/step_latency_ms").set(self.latency_s * 1e3)
+        tracer.registry.gauge("flops/bytes_accessed").set(self.bytes_accessed)
+
 
 def get_model_profile(fn: Callable, *args, warmup: int = 1, iters: int = 3,
                       params: Any = None, peak_tflops: Optional[float] = None,
@@ -201,7 +219,7 @@ def get_model_profile(fn: Callable, *args, warmup: int = 1, iters: int = 3,
         # jaxpr-derived matmul/conv count (a lower bound on true flops)
         flops = float(sum(per_op.values()))
     achieved = flops / latency / 1e12 if latency > 0 else 0.0
-    return ProfileResult(
+    result = ProfileResult(
         flops_per_step=flops,
         bytes_accessed=bytes_accessed,
         params=n_params,
@@ -210,6 +228,8 @@ def get_model_profile(fn: Callable, *args, warmup: int = 1, iters: int = 3,
         mfu=(achieved / peak if peak else 0.0),
         per_op_flops=per_op,
     )
+    result.publish_to_telemetry()
+    return result
 
 
 class FlopsProfiler:
@@ -249,7 +269,10 @@ class FlopsProfiler:
         """
         e = self.engine
         state = e.state
-        compiled = e._train_step.lower(state, batch).compile()
+        from deepspeed_tpu.diagnostics.recompile import unwrap_jit
+
+        step_fn = unwrap_jit(e._train_step)  # AOT path: don't count the trace
+        compiled = step_fn.lower(state, batch).compile()
         costs = _costs_of(compiled)
         flops = float(costs.get("flops", 0.0))
 
@@ -262,7 +285,7 @@ class FlopsProfiler:
 
         n_dev = max(e.mesh.size, 1)
         try:
-            per_op = {k: v // n_dev for k, v in flops_by_op(e._train_step, state, batch).items()}
+            per_op = {k: v // n_dev for k, v in flops_by_op(step_fn, state, batch).items()}
         except Exception as ex:  # noqa: BLE001 - breakdown is best-effort
             logger.debug(f"per-op flop breakdown unavailable: {ex}")
             per_op = {}
@@ -280,6 +303,7 @@ class FlopsProfiler:
             mfu=(achieved / peak if peak else 0.0),
             per_op_flops=per_op,
         )
+        self.result.publish_to_telemetry()
         self._armed = False
         return new_state, metrics
 

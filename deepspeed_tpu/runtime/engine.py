@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional, Tuple
 
 import jax
@@ -66,6 +67,10 @@ class TrainState(NamedTuple):
     # residuals, leaves shaped [dp_world, *param.shape] sharded on dim 0
     # (reference runtime/comm/nccl.py worker_error).
     comm_error: Any = None
+    # Training-health EMA state (diagnostics/health.py HealthState) — None
+    # unless the diagnostics block enables in-step health probes, so the
+    # disabled path compiles the identical program.
+    health: Any = None
 
 
 class DeepSpeedTPUEngine:
@@ -156,6 +161,10 @@ class DeepSpeedTPUEngine:
         # ---- state init + placement --------------------------------------
         self._init_state(model_parameters, seed)
 
+        # ---- diagnostics (before step compilation: the health probes trace
+        # into the step and the recompile detector wraps the jitted fns) ----
+        self._setup_diagnostics()
+
         # ---- data --------------------------------------------------------
         self.training_dataloader = None
         if training_data is not None:
@@ -166,13 +175,18 @@ class DeepSpeedTPUEngine:
             # Split program: device grad accumulation + compiled host update
             # (the DeepSpeedCPUAdam analog). ``_train_step`` stays None.
             self._train_step = None
-            self._offload_grad_step = self._build_offload_grad_step()
+            self._offload_grad_step = self._wrap_jit(
+                "offload_grad_step", self._build_offload_grad_step(),
+                ("params", "batch", "scale", "rng"))
             if self._twin_ratio is not None:
                 self._build_twin_flow_steps()
             else:
-                self._offload_update_step = self._build_offload_update_step()
+                self._offload_update_step = self._wrap_jit(
+                    "offload_update_step", self._build_offload_update_step(),
+                    ("state", "grads"))
         else:
-            self._train_step = self._build_train_step()
+            self._train_step = self._wrap_jit(
+                "train_step", self._build_train_step(), ("state", "batch"))
         self._grad_step = None  # built lazily for the forward/backward/step path
         self._apply_step = None
         self._eval_step = None
@@ -389,6 +403,70 @@ class DeepSpeedTPUEngine:
             f"ZeRO-Offload enabled: mode={self.offload_mode} device={dev}"
             + (f" twin_flow_ratio={ratio}" if self._twin_ratio is not None else ""),
             ranks=[0])
+
+    # --------------------------------------------------------- diagnostics
+    def _setup_diagnostics(self) -> None:
+        """Build the DiagnosticsManager (``diagnostics`` config block) and
+        fold the health-probe EMA state into the train state.
+
+        Runs AFTER ``_init_state`` (it extends state/state_sharding) and
+        BEFORE step compilation (the probes trace into the step; the
+        recompile detector wraps the jitted callables). Disabled => the
+        engine keeps ``diagnostics = None``, ``state.health = None``, and
+        compiles a program identical to the no-diagnostics build."""
+        self.diagnostics = None
+        self._health = None
+        dcfg = self.config.model.diagnostics
+        if not dcfg.enabled:
+            return
+        from deepspeed_tpu.diagnostics.manager import DiagnosticsManager
+
+        self.diagnostics = DiagnosticsManager(dcfg, fp16=self.fp16)
+        if self._twin_ratio is not None and self.diagnostics.health is not None:
+            # A silently-dead knob is worse than a warning (the
+            # prescale_gradients stance): the Twin-Flow split update bypasses
+            # the shared update math the probes live in.
+            logger.warning(
+                "diagnostics.health is not wired into the Twin-Flow split "
+                "update (offload_optimizer.ratio < 1): health probes disabled "
+                "for this engine; recompile/step-time/flight-recorder stay on")
+            self.diagnostics.health = None
+        self._health = self.diagnostics.health
+        if self._health is not None:
+            if self.offload_mode in ("host-jit", "nvme"):
+                from jax.sharding import SingleDeviceSharding
+
+                sh = SingleDeviceSharding(self._host_device)
+            else:
+                sh = NamedSharding(self.mesh, PartitionSpec())
+            hstate = jax.device_put(self._health.init_state(), sh)
+            self.state = self.state._replace(health=hstate)
+            self.state_sharding = self.state_sharding._replace(
+                health=jax.tree_util.tree_map(lambda _: sh, hstate))
+        if self.diagnostics.flight_recorder is not None:
+            self.diagnostics.flight_recorder.set_context(
+                mesh=dict(self.mesh.shape),
+                zero_stage=self.zero_config.stage,
+                dtype=self.compute_dtype.__name__,
+                train_batch_size=self.config.train_batch_size,
+                gradient_accumulation_steps=self.config.gradient_accumulation_steps,
+                offload_mode=self.offload_mode,
+            )
+        log_dist(
+            "diagnostics enabled: health="
+            + (",".join(f"{s}={p}" for s, p in self._health.policies.items())
+               if self._health else "off")
+            + f" recompile={dcfg.recompile.enabled}"
+            + f" step_time={dcfg.step_time.enabled}"
+            + f" flight_recorder={dcfg.flight_recorder.enabled}",
+            ranks=[0])
+
+    def _wrap_jit(self, name: str, fn: Callable, arg_names=None) -> Callable:
+        """Recompile-detector wrap for a jitted callable (identity when
+        diagnostics/recompile checking is off)."""
+        if self.diagnostics is None:
+            return fn
+        return self.diagnostics.wrap_jit(name, fn, arg_names=arg_names)
 
     @staticmethod
     def _build_engine_mesh(config) -> Mesh:
@@ -798,7 +876,7 @@ class DeepSpeedTPUEngine:
         )
         batch_spec = PartitionSpec(live if len(live) > 1 else live[0])
 
-        from jax import shard_map
+        from deepspeed_tpu.utils.compat import shard_map
 
         if loco:
             err_beta = float(loco.get("err_beta", 0.8))
@@ -904,7 +982,7 @@ class DeepSpeedTPUEngine:
     def _build_onebit_fn(self, live) -> Callable:
         """shard_map program: local grad accumulation + sign-compressed exact-
         mean allreduce with error feedback (parallel/onebit.py)."""
-        from jax import shard_map
+        from deepspeed_tpu.utils.compat import shard_map
 
         from deepspeed_tpu.parallel import onebit as onebit_mod
 
@@ -996,19 +1074,24 @@ class DeepSpeedTPUEngine:
                 grads, new_err, losses = ob_fn(
                     compute_params, batch, scale, inv, jax.random.key_data(step_rng), state.comm_error
                 )
+                loss_mean = jnp.mean(losses.astype(jnp.float32))
                 new_state, metrics = self._update_math(
-                    state, grads, jax.random.key_data(rng), grads_are_unscaled=True
+                    state, grads, jax.random.key_data(rng), grads_are_unscaled=True,
+                    loss=loss_mean,
                 )
                 # fp16 overflow: a non-finite step would store NaN residuals
                 # and poison every later step — keep the previous buffers
                 # (the reference skips its error-feedback update on overflow
-                # the same way).
+                # the same way). A health-policy skip keeps them too: the
+                # residual update belongs to an update that never applied.
                 keep = ~metrics["overflow"]
+                if "health/skip" in metrics:
+                    keep = keep & ~metrics["health/skip"]
                 new_err = jax.tree_util.tree_map(
                     lambda n, o: jnp.where(keep, n, o), new_err, state.comm_error
                 )
                 new_state = new_state._replace(comm_error=new_err)
-                metrics["loss"] = jnp.mean(losses.astype(jnp.float32))
+                metrics["loss"] = loss_mean
                 return new_state, metrics
 
             if zpp_fn is not None:
@@ -1075,13 +1158,18 @@ class DeepSpeedTPUEngine:
                     (grads, new_err, _), losses = jax.lax.scan(
                         micro_step_loco, (zero_grads, err0, 0), batch)
 
-                new_state, metrics = self._update_math(state, grads, jax.random.key_data(rng))
-                # overflow => keep the previous residuals (as the 1-bit path)
+                loss_mean = jnp.mean(losses.astype(jnp.float32))
+                new_state, metrics = self._update_math(
+                    state, grads, jax.random.key_data(rng), loss=loss_mean)
+                # overflow/health skip => keep the previous residuals (as the
+                # 1-bit path)
                 keep = ~metrics["overflow"]
+                if "health/skip" in metrics:
+                    keep = keep & ~metrics["health/skip"]
                 new_err = jax.tree_util.tree_map(
                     lambda n, o: jnp.where(keep, n, o), new_err, state.comm_error)
                 new_state = new_state._replace(comm_error=new_err)
-                metrics["loss"] = jnp.mean(losses.astype(jnp.float32))
+                metrics["loss"] = loss_mean
                 return new_state, metrics
 
             if gas == 1:
@@ -1090,8 +1178,10 @@ class DeepSpeedTPUEngine:
             else:
                 (grads, _), losses = jax.lax.scan(micro_step, (zero_grads, 0), batch)
 
-            new_state, metrics = self._update_math(state, grads, jax.random.key_data(rng))
-            metrics["loss"] = jnp.mean(losses.astype(jnp.float32))
+            loss_mean = jnp.mean(losses.astype(jnp.float32))
+            new_state, metrics = self._update_math(
+                state, grads, jax.random.key_data(rng), loss=loss_mean)
+            metrics["loss"] = loss_mean
             return new_state, metrics
 
         return jax.jit(
@@ -1102,12 +1192,16 @@ class DeepSpeedTPUEngine:
         )
 
     def _update_math(self, state: TrainState, grads, new_rng_data,
-                     grads_are_unscaled: bool = False) -> Tuple[TrainState, Dict[str, Any]]:
+                     grads_are_unscaled: bool = False,
+                     loss: Any = None) -> Tuple[TrainState, Dict[str, Any]]:
         """Scale / clip / optimizer update / overflow-skip / loss-scale step.
 
         The ONE copy of the update semantics, traced into the fused step, the
         forward/backward/step apply program, and the offload host program —
-        so the three paths cannot drift (reference ``FP16_Optimizer.step``)."""
+        so the three paths cannot drift (reference ``FP16_Optimizer.step``).
+        ``loss`` (optional step-mean loss) feeds the loss-spike health probe
+        on paths that have it (the fused step; the offload host program and
+        the apply path receive gradients only)."""
         gas = self.config.gradient_accumulation_steps
         clip = self.config.gradient_clipping
         fp16_cfg = self.config.model.fp16
@@ -1122,18 +1216,31 @@ class DeepSpeedTPUEngine:
             grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
         finite = all_finite(grads) if self.fp16 else jnp.asarray(True)
         gnorm = global_norm(grads)
+        # Health probes (diagnostics/health.py) on the raw unscaled/unclipped
+        # gradients — extends the finite/gnorm this step already computes,
+        # never a second fetch. skip_step-policy signals gate the update off
+        # inside the program, exactly like the fp16 overflow skip.
+        health_metrics: Dict[str, Any] = {}
+        new_health = state.health
+        apply_ok = finite
+        if self._health is not None and state.health is not None:
+            new_health, health_metrics, hskip, _habort = self._health.probe(
+                state.health, grads, gnorm, loss=loss, finite=finite)
+            apply_ok = finite & ~hskip
         if clip and clip > 0:
             grads, gnorm = clip_by_global_norm(grads, clip, norm=gnorm)
 
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
 
-        # overflow => skip the update (reference FP16_Optimizer.step overflow path)
+        # overflow / unhealthy => skip the update (reference
+        # FP16_Optimizer.step overflow path, extended to health verdicts)
         def sel(new, old):
-            return jax.tree_util.tree_map(lambda n, o: jnp.where(finite, n, o), new, old)
+            return jax.tree_util.tree_map(lambda n, o: jnp.where(apply_ok, n, o), new, old)
 
         new_ls, new_step, metrics = self._post_update_bookkeeping(
-            finite, gnorm, state.step, state.loss_scale)
+            finite, gnorm, state.step, state.loss_scale, apply_ok=apply_ok)
+        metrics.update(health_metrics)
         new_state = TrainState(
             step=new_step,
             params=sel(new_params, state.params),
@@ -1141,16 +1248,24 @@ class DeepSpeedTPUEngine:
             loss_scale=new_ls,
             rng=new_rng_data,
             comm_error=state.comm_error,
+            health=new_health,
         )
         return new_state, metrics
 
-    def _post_update_bookkeeping(self, finite, gnorm, step, ls_state):
+    def _post_update_bookkeeping(self, finite, gnorm, step, ls_state, apply_ok=None):
         """Loss-scale advance + step counter + step metrics — shared by
         ``_update_math`` (fused / host-jit / apply paths) AND the Twin-Flow
         host program, so the overflow/bookkeeping semantics cannot drift
-        between full and partial offload."""
+        between full and partial offload.
+
+        ``apply_ok`` (default ``finite``) is whether the update actually
+        applied — a health-policy skip advances neither the step counter nor
+        the loss scale's notion of success... the loss scale stays keyed on
+        ``finite`` alone: a healthy-but-skipped step is not an fp16 overflow
+        and must not shrink the scale."""
         fp16_cfg = self.config.model.fp16
         dynamic = self.fp16 and fp16_cfg.dynamic
+        apply_ok = finite if apply_ok is None else apply_ok
         new_ls = update_loss_scale(
             ls_state,
             finite,
@@ -1160,7 +1275,7 @@ class DeepSpeedTPUEngine:
             init_hysteresis=fp16_cfg.hysteresis,
             consecutive_hysteresis=fp16_cfg.consecutive_hysteresis,
         ) if self.fp16 else ls_state
-        new_step = step + jnp.where(finite, 1, 0).astype(jnp.int32)
+        new_step = step + jnp.where(apply_ok, 1, 0).astype(jnp.int32)
         metrics = {
             "grad_norm": gnorm,
             "lr": jnp.asarray(self.lr_scheduler_fn(step), jnp.float32),
@@ -1354,6 +1469,7 @@ class DeepSpeedTPUEngine:
             loss_scale=new_ls,
             rng=new_rng,
             comm_error=state.comm_error,
+            health=state.health,
         )
         return metrics
 
@@ -1544,6 +1660,9 @@ class DeepSpeedTPUEngine:
         fp_cfg = prof.config
         config_fire = (fp_cfg.enabled and prof.result is None
                        and self._batch_count >= fp_cfg.profile_step)
+        # step wall-clock for the anomaly detector (same honesty caveat as the
+        # spans: dispatch time under async dispatch unless sync_spans drains)
+        diag_t0 = time.perf_counter() if self.diagnostics is not None else None
         if self._train_step is None:  # offload split path
             if (prof.armed or config_fire) and not getattr(self, "_offload_prof_warned", False):
                 logger.warning(
@@ -1580,12 +1699,23 @@ class DeepSpeedTPUEngine:
         self.losses = metrics["loss"]
         self._batch_count += 1
         step = self._batch_count
+        if self.diagnostics is not None:
+            # flight-recorder ring append (device refs, no fetch) + step-time
+            # anomaly observe + the abort-policy check (which may raise)
+            self.diagnostics.after_step(
+                step, metrics, step_time_s=time.perf_counter() - diag_t0)
         if self.monitor is not None:
             scalars = {
                 "Train/loss": metrics["loss"],
                 "Train/lr": metrics["lr"],
                 **({"Train/loss_scale": metrics["loss_scale"]} if self.fp16 else {}),
             }
+            if self._health is not None:
+                scalars.update({
+                    f"Health/{k[len('health/'):]}": metrics[k]
+                    for k in ("health/skip", "health/grad_zscore",
+                              "health/nonfinite_total")
+                    if k in metrics})
             if self._tracer.enabled:
                 # host-side floats only (counter deltas, memory watermarks,
                 # last phase wall times) — never a device fetch
@@ -1640,13 +1770,17 @@ class DeepSpeedTPUEngine:
                     loss, aux = self._loss_and_aux(params, batch, jax.random.wrap_key_data(rng))
                     return (loss, *aux) if aux else loss
 
-                self._eval_step = jax.jit(eval_fn)
+                self._eval_step = self._wrap_jit(
+                    "eval_step", jax.jit(eval_fn), ("params", "batch", "rng"))
             else:
                 def eval_fn(params, batch, rng):
                     loss, aux = self._loss_and_aux(self._compute_params(params), batch, jax.random.wrap_key_data(rng))
                     return (loss, *aux) if aux else loss
 
-                self._eval_step = jax.jit(eval_fn, in_shardings=(self.param_sharding, None, None))
+                self._eval_step = self._wrap_jit(
+                    "eval_step",
+                    jax.jit(eval_fn, in_shardings=(self.param_sharding, None, None)),
+                    ("params", "batch", "rng"))
         placed = self._place_batch(jax.tree_util.tree_map(jnp.asarray, batch))
         self._last_batch = placed
         if offload_split:
@@ -1717,9 +1851,14 @@ class DeepSpeedTPUEngine:
                     return loss, grads
 
             if offload_split:
-                self._grad_step = jax.jit(micro_grads)
+                self._grad_step = self._wrap_jit(
+                    "grad_step", jax.jit(micro_grads),
+                    ("params", "scale", "batch", "rng"))
             else:
-                self._grad_step = jax.jit(micro_grads, in_shardings=(self.param_sharding, None, None, None))
+                self._grad_step = self._wrap_jit(
+                    "grad_step",
+                    jax.jit(micro_grads, in_shardings=(self.param_sharding, None, None, None)),
+                    ("params", "scale", "batch", "rng"))
             self._accum_add = jax.jit(
                 lambda a, b: jax.tree_util.tree_map(jnp.add, a, b), donate_argnums=(0, 1)
             )
@@ -1752,7 +1891,8 @@ class DeepSpeedTPUEngine:
             metrics = self._offload_apply_update(self._swapped_in_state(), self._pending_grads)
         else:
             if self._apply_step is None:
-                self._apply_step = self._build_apply_step()
+                self._apply_step = self._wrap_jit(
+                    "apply_step", self._build_apply_step(), ("state", "grads"))
             self.state, metrics = self._apply_step(self.state, self._pending_grads)
         metrics = {k: np.asarray(v) for k, v in metrics.items()}
         if self._pending_losses:

@@ -94,7 +94,9 @@ def sparse_embedding_grad_allreduce(ids: jax.Array, g_x: jax.Array,
         gids, grows = sparse_allgather_rows(fids, rows, axis)
         return scatter_rows(gids, grows, vocab_size, mean_over=dp)
 
-    return jax.shard_map(
+    from deepspeed_tpu.utils.compat import shard_map
+
+    return shard_map(
         f, mesh=mesh, axis_names={axis},
         in_specs=(P(axis), P(axis)), out_specs=P(),
         check_vma=False,
@@ -170,15 +172,19 @@ def _sparse_lookup_bwd(token_axes, res, g):
     manual = set(getattr(mesh_lib.get_abstract_mesh(), "manual_axes", ()) or ())
     bound = tuple(a for a in token_axes if a in manual)
     if bound:
+        from deepspeed_tpu.utils.compat import axis_size
+
         world = 1
         for ax in bound:
-            world *= jax.lax.axis_size(ax)
+            world *= axis_size(ax)
         dense = gather_scatter(ids, g, bound) / world
         return dense.astype(table.dtype), ids_zero
 
     batch_axes = tuple(a for a in token_axes if a != "sp") or None
     seq_axis = "sp" if "sp" in token_axes else None
-    dense = jax.shard_map(
+    from deepspeed_tpu.utils.compat import shard_map
+
+    dense = shard_map(
         lambda i, gg: gather_scatter(i, gg, token_axes),
         mesh=get_mesh(),
         in_specs=(P(batch_axes, seq_axis), P(batch_axes, seq_axis, None)),

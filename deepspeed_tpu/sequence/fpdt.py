@@ -172,7 +172,9 @@ def _fpdt_fwd(q, k, v, slopes, Cq, Ck, causal, q_offset, offload):
     if offload:
         # big residuals park in (pinned) host memory until the backward —
         # sharding-preserving transfers, safe under the SPMD partitioner
-        host = lambda x: jax.device_put(x, jax.memory.Space.Host)  # noqa: E731
+        from deepspeed_tpu.utils.compat import memory_space
+
+        host = lambda x: jax.device_put(x, memory_space("host"))  # noqa: E731
         return out, (host(q), host(k), host(v), slopes, host(out), lses)
     return out, (q, k, v, slopes, out, lses)
 
@@ -180,7 +182,9 @@ def _fpdt_fwd(q, k, v, slopes, Cq, Ck, causal, q_offset, offload):
 def _fpdt_bwd(Cq, Ck, causal, q_offset, offload, res, dout):
     q, k, v, slopes, out, lses = res      # lses [nq, B, Hkv, G, Cq]
     if offload:
-        dev = lambda x: jax.device_put(x, jax.memory.Space.Device)  # noqa: E731
+        from deepspeed_tpu.utils.compat import memory_space
+
+        dev = lambda x: jax.device_put(x, memory_space("device"))  # noqa: E731
         q, k, v, out = dev(q), dev(k), dev(v), dev(out)
     qg, kc, vc, slopes2, (B, Sq, H, D, Sk, Hkv, G, nq, nk) = \
         _fpdt_prep(q, k, v, slopes, Cq, Ck)

@@ -17,10 +17,17 @@ Enable via the ``telemetry`` config block (see ``config/config.py``) or the
 ``DSTPU_TELEMETRY=1`` env var; export dir defaults to ``DSTPU_TELEMETRY_DIR``
 (else ``./telemetry_out``). Disabled (the default) every hook is a single
 attribute check — zero measurable overhead. See ``docs/telemetry.md``.
+
+What WATCHES these streams lives in ``deepspeed_tpu/diagnostics`` (health
+probes, recompile detection, step-time anomaly flags, crash flight recorder)
+— it shares this registry, so its ``health/``, ``recompile/``, ``anomaly/``,
+and ``flops/`` metrics ride the same monitor/export paths. See
+``docs/diagnostics.md``.
 """
 
 from deepspeed_tpu.telemetry.exporters import (
     chrome_trace_events,
+    default_output_dir,
     export_chrome_trace,
     export_jsonl,
 )
@@ -49,6 +56,7 @@ __all__ = [
     "Tracer",
     "chrome_trace_events",
     "configure",
+    "default_output_dir",
     "enabled",
     "env_enabled",
     "export_chrome_trace",

@@ -18,6 +18,14 @@ import os
 from typing import Any, Dict, List, Optional
 
 
+def default_output_dir() -> str:
+    """The ONE resolution of the telemetry artifact directory (trace/JSONL
+    exports, flight-recorder dumps, bench telemetry): $DSTPU_TELEMETRY_DIR,
+    else ./telemetry_out. Counterpart of ``tracer.env_enabled`` — don't
+    re-implement the default at call sites."""
+    return os.environ.get("DSTPU_TELEMETRY_DIR", "telemetry_out")
+
+
 def _resolve(tracer) -> Any:
     if tracer is None:
         from deepspeed_tpu.telemetry.tracer import get_tracer
@@ -87,7 +95,7 @@ def export_chrome_trace(path: Optional[str] = None, tracer=None) -> str:
     """Write the Chrome trace JSON; returns the path written."""
     tracer = _resolve(tracer)
     path = path or tracer.trace_path or os.path.join(
-        os.environ.get("DSTPU_TELEMETRY_DIR", "telemetry_out"), "trace.json")
+        default_output_dir(), "trace.json")
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "w") as f:
@@ -99,7 +107,7 @@ def export_jsonl(path: Optional[str] = None, tracer=None) -> str:
     """Write one JSON object per event; returns the path written."""
     tracer = _resolve(tracer)
     path = path or tracer.jsonl_path or os.path.join(
-        os.environ.get("DSTPU_TELEMETRY_DIR", "telemetry_out"), "events.jsonl")
+        default_output_dir(), "events.jsonl")
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     pid = os.getpid()

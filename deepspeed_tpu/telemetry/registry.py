@@ -141,6 +141,10 @@ class MetricsRegistry:
         with self._lock:
             return {n: c.value for n, c in self._counters.items()}
 
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return {n: g.value for n, g in self._gauges.items()}
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()

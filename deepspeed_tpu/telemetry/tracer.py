@@ -247,9 +247,14 @@ class Tracer:
 
     def step_scalars(self, prefix: str = "Telemetry/") -> Dict[str, float]:
         """Per-step scalars for the ``MonitorMaster``: counter deltas since
-        the previous call (comm bytes/counts...), memory watermarks, and the
-        last completed step-phase wall times. All host-side floats — never
-        blocks the dispatch pipeline."""
+        the previous call, gauge samples (flops/MFU, anomaly flags...),
+        memory watermarks, and the last completed step-phase wall times. All
+        host-side floats — never blocks the dispatch pipeline.
+
+        Caveat on ``comm/*`` counters: the facade records collectives at
+        TRACE time (one bump per compiled program, not per execution), so
+        their deltas spike on compile steps and read 0 in steady state —
+        they chart recompile/compile activity, not per-step wire volume."""
         if not self.enabled:
             return {}
         out: Dict[str, float] = {}
@@ -257,6 +262,11 @@ class Tracer:
             delta = value - self._last_counts.get(name, 0.0)
             self._last_counts[name] = value
             out[prefix + name] = float(delta)
+        for name, value in self.registry.gauges().items():
+            # gauges are last-write samples (flops/MFU, anomaly/ flags...);
+            # mem/ gauges are refreshed + emitted by sample_memory below
+            if not name.startswith("mem/"):
+                out[prefix + name] = float(value)
         for k, v in self.sample_memory().items():
             out[f"{prefix}mem/{k}"] = v
         for phase in ("train_batch", "data", "step", "fwd_bwd", "fwd", "bwd"):

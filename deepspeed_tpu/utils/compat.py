@@ -1,0 +1,108 @@
+"""Version compatibility shims for the jax API surface.
+
+``shard_map`` is the one symbol this package needs whose location AND
+signature moved across jax releases:
+
+  - new jax exports ``jax.shard_map(f, mesh=..., in_specs=..., out_specs=...,
+    axis_names=..., check_vma=...)`` as a function
+  - some intermediate versions expose ``jax.shard_map`` as a MODULE holding
+    the function
+  - jax 0.4.x (this environment) only has
+    ``jax.experimental.shard_map.shard_map(f, mesh, in_specs, out_specs,
+    check_rep=..., auto=...)`` — no ``axis_names``/``check_vma`` kwargs
+
+Every call site in the package imports ``shard_map`` from HERE and writes the
+new-API spelling; this wrapper translates to whatever the installed jax
+understands (``check_vma`` -> ``check_rep``; ``axis_names={manual}`` ->
+``auto = mesh_axes - manual``). A tier-1 lint (tests/unit/
+test_no_bare_shard_map.py) greps the tree so bare ``jax.shard_map`` /
+``from jax import shard_map`` imports cannot regress.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+
+def _resolve_native() -> Optional[Callable]:
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None and not callable(sm):  # module-valued on some versions
+        sm = getattr(sm, "shard_map", None)
+    return sm
+
+
+_NATIVE = _resolve_native()
+if _NATIVE is None:
+    from jax.experimental.shard_map import shard_map as _EXPERIMENTAL
+else:
+    _EXPERIMENTAL = None
+
+
+def axis_size(axis) -> int:
+    """``jax.lax.axis_size`` with the pre-0.5 fallback (the same idiom as the
+    comm facade's ``_axis_size``): a unit psum over a bound axis is statically
+    the axis size at trace time. Accepts an axis name or a tuple of them."""
+    if isinstance(axis, (tuple, list)):
+        out = 1
+        for a in axis:
+            out *= axis_size(a)
+        return out
+    try:
+        return int(jax.lax.axis_size(axis))
+    except (AttributeError, TypeError):
+        return int(jax.lax.psum(1, axis))
+
+
+def memory_space(space: str):
+    """A ``jax.device_put`` target selecting host vs device memory.
+
+    New jax spells it ``jax.memory.Space.Host/Device``; 0.4.x spells it
+    ``TransferToMemoryKind('pinned_host'|'device')``. Both work inside jit
+    (sharding-preserving memory-kind transfer)."""
+    mem = getattr(jax, "memory", None)
+    if mem is not None:
+        return mem.Space.Host if space == "host" else mem.Space.Device
+    from jax._src.sharding_impls import TransferToMemoryKind
+
+    return TransferToMemoryKind("pinned_host" if space == "host" else "device")
+
+
+def shard_map(
+    f: Callable,
+    *,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    axis_names: Any = None,
+    check_vma: Optional[bool] = None,
+    check_rep: Optional[bool] = None,
+) -> Callable:
+    """``jax.shard_map`` with the NEW keyword surface on every jax version.
+
+    ``axis_names``: the axes the body handles manually (default: all mesh
+    axes). ``check_vma`` (new spelling) and ``check_rep`` (old spelling) are
+    the same knob; pass at most one.
+    """
+    if check_vma is not None and check_rep is not None:
+        raise TypeError("pass only one of check_vma / check_rep")
+    check = check_vma if check_vma is not None else check_rep
+
+    if _NATIVE is not None:
+        kwargs: dict = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        if check is not None:
+            kwargs["check_vma"] = check
+        return _NATIVE(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+    kwargs = {}
+    if check is not None:
+        kwargs["check_rep"] = check
+    if axis_names is not None:
+        manual = set(axis_names)
+        auto = frozenset(a for a in mesh.axis_names if a not in manual)
+        if auto:
+            kwargs["auto"] = auto
+    return _EXPERIMENTAL(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

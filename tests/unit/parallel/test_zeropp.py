@@ -144,7 +144,7 @@ def test_loco_error_feedback_beats_plain_qgz(devices):
     """The EF property (reference all_to_all_loco_quant_reduce): repeatedly
     reducing the SAME gradient, the loco running sum tracks the exact sum with
     bounded error, while plain qgZ accumulates its quantization bias linearly."""
-    from jax import shard_map
+    from deepspeed_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from deepspeed_tpu.parallel.zeropp import (

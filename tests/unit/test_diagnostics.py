@@ -1,0 +1,393 @@
+"""Diagnostics subsystem tests (health policies, recompile detector,
+step-time anomaly, flight recorder, disabled no-op contract).
+
+Default tier: like telemetry, the diagnostics contract is what every future
+reliability claim leans on, so it stays under the cheap sweep. Engine-level
+tests use the SimpleMLP fixture on the 8-device CPU mesh; NaN injection goes
+through the batch (a NaN input poisons the whole backward), matching how a
+bad shard poisons a real run.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.diagnostics import (
+    FlightRecorder,
+    RecompileDetector,
+    StepTimeAnomalyDetector,
+    TrainingHealthError,
+)
+from deepspeed_tpu.telemetry import get_tracer
+from tests.unit.simple_model import random_batch, simple_model_spec
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_tracer():
+    tr = get_tracer()
+    tr.configure(enabled=False)
+    tr.trace_path = None
+    tr.jsonl_path = None
+    tr.reset()
+    yield
+    tr.configure(enabled=False)
+    tr.trace_path = None
+    tr.jsonl_path = None
+    tr.reset()
+
+
+def _engine(diag=None, extra=None):
+    eng, *_ = deepspeed_tpu.initialize(
+        model=simple_model_spec(),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10_000,
+            **({"diagnostics": diag} if diag else {}),
+            **(extra or {}),
+        },
+    )
+    return eng
+
+
+def _poisoned(batch):
+    bad = {k: np.array(v, copy=True) for k, v in batch.items()}
+    bad["x"][0, 0] = np.nan
+    return bad
+
+
+def _params(eng):
+    return jax.device_get(eng.state.params)
+
+
+def _same(a, b):
+    return all(np.array_equal(x, y) for x, y in
+               zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)))
+
+
+# ------------------------------------------------------------ health policies
+def test_nan_injection_skip_step_policy():
+    """skip_step: the poisoned step applies NO update (params, opt state,
+    step counter all frozen — the fp16 overflow-skip select, extended to
+    bf16/fp32 runs the loss scaler never watches)."""
+    eng = _engine({"enabled": True, "health": {"nonfinite_policy": "skip_step"}})
+    batch = random_batch(eng.train_batch_size)
+    eng.train_batch(batch)
+    assert eng.global_steps == 1
+    before = _params(eng)
+
+    m = eng.train_batch(_poisoned(batch))
+    assert bool(m["health/skip"])
+    assert bool(m["health/nonfinite_any"])
+    assert int(m["health/nonfinite_total"]) > 0
+    # per-leaf-group attribution names the layer group(s) that went nonfinite
+    groups = [k for k in m if k.startswith("health/nonfinite/")]
+    assert groups and any(int(m[k]) > 0 for k in groups)
+    assert eng.global_steps == 1  # skipped step does not count
+    assert _same(before, _params(eng))
+
+    m2 = eng.train_batch(batch)  # clean step applies again
+    assert not bool(m2["health/skip"])
+    assert eng.global_steps == 2
+    assert not _same(before, _params(eng))
+
+
+def test_nan_injection_log_policy_applies_update():
+    """log: the verdict is recorded but the update still applies (and the
+    step counter advances) — observation only."""
+    eng = _engine({"enabled": True, "health": {"nonfinite_policy": "log"}})
+    batch = random_batch(eng.train_batch_size)
+    eng.train_batch(batch)
+    m = eng.train_batch(_poisoned(batch))
+    assert bool(m["health/nonfinite_any"])
+    assert not bool(m["health/skip"])
+    assert eng.global_steps == 2
+
+
+def test_nan_injection_abort_policy_raises_and_dumps(tmp_path):
+    eng = _engine({
+        "enabled": True,
+        "health": {"nonfinite_policy": "abort"},
+        "flight_recorder": {"dump_dir": str(tmp_path),
+                            "install_signal_handlers": False,
+                            "dump_on_exception": False},
+    })
+    batch = random_batch(eng.train_batch_size)
+    eng.train_batch(batch)
+    with pytest.raises(TrainingHealthError) as ei:
+        eng.train_batch(_poisoned(batch))
+    assert ei.value.verdicts.get("health/nonfinite_any")
+    assert ei.value.dump_path and os.path.exists(ei.value.dump_path)
+    # abort also skipped the poisoned update
+    assert eng.global_steps == 1
+
+
+def test_grad_spike_zscore_detection():
+    """A 1000x-scaled batch after a stable warmup trips the grad-norm
+    z-score; with policy log the verdict lands in metrics."""
+    eng = _engine({"enabled": True, "health": {
+        "grad_spike_policy": "log", "warmup_steps": 4, "grad_spike_zscore": 4.0,
+        "ema_beta": 0.9}})
+    batch = random_batch(eng.train_batch_size)
+    for i in range(8):  # stable baseline past warmup
+        m = eng.train_batch(random_batch(eng.train_batch_size, seed=i))
+        assert not bool(m["health/grad_spike"])
+    spike = {k: np.array(v, copy=True) for k, v in batch.items()}
+    spike["x"] *= 1000.0
+    m = eng.train_batch(spike)
+    assert bool(m["health/grad_spike"])
+    assert float(m["health/grad_zscore"]) > 4.0
+
+
+def test_health_ema_not_poisoned_by_skipped_step():
+    """The EMA baseline must ignore skipped steps: after a NaN step the
+    count stays put and later clean steps are not judged against NaN."""
+    eng = _engine({"enabled": True, "health": {"nonfinite_policy": "skip_step"}})
+    batch = random_batch(eng.train_batch_size)
+    eng.train_batch(batch)
+    c1 = int(eng.state.health.count)
+    eng.train_batch(_poisoned(batch))
+    assert int(eng.state.health.count) == c1
+    assert np.isfinite(float(eng.state.health.gnorm_ema))
+    m = eng.train_batch(batch)
+    assert not bool(m["health/skip"])
+
+
+# ------------------------------------------------------- disabled-path no-op
+def test_disabled_diagnostics_is_noop():
+    eng = _engine()  # no diagnostics block
+    assert eng.diagnostics is None
+    assert eng.state.health is None
+    m = eng.train_batch(random_batch(eng.train_batch_size))
+    assert not any(k.startswith("health/") for k in m)
+    # and nothing leaked into the (disabled) tracer
+    assert get_tracer().events() == []
+
+
+def test_disabled_health_block_keeps_state_none():
+    eng = _engine({"enabled": True, "health": {"enabled": False},
+                   "flight_recorder": {"install_signal_handlers": False,
+                                       "dump_on_exception": False}})
+    assert eng.diagnostics is not None and eng._health is None
+    assert eng.state.health is None
+    m = eng.train_batch(random_batch(eng.train_batch_size))
+    assert not any(k.startswith("health/") for k in m)
+
+
+# ----------------------------------------------------------------- recompile
+def test_recompile_detector_warns_once_naming_argument():
+    det = RecompileDetector("unit", arg_names=("x",))
+    f = det.wrap(jax.jit(lambda x: x * 2))
+    f(jnp.ones((4, 8)))  # initial compile: expected, no warning
+    f(jnp.ones((4, 8)))  # cache hit
+    assert det.compiles == 1 and det.recompiles == 0
+
+    f(jnp.ones((4, 16)))  # forced shape change -> exactly one recompile event
+    assert det.recompiles == 1
+    recs = [e for e in det.events if e["kind"] == "recompile"]
+    assert len(recs) == 1
+    assert any("x" in d and "(4, 8)" in d and "(4, 16)" in d for d in recs[0]["diff"])
+
+    f(jnp.ones((4, 16)))  # stable again: no new events
+    assert det.recompiles == 1
+
+
+def test_recompile_storm_escalates():
+    det = RecompileDetector("storm", storm_threshold=3, storm_window_s=60.0)
+    f = det.wrap(jax.jit(lambda x: x + 1))
+    for n in range(2, 7):  # every call a new shape
+        f(jnp.ones((n,)))
+    assert det.recompiles >= 3
+    assert any(e["kind"] == "storm" for e in det.events)
+
+
+def test_engine_forced_recompile_fires_detector():
+    """An unpadded sequence length (the classic silent-recompile trigger)
+    recompiles the fused step; the engine's detector names the changed leaf
+    exactly once."""
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=2, max_seq_len=64,
+    )
+    eng, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=16),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10_000,
+            "diagnostics": {"enabled": True, "health": {"enabled": False},
+                            "flight_recorder": {"install_signal_handlers": False,
+                                                "dump_on_exception": False}},
+        },
+    )
+
+    def tok_batch(seq, seed=0):
+        rng = np.random.default_rng(seed)
+        return {"input_ids": rng.integers(
+            0, 64, (eng.train_batch_size, seq), dtype=np.int32)}
+
+    eng.train_batch(tok_batch(16))
+    eng.train_batch(tok_batch(16, seed=1))
+    det = eng.diagnostics.detector("train_step")
+    assert det is not None and det.recompiles == 0
+
+    eng.train_batch(tok_batch(24, seed=2))
+    assert det.recompiles == 1
+    recs = [e for e in det.events if e["kind"] == "recompile"]
+    assert len(recs) == 1
+    assert any("input_ids" in d and "16" in d and "24" in d
+               for d in recs[0]["diff"]), recs[0]["diff"]
+
+
+def test_inference_bucketing_no_recompile_within_bucket():
+    """The v1 engine's seq_bucket claim, now checked: prompts inside one
+    bucket never recompile; a new bucket is an expected first compile."""
+    from deepspeed_tpu.models import TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=2, max_seq_len=128,
+    )
+    import flax.linen as nn  # noqa: F401  (CausalLM import path warmup)
+    from deepspeed_tpu.models import CausalLM
+
+    module = CausalLM(cfg)
+    params = module.init({"params": jax.random.PRNGKey(0)},
+                         {"input_ids": jnp.zeros((1, 8), jnp.int32)},
+                         train=False)["params"]
+    eng = deepspeed_tpu.init_inference(
+        cfg, params=params, config={"dtype": "fp32", "seq_bucket": 32})
+    assert eng._gen_detector is not None
+    eng.generate(np.ones((1, 10), np.int32), max_new_tokens=4)
+    eng.generate(np.ones((1, 20), np.int32), max_new_tokens=4)  # same bucket
+    eng.generate(np.ones((1, 17), np.int32), max_new_tokens=4)  # same bucket
+    det = eng._gen_detector
+    assert det.compiles == 1 and det.recompiles == 0
+    eng.generate(np.ones((1, 40), np.int32), max_new_tokens=4)  # new bucket
+    assert det.compiles == 2 and det.recompiles == 0
+
+
+# ------------------------------------------------------------------- anomaly
+def test_step_time_straggler_and_regression_flags():
+    tr = get_tracer()
+    det = StepTimeAnomalyDetector(window=32, straggler_mads=6.0,
+                                  regression_factor=1.3, min_samples=8,
+                                  name="t", tracer=tr)
+    for _ in range(16):
+        flags = det.observe(0.100)
+        assert not flags["straggler"] and not flags["regression"]
+    flags = det.observe(1.0)  # 10x median: straggler, not yet a regression
+    assert flags["straggler"]
+    assert det.stragglers == 1
+    for _ in range(12):  # sustained 1.5x shift
+        flags = det.observe(0.150)
+    assert flags["regression"]
+    gauges = tr.registry.gauges()
+    assert gauges["anomaly/t_median_ms"] > 0
+    assert gauges["anomaly/t_regression"] == 1.0
+
+
+# ----------------------------------------------------------- flight recorder
+def test_flight_recorder_ring_and_dump_schema(tmp_path):
+    """≥8 step records with health verdicts survive in the dump; the ring
+    stays bounded; the JSONL round-trips."""
+    eng = _engine({
+        "enabled": True,
+        "health": {"nonfinite_policy": "skip_step"},
+        "flight_recorder": {"capacity": 12, "dump_dir": str(tmp_path),
+                            "install_signal_handlers": False,
+                            "dump_on_exception": False},
+    })
+    batch = random_batch(eng.train_batch_size)
+    for i in range(15):
+        eng.train_batch(random_batch(eng.train_batch_size, seed=i))
+    eng.train_batch(_poisoned(batch))
+    assert len(eng.diagnostics.flight_recorder) == 12  # bounded
+
+    path = eng.diagnostics.dump(reason="unit_test")
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    header = lines[0]
+    assert header["kind"] == "header"
+    assert header["reason"] == "unit_test"
+    assert header["n_records"] == 12
+    assert header["context"]["zero_stage"] == 1
+
+    recs = [l for l in lines if l["kind"] == "step_record"]
+    assert len(recs) >= 8
+    for r in recs:
+        assert {"step", "t_unix", "metrics", "health"} <= set(r)
+        assert "skip" in r["health"] and "nonfinite_any" in r["health"]
+        assert "loss" in r["metrics"] and "grad_norm" in r["metrics"]
+    # the poisoned step's verdict is in the dump
+    assert recs[-1]["health"]["skip"] is True
+    assert recs[-1]["health"]["nonfinite_any"] is True
+    # steps are contiguous and ordered (the ring kept the LAST capacity steps)
+    steps = [r["step"] for r in recs]
+    assert steps == sorted(steps) and steps[-1] == 16
+
+    # schema round-trip: re-serialize == re-parse identical
+    assert [json.loads(json.dumps(l)) for l in lines] == lines
+
+
+def test_flight_recorder_dump_all_via_hook_helpers(tmp_path):
+    """dump_all (what the excepthook/signal handlers call) reaches every
+    live recorder without an engine reference."""
+    from deepspeed_tpu.diagnostics import dump_all
+
+    rec = FlightRecorder(capacity=4, dump_dir=str(tmp_path))
+    rec.set_context(run="t")
+    for i in range(6):
+        rec.record(i, {"loss": float(i)})
+    paths = dump_all(reason="signal:SIGUSR1")
+    assert any(str(tmp_path) in p for p in paths)
+    mine = [p for p in paths if str(tmp_path) in p][0]
+    lines = [json.loads(l) for l in open(mine) if l.strip()]
+    assert lines[0]["reason"] == "signal:SIGUSR1"
+    assert lines[0]["n_records"] == 4  # bounded ring kept the last 4
+    assert [l["step"] for l in lines[1:5]] == [2, 3, 4, 5]
+
+
+def test_flops_profiler_mfu_reaches_registry_and_monitor_scalars():
+    """The flops profiler publishes achieved-TFLOPS/MFU into the shared
+    registry, so MFU rides the same step_scalars stream (monitor CSV/trace)
+    as step time and comm bytes."""
+    tr = get_tracer()
+    tr.configure(enabled=True)
+    eng = _engine(extra={"telemetry": {"enabled": True}})
+    eng.flops_profiler.start_profile()
+    eng.train_batch(random_batch(eng.train_batch_size))
+    assert eng.flops_profiler.result is not None
+    gauges = tr.registry.gauges()
+    assert "flops/mfu" in gauges and "flops/achieved_tflops" in gauges
+    assert gauges["flops/flops_per_step"] > 0
+    scalars = tr.step_scalars()
+    assert "Telemetry/flops/mfu" in scalars
+    assert scalars["Telemetry/flops/flops_per_step"] > 0
+
+
+def test_explicit_dump_includes_recent_spans(tmp_path):
+    """With telemetry on, the dump carries the recent span tail so the
+    post-mortem has the timeline, not just the scalars."""
+    eng = _engine(
+        {"enabled": True,
+         "flight_recorder": {"dump_dir": str(tmp_path),
+                             "install_signal_handlers": False,
+                             "dump_on_exception": False}},
+        extra={"telemetry": {"enabled": True}})
+    eng.train_batch(random_batch(eng.train_batch_size))
+    path = eng.diagnostics.dump()
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    span_names = {l["name"] for l in lines if l.get("kind") == "span"}
+    assert {"train_batch", "step"} <= span_names
+    # Perfetto trace written next to the JSONL
+    assert os.path.exists(os.path.splitext(path)[0] + "_trace.json")

@@ -18,12 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # newer jax exports shard_map at top level; older under experimental
-    from jax import shard_map as _sm
-
-    shard_map = _sm if callable(_sm) else _sm.shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from deepspeed_tpu.utils.compat import shard_map
 
 import deepspeed_tpu
 import deepspeed_tpu.comm as dist
