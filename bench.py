@@ -103,6 +103,14 @@ def _telemetry_section(engine, batch, steps=5):
     probe = shard_map(lambda v: dist.all_reduce(v, "dp"),
                       mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
     np.asarray(jax.jit(probe)(jnp.ones((len(devs), 256), jnp.float32)))
+    # algorithmic sibling: one hop-composed quantized all-reduce so the trace
+    # also holds per-hop coll:* spans + the algorithm/codec routing tags
+    # (collectives/ subsystem; harmless single tiny collective)
+    probe2 = shard_map(
+        lambda v: dist.all_reduce(v[0], "dp", algorithm="ring2d", codec="int8",
+                                  block_size=128)[None],
+        mesh=mesh, in_specs=P("dp"), out_specs=P("dp"), check_vma=False)
+    np.asarray(jax.jit(probe2)(jnp.ones((len(devs), 256), jnp.float32)))
 
     gas = engine.config.gradient_accumulation_steps
     micro = {k: np.asarray(v)[: max(1, np.asarray(v).shape[0] // gas)]

@@ -1,0 +1,39 @@
+"""deepspeed_tpu.collectives: algorithmic collective library.
+
+The layer between the ``deepspeed_tpu.comm`` facade and ``jax.lax``:
+hop-composed collective algorithms (``algorithms.py``), wire codecs applied
+per hop (``codecs.py``), an alpha-beta / measured cost model picking
+algorithm+codec per (op, bytes, axis-size) (``selector.py``), and a chunked
+double-buffered compute/comm overlap helper (``overlap.py``).
+
+Reference analogs: ZeRO++'s quantized hierarchical collectives
+(arxiv 2306.10209, ``deepspeed/runtime/comm/coalesced_collectives.py``) and
+EQuARX-style in-XLA quantized all-reduce (arxiv 2506.17615). Everything here
+is built from ``ppermute`` hops inside **full-manual** shard_map (via
+``utils/compat.shard_map`` — partial-manual is broken upstream on jax 0.4.37)
+so a later Pallas remote-DMA backend can replace the hop primitive without
+touching the algorithm layer.
+"""
+
+from deepspeed_tpu.collectives.codecs import (
+    CODECS,
+    Codec,
+    Wire,
+    get_codec,
+)
+from deepspeed_tpu.collectives.algorithms import (
+    ALGORITHMS,
+    all_gather,
+    all_reduce,
+    reduce_scatter,
+)
+from deepspeed_tpu.collectives.selector import (
+    Decision,
+    configure,
+    get_config,
+    select,
+)
+from deepspeed_tpu.collectives.overlap import (
+    double_buffered,
+    double_buffered_scan,
+)

@@ -1,0 +1,497 @@
+"""Hop-composed collective algorithms over ``ppermute``.
+
+Each algorithm is built from neighbor-exchange hops (the SNIPPETS
+right-permute pattern: ``perm = [(s, (s+1) % n) for s in range(n)]``) routed
+through the ``comm`` facade's ``ppermute`` so (a) every hop lands in the
+telemetry trace as a ``comm:ppermute`` span next to step time and (b) a later
+Pallas remote-DMA backend (``make_async_remote_copy`` per hop) can replace
+the primitive without touching this layer.
+
+Algorithms (reference: NCCL/MPI collective-algorithm menus; ZeRO++ hpZ for
+the hierarchical shape, arxiv 2306.10209):
+
+- ``ring``    — classic n-1 hop ring. Bandwidth-optimal, latency O(n).
+- ``bidir``   — bidirectional ring: payload halved onto two counter-rotating
+  rings; same bus traffic, half the serial chain on full-duplex links.
+- ``rhd``     — recursive halving/doubling: log2(n) hops, latency-optimal for
+  small payloads; power-of-two axis sizes only (callers fall back to ring).
+- ``ring2d``  — the axis factored into a near-square a x b grid (or a tuple
+  of two mesh axes): intra-group reduce-scatter -> inter-group all-reduce ->
+  intra-group all-gather — the ZeRO++ hierarchical all-reduce shape that
+  keeps the quantized hops on the fast intra links.
+
+Wire codecs (``codecs.py``) apply at hop granularity: all-gather-style
+forwarding encodes once at the source and relays the wire; reduce paths
+decode-accumulate-re-encode per hop (which is why LoCo error feedback exists
+— pass ``err`` to ``reduce_scatter``).
+
+Everything here must run inside **full-manual** shard_map (axis names bound;
+partial-manual is broken on this jax 0.4.37 — see ``utils/compat.py``).
+All functions accept arbitrary local shapes; reduce paths pad the flattened
+payload up to ``n`` chunks internally and strip the padding on exit.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.collectives.codecs import Codec, get_codec
+from deepspeed_tpu.utils.compat import axis_size
+
+ALGORITHMS = ("ring", "bidir", "rhd", "ring2d")
+
+
+def _permute_wire(wire, axis, perm):
+    """Permute every leaf of a wire pytree one hop (facade ppermute so each
+    leaf transfer is a traced ``comm:ppermute`` span)."""
+    from deepspeed_tpu.comm import comm as dist
+
+    return jax.tree_util.tree_map(
+        lambda w: w if w.size == 0 else dist.ppermute(w, axis, perm), wire)
+
+
+def _hop_span(name: str, axis, hop: int, codec: Codec):
+    tracer = telemetry.get_tracer()
+    if not tracer.enabled:
+        return telemetry.NOOP_SPAN
+    axis_str = "+".join(axis) if isinstance(axis, (tuple, list)) else str(axis)
+    return tracer.span(f"coll:{name}", cat="coll", axis=axis_str, hop=hop,
+                       codec=codec.name)
+
+
+def _ring_perm(n: int, reverse: bool = False):
+    if reverse:
+        return [(s, (s - 1) % n) for s in range(n)]
+    return [(s, (s + 1) % n) for s in range(n)]
+
+
+# ---------------------------------------------------------------- all-gather
+
+
+def _ring_all_gather_flat(x: jax.Array, axis, codec: Codec, *, reverse: bool = False,
+                          sub: Optional[tuple] = None) -> jax.Array:
+    """Ring all-gather of a flat local block: ``[L] -> [n, L]`` ordered by
+    source rank. Encode once at the source, relay the wire n-1 hops, decode
+    on each arrival (lossy codecs quantize exactly once).
+
+    ``sub = (n, rank, perm, span_label)`` runs the SAME schedule on a
+    sub-ring of the axis (ring2d's intra/inter groups): ``perm`` connects
+    each group's members and ``rank`` is the position within the group."""
+    if sub is not None:
+        n, i, perm, label = sub
+        step = 1
+    else:
+        n = axis_size(axis)
+        i = jax.lax.axis_index(axis) if n > 1 else 0
+        step = -1 if reverse else 1
+        perm = _ring_perm(n, reverse)
+        label = f"all_gather:ring{'-' if reverse else ''}"
+    L = x.shape[0]
+    if n == 1:
+        return x[None]
+    wire = codec.encode_rows(x[None])
+    # the sender's own row comes from its own DECODED wire, not the raw
+    # block: with a lossy codec every rank must see the same bytes for every
+    # block or data-parallel replicas silently drift apart
+    out = jnp.zeros((n, L), x.dtype).at[i].set(codec.decode_rows(wire, L, x.dtype)[0])
+    for k in range(1, n):
+        with _hop_span(label, axis, k, codec):
+            wire = _permute_wire(wire, axis, perm)
+        src = (i - step * k) % n
+        out = out.at[src].set(codec.decode_rows(wire, L, x.dtype)[0])
+    return out
+
+
+def ring_all_gather(x: jax.Array, axis, codec: Codec, *, concat_axis: int = 0,
+                    bidir: bool = False) -> jax.Array:
+    """All-gather along ``concat_axis`` (tiled, matching
+    ``lax.all_gather(..., tiled=True)`` semantics)."""
+    n = axis_size(axis)
+    moved = jnp.moveaxis(x, concat_axis, 0)
+    lead, rest = moved.shape[0], moved.shape[1:]
+    flat = moved.reshape(-1)
+    if bidir and flat.shape[0] >= 2:
+        h = flat.shape[0] // 2
+        ga = _ring_all_gather_flat(flat[:h], axis, codec)
+        gb = _ring_all_gather_flat(flat[h:], axis, codec, reverse=True)
+        gathered = jnp.concatenate([ga, gb], axis=1)  # [n, L]
+    else:
+        gathered = _ring_all_gather_flat(flat, axis, codec)
+    full = gathered.reshape((n * lead,) + rest)
+    return jnp.moveaxis(full, 0, concat_axis)
+
+
+def rhd_all_gather(x: jax.Array, axis, codec: Codec, *, concat_axis: int = 0) -> jax.Array:
+    """Recursive-doubling all-gather: log2(n) hops, payload doubling each
+    hop. Power-of-two axis sizes only.
+
+    The working buffer stays in WIRE form the whole way (rows concatenate
+    without decoding — every row was encoded independently), so lossy codecs
+    quantize exactly once at the source, same as the ring relay."""
+    n = axis_size(axis)
+    if n & (n - 1):
+        raise ValueError(f"rhd needs a power-of-two axis size, got {n}")
+    moved = jnp.moveaxis(x, concat_axis, 0)
+    lead, rest = moved.shape[0], moved.shape[1:]
+    L = moved.size  # static row length of the single source row
+    i = jax.lax.axis_index(axis)
+    # [groups, ...] wire rows: groups of contiguous src ranks, one row each
+    wire = codec.encode_rows(moved.reshape(1, -1))
+    d = 1
+    hop = 0
+    while d < n:
+        perm = [(s, s ^ d) for s in range(n)]
+        with _hop_span("all_gather:rhd", axis, hop, codec):
+            recv = _permute_wire(wire, axis, perm)
+        # my block covers ranks [i & ~(d-1) ...]; the partner's covers the
+        # sibling half — order rows by the side bit of this round
+        upper = ((i & d) != 0)
+        wire = jax.tree_util.tree_map(
+            lambda own, rcv: jnp.concatenate(
+                [jnp.where(upper, rcv, own), jnp.where(upper, own, rcv)], axis=0),
+            wire, recv)
+        d *= 2
+        hop += 1
+    full = codec.decode_rows(wire, L, x.dtype).reshape((n * lead,) + rest)
+    return jnp.moveaxis(full, 0, concat_axis)
+
+
+# ------------------------------------------------------------ reduce-scatter
+
+
+def _pad_to_chunks(flat: jax.Array, n: int) -> Tuple[jax.Array, int, int]:
+    N = flat.shape[0]
+    chunk = -(-N // n)
+    Np = chunk * n
+    if Np != N:
+        flat = jnp.pad(flat, (0, Np - N))
+    return flat, N, chunk
+
+
+def _ring_reduce_scatter_rows(rows: jax.Array, axis, codec: Codec, *,
+                              err: Optional[jax.Array] = None,
+                              reverse: bool = False,
+                              sub: Optional[tuple] = None):
+    """Ring reduce-scatter of ``[n, L]`` chunk rows: returns this rank's
+    fully-reduced (summed) chunk ``[L]`` (+ refreshed EF residual rows).
+
+    Hop schedule (right ring): at hop k rank i sends its accumulated chunk
+    ``(i - 1 - k) % n`` and receives chunk ``(i - 2 - k) % n`` from the left,
+    finishing after n-1 hops with chunk ``i`` reduced over all ranks.
+    Lossy codecs re-encode partial sums each hop; ``err`` (shaped like
+    ``rows``) turns on LoCo error feedback per sent chunk.
+
+    ``sub = (n, rank, perm, span_label)`` runs the schedule on a sub-ring
+    of the axis (see :func:`_ring_all_gather_flat`).
+    """
+    if sub is not None:
+        n, i, perm, label = sub
+        step = 1
+    else:
+        n = axis_size(axis)
+        i = jax.lax.axis_index(axis) if n > 1 else 0
+        step = -1 if reverse else 1
+        perm = _ring_perm(n, reverse)
+        label = f"reduce_scatter:ring{'-' if reverse else ''}"
+    L = rows.shape[1]
+    if n == 1:
+        out = rows[0]
+        return (out, err) if err is not None else (out, None)
+    # float payloads accumulate in fp32 — the WHOLE chain, not just each
+    # add: a bf16 accumulator would round partial sums on every hop, drifting
+    # from lax.psum as the world grows. Integer payloads accumulate in their
+    # own dtype so exactness matches lax.psum (fp32 rounds above 2^24).
+    acc_dtype = jnp.float32 if jnp.issubdtype(rows.dtype, jnp.floating) else rows.dtype
+    acc = rows.astype(acc_dtype)
+    new_err = err
+    for k in range(n - 1):
+        send_idx = (i - step * (1 + k)) % n
+        v = jax.lax.dynamic_index_in_dim(acc, send_idx, axis=0)  # [1, L]
+        if err is not None:
+            e = jax.lax.dynamic_index_in_dim(new_err, send_idx, axis=0)
+            wire, e2 = codec.encode_rows_ef(v, e)
+            new_err = jax.lax.dynamic_update_index_in_dim(new_err, e2, send_idx, axis=0)
+        else:
+            wire = codec.encode_rows(v)
+        with _hop_span(label, axis, k, codec):
+            wire = _permute_wire(wire, axis, perm)
+        recv = codec.decode_rows(wire, L, acc_dtype)
+        recv_idx = (i - step * (2 + k)) % n
+        mine = jax.lax.dynamic_index_in_dim(acc, recv_idx, axis=0)
+        acc = jax.lax.dynamic_update_index_in_dim(acc, mine + recv, recv_idx, axis=0)
+    out = jax.lax.dynamic_index_in_dim(acc, i, axis=0)[0]
+    return out, new_err
+
+
+def _rhd_reduce_scatter_rows(rows: jax.Array, axis, codec: Codec):
+    """Recursive-halving reduce-scatter of ``[n, L]`` rows -> this rank's
+    summed chunk ``[L]``; log2(n) hops, halving payload each hop."""
+    n = axis_size(axis)
+    if n & (n - 1):
+        raise ValueError(f"rhd needs a power-of-two axis size, got {n}")
+    if n == 1:
+        return rows[0]
+    i = jax.lax.axis_index(axis)
+    L = rows.shape[1]
+    # fp32 working set for floats; integer payloads keep their dtype (exact)
+    acc_dtype = jnp.float32 if jnp.issubdtype(rows.dtype, jnp.floating) else rows.dtype
+    buf = rows.astype(acc_dtype)  # [m, L] working set, m halves each round
+    d = n >> 1
+    hop = 0
+    while d >= 1:
+        m = buf.shape[0]
+        upper = ((i & d) != 0)
+        lo, hi = buf[: m // 2], buf[m // 2:]
+        send = jnp.where(upper, lo, hi)  # the half the partner keeps
+        keep = jnp.where(upper, hi, lo)
+        wire = codec.encode_rows(send.reshape(1, -1))
+        perm = [(s, s ^ d) for s in range(n)]
+        with _hop_span("reduce_scatter:rhd", axis, hop, codec):
+            wire = _permute_wire(wire, axis, perm)
+        recv = codec.decode_rows(wire, send.size, acc_dtype).reshape(send.shape)
+        buf = keep + recv
+        d >>= 1
+        hop += 1
+    return buf[0]
+
+
+def ring_reduce_scatter(x: jax.Array, axis, codec: Codec, *, scatter_axis: int = 0,
+                        op: str = "sum", bidir: bool = False,
+                        err: Optional[jax.Array] = None):
+    """Reduce-scatter along ``scatter_axis`` (tiled ``lax.psum_scatter``
+    semantics: rank i gets slice i of the reduction). ``err`` (same shape as
+    the flattened chunk rows ``[n, L]``) enables LoCo error feedback and
+    makes the return a ``(out, new_err)`` pair."""
+    n = axis_size(axis)
+    moved = jnp.moveaxis(x, scatter_axis, 0)
+    lead, rest = moved.shape[0], moved.shape[1:]
+    if lead % n:
+        raise ValueError(f"reduce_scatter dim {lead} not divisible by axis size {n}")
+    rows = moved.reshape(n, -1)
+    if bidir and err is None and rows.shape[1] >= 2:
+        h = rows.shape[1] // 2
+        oa, _ = _ring_reduce_scatter_rows(rows[:, :h], axis, codec)
+        ob, _ = _ring_reduce_scatter_rows(rows[:, h:], axis, codec, reverse=True)
+        out = jnp.concatenate([oa, ob], axis=0)
+        new_err = None
+    else:
+        out, new_err = _ring_reduce_scatter_rows(rows, axis, codec, err=err)
+    out = out.reshape((lead // n,) + rest).astype(x.dtype)
+    out = jnp.moveaxis(out, 0, scatter_axis)
+    if op in ("mean", "avg"):
+        out = out / n
+    elif op != "sum":
+        raise ValueError(f"reduce op {op!r} unsupported by algorithmic reduce_scatter")
+    return (out, new_err) if err is not None else out
+
+
+def rhd_reduce_scatter(x: jax.Array, axis, codec: Codec, *, scatter_axis: int = 0,
+                       op: str = "sum") -> jax.Array:
+    n = axis_size(axis)
+    moved = jnp.moveaxis(x, scatter_axis, 0)
+    lead, rest = moved.shape[0], moved.shape[1:]
+    if lead % n:
+        raise ValueError(f"reduce_scatter dim {lead} not divisible by axis size {n}")
+    out = _rhd_reduce_scatter_rows(moved.reshape(n, -1), axis, codec)
+    out = out.reshape((lead // n,) + rest).astype(x.dtype)
+    out = jnp.moveaxis(out, 0, scatter_axis)
+    return out / n if op in ("mean", "avg") else out
+
+
+# ---------------------------------------------------------------- all-reduce
+
+
+def _flat_all_reduce_ring(flat: jax.Array, axis, codec: Codec, *, bidir: bool = False,
+                          n: Optional[int] = None) -> jax.Array:
+    """Ring all-reduce of a flat payload (any length): pad to n chunks,
+    ring RS then ring AG, strip padding."""
+    n = axis_size(axis) if n is None else n
+    if n == 1:
+        return flat
+    padded, N, chunk = _pad_to_chunks(flat, n)
+    rows = padded.reshape(n, chunk)
+    # the reduced shard returns fp32; gather it in the payload dtype so the
+    # AG wire costs what the caller's dtype costs (one boundary rounding,
+    # same as lax's psum_scatter + all_gather decomposition)
+    if bidir and chunk >= 2:
+        h = chunk // 2
+        ra, _ = _ring_reduce_scatter_rows(rows[:, :h], axis, codec)
+        rb, _ = _ring_reduce_scatter_rows(rows[:, h:], axis, codec, reverse=True)
+        ga = _ring_all_gather_flat(ra.astype(flat.dtype), axis, codec)
+        gb = _ring_all_gather_flat(rb.astype(flat.dtype), axis, codec, reverse=True)
+        out = jnp.concatenate([ga, gb], axis=1).reshape(-1)[:N]
+    else:
+        red, _ = _ring_reduce_scatter_rows(rows, axis, codec)
+        out = _ring_all_gather_flat(red.astype(flat.dtype), axis, codec).reshape(-1)[:N]
+    return out.astype(flat.dtype)
+
+
+def _flat_all_reduce_rhd(flat: jax.Array, axis, codec: Codec) -> jax.Array:
+    n = axis_size(axis)
+    if n == 1:
+        return flat
+    padded, N, chunk = _pad_to_chunks(flat, n)
+    red = _rhd_reduce_scatter_rows(padded.reshape(n, chunk), axis, codec)
+    return rhd_all_gather(red.astype(flat.dtype), axis, codec).reshape(-1)[:N]
+
+
+def _factor_near_square(n: int) -> Tuple[int, int]:
+    """n = a * b with a <= b and a as close to sqrt(n) as divides."""
+    a = int(math.isqrt(n))
+    while a > 1 and n % a:
+        a -= 1
+    return a, n // a
+
+
+def _flat_all_reduce_ring2d(flat: jax.Array, axis, codec: Codec,
+                            factors: Optional[Tuple[int, int]] = None) -> jax.Array:
+    """Hierarchical 2D all-reduce on ONE mesh axis factored a x b
+    (rank = u*b + v): intra-group (b, contiguous ranks — the fast/near links
+    on a ring-ordered axis) reduce-scatter, inter-group (a, stride b) ring
+    all-reduce of the shard, intra-group all-gather — the ZeRO++/hpZ shape.
+    Every phase is ppermute hops with the codec applied, so the wire
+    reduction lands on every link tier."""
+    n = axis_size(axis)
+    if n == 1:
+        return flat
+    a, b = factors if factors else _factor_near_square(n)
+    if a * b != n:
+        raise ValueError(f"ring2d factors {a}x{b} != axis size {n}")
+    if a == 1 or b == 1:
+        return _flat_all_reduce_ring(flat, axis, codec)
+
+    i = jax.lax.axis_index(axis)
+    u, v = i // b, i % b
+    # sub-ring permutations: intra connects contiguous groups of b (the
+    # near links on a ring-ordered axis); inter connects same-v ranks at
+    # stride b across the a groups
+    intra = [(s, (s // b) * b + ((s % b) + 1) % b) for s in range(n)]
+    inter = [(s, ((s // b + 1) % a) * b + (s % b)) for s in range(n)]
+
+    # phase 1: intra-group ring reduce-scatter over the v sub-axis
+    padded, N, chunk = _pad_to_chunks(flat, b)
+    shard, _ = _ring_reduce_scatter_rows(
+        padded.reshape(b, chunk), axis, codec,
+        sub=(b, v, intra, "all_reduce:ring2d/intra-rs"))  # [chunk]
+
+    # phase 2: inter-group ring all-reduce of the shard over the u sub-axis
+    sp, SN, schunk = _pad_to_chunks(shard, a)
+    sred, _ = _ring_reduce_scatter_rows(
+        sp.reshape(a, schunk), axis, codec,
+        sub=(a, u, inter, "all_reduce:ring2d/inter-rs"))
+    sout = _ring_all_gather_flat(
+        sred.astype(flat.dtype), axis, codec,
+        sub=(a, u, inter, "all_reduce:ring2d/inter-ag"))
+    shard_full = sout.reshape(-1)[:SN]  # [chunk], reduced over ALL n ranks
+
+    # phase 3: intra-group ring all-gather of the reduced shard
+    out = _ring_all_gather_flat(
+        shard_full.astype(flat.dtype), axis, codec,
+        sub=(b, v, intra, "all_reduce:ring2d/intra-ag"))
+    return out.reshape(-1)[:N].astype(flat.dtype)
+
+
+def _hier_all_reduce_axes(x: jax.Array, axes: Sequence[str], codec: Codec) -> jax.Array:
+    """Mesh-axis-factored hierarchical all-reduce over a tuple of named axes
+    (intra ``axes[0]`` RS -> inter ``axes[1:]`` AR -> intra ``axes[0]`` AG)."""
+    inner = axes[0]
+    n = axis_size(inner)
+    flat = x.reshape(-1)
+    padded, N, chunk = _pad_to_chunks(flat, n)
+    red, _ = _ring_reduce_scatter_rows(padded.reshape(n, chunk), axis=inner, codec=codec)
+    rest = tuple(axes[1:])
+    if len(rest) == 1:
+        red = _flat_all_reduce_ring(red, rest[0], codec)
+    elif rest:
+        red = _hier_all_reduce_axes(red, rest, codec).reshape(-1)
+    gathered = _ring_all_gather_flat(red.astype(flat.dtype), inner, codec)
+    return gathered.reshape(-1)[:N].reshape(x.shape)
+
+
+# ------------------------------------------------------------------ dispatch
+
+
+def all_reduce(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
+               op: str = "sum", block_size: Optional[int] = None) -> jax.Array:
+    """Algorithmic all-reduce (sum/mean) of an arbitrary-shaped local array.
+
+    ``axis`` may be one mesh-axis name or a tuple of them; tuples route
+    ``ring2d`` (and any multi-axis call) through the mesh-axis-factored
+    hierarchical path. Must run inside full-manual shard_map.
+    """
+    c = get_codec(codec, block_size)
+    if op not in ("sum", "mean", "avg"):
+        raise ValueError(f"reduce op {op!r} unsupported by algorithmic all_reduce")
+    axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+    flat = x.reshape(-1)
+    if len(axes) > 1:
+        out = _hier_all_reduce_axes(x, axes, c).reshape(-1)
+    elif algorithm == "ring":
+        out = _flat_all_reduce_ring(flat, axes[0], c)
+    elif algorithm == "bidir":
+        out = _flat_all_reduce_ring(flat, axes[0], c, bidir=True)
+    elif algorithm == "rhd":
+        out = _flat_all_reduce_rhd(flat, axes[0], c)
+    elif algorithm == "ring2d":
+        out = _flat_all_reduce_ring2d(flat, axes[0], c)
+    else:
+        raise ValueError(f"unknown algorithm {algorithm!r} (one of {ALGORITHMS})")
+    out = out.reshape(x.shape)
+    if op in ("mean", "avg"):
+        total = 1
+        for a in axes:
+            total *= axis_size(a)
+        out = (out.astype(jnp.float32) / total).astype(x.dtype)
+    return out
+
+
+def all_gather(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
+               concat_axis: int = 0, block_size: Optional[int] = None) -> jax.Array:
+    if isinstance(axis, (tuple, list)):
+        if len(axis) != 1:
+            raise ValueError(f"algorithmic all_gather takes one axis, got {axis}")
+        axis = axis[0]
+    c = get_codec(codec, block_size)
+    if algorithm == "ring":
+        return ring_all_gather(x, axis, c, concat_axis=concat_axis)
+    if algorithm == "bidir":
+        return ring_all_gather(x, axis, c, concat_axis=concat_axis, bidir=True)
+    if algorithm == "rhd":
+        return rhd_all_gather(x, axis, c, concat_axis=concat_axis)
+    if algorithm == "ring2d":
+        # the hierarchy only exists for reductions: a non-reducing ring2d is
+        # a plain ring (exactly what the cost model charges it as)
+        return ring_all_gather(x, axis, c, concat_axis=concat_axis)
+    raise ValueError(f"unknown algorithm {algorithm!r} (one of {ALGORITHMS})")
+
+
+def reduce_scatter(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
+                   scatter_axis: int = 0, op: str = "sum",
+                   block_size: Optional[int] = None,
+                   err: Optional[jax.Array] = None):
+    if isinstance(axis, (tuple, list)):
+        if len(axis) != 1:
+            raise ValueError(f"algorithmic reduce_scatter takes one axis, got {axis}")
+        axis = axis[0]
+    c = get_codec(codec, block_size)
+    if err is not None and algorithm != "ring":
+        raise ValueError(
+            f"error feedback is implemented for algorithm='ring' only, got {algorithm!r}")
+    if algorithm == "ring":
+        return ring_reduce_scatter(x, axis, c, scatter_axis=scatter_axis, op=op, err=err)
+    if algorithm == "bidir":
+        return ring_reduce_scatter(x, axis, c, scatter_axis=scatter_axis, op=op, bidir=True)
+    if algorithm == "rhd":
+        # rhd_reduce_scatter itself raises on non-power-of-two axes — an
+        # explicit request must not silently measure ring instead
+        return rhd_reduce_scatter(x, axis, c, scatter_axis=scatter_axis, op=op)
+    if algorithm == "ring2d":
+        # the hierarchy only exists for reductions over BOTH tiers at once:
+        # a lone reduce-scatter rides the plain ring (the model's costing)
+        return ring_reduce_scatter(x, axis, c, scatter_axis=scatter_axis, op=op)
+    raise ValueError(f"unknown algorithm {algorithm!r} (one of {ALGORITHMS})")

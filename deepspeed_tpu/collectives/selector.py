@@ -1,0 +1,298 @@
+"""Algorithm + codec selection: alpha-beta cost model and measured mode.
+
+Reference analog: NCCL's tuner (latency/bandwidth tables per algorithm and
+protocol picking tree vs ring per message size) and DeepSpeed's autotuner.
+Here the model is the classic alpha-beta point-to-point model::
+
+    T(alg) = hops * alpha  +  wire_bytes_on_link * beta
+
+with per-algorithm hop counts and busiest-link byte volumes (ring moves
+2(n-1)/n * S for all-reduce in n-1+n-1 serial hops; recursive
+halving/doubling moves the same bytes in 2*log2(n) hops; ring2d's a x b
+factorization trades hop count for two link tiers). Codecs scale the beta
+term by their wire ratio (int8 ~ S/4 + scales vs fp32).
+
+``measured`` mode replaces the model with timings: ``comm/benchmark.py
+--sweep`` emits a JSON decision table (rows of op/world/size/algorithm/codec/
+latency) and the selector picks the nearest-size winner. Either way every
+(op, bytes-bucket, axis-size) query is answered once and cached — the cache
+IS the decision table the facade consults per traced collective, and each
+fresh decision emits a ``telemetry`` instant event so choices land in the
+same Perfetto trace as the step.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from dataclasses import dataclass, replace as dc_replace
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.collectives.algorithms import ALGORITHMS, _factor_near_square
+from deepspeed_tpu.collectives.codecs import get_codec
+from deepspeed_tpu.utils.logging import logger
+
+OPS = ("all_reduce", "all_gather", "reduce_scatter")
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One cached (op, bytes-bucket, world) routing decision."""
+
+    op: str
+    algorithm: str
+    codec: str
+    est_us: float
+    source: str  # "model" | "measured" | "config"
+
+
+@dataclass
+class SelectorConfig:
+    """Tunables for the cost model + measured table (see the ``collectives``
+    config block in ``config/config.py``)."""
+
+    # "auto": measured when a decision table is loaded, the alpha-beta model
+    # otherwise; "model"/"measured" pin one source explicitly.
+    mode: str = "auto"  # auto | model | measured
+    alpha_us: float = 1.0  # per-hop latency
+    beta_us_per_mb: float = 10.0  # inverse link bandwidth (~100 GB/s)
+    codecs: Tuple[str, ...] = ("none",)  # candidate wire codecs
+    block_size: int = 2048
+    decision_table: Optional[str] = None  # JSON path from benchmark --sweep
+    # payloads below this skip quantization entirely (scales overhead + host
+    # side compute dominate); matches ZeRO++'s "quantize the big tensors"
+    min_quant_bytes: int = 1 << 16
+    # payloads below this stay on the native lax lowering in model mode: a
+    # tiny psum as 2(n-1) serial ppermute hops loses to XLA's built-in
+    # collective at any alpha; the "lax" verdict is the model's analog of
+    # measured mode's don't-bother rows
+    min_algorithmic_bytes: int = 1 << 12
+    # Facade defaults (the `collectives` config block's algorithm/codec):
+    # applied by comm.all_reduce/all_gather/reduce_scatter when the call
+    # passes no explicit algorithm/codec. None = plain jax.lax lowering.
+    facade_algorithm: Optional[str] = None  # "auto" | concrete name | None
+    facade_codec: Optional[str] = None
+
+
+_lock = threading.Lock()
+_config = SelectorConfig()
+_cache: Dict[Tuple[str, int, int, Optional[str], int], Decision] = {}
+_measured: List[dict] = []
+_stats = {"hits": 0, "misses": 0}
+
+
+def configure(config: Optional[SelectorConfig] = None, **kwargs) -> SelectorConfig:
+    """Install selector tunables (process-global, like the telemetry tracer);
+    clears the decision cache. Accepts a ``SelectorConfig`` or field kwargs."""
+    global _config
+    with _lock:
+        # copy, never mutate the caller's template instance
+        cfg = dc_replace(config, **kwargs) if config is not None else SelectorConfig(**kwargs)
+        _config = cfg
+        _cache.clear()
+        _measured.clear()
+        _stats["hits"] = _stats["misses"] = 0
+        if cfg.decision_table and cfg.mode != "model":
+            try:
+                with open(cfg.decision_table) as f:
+                    rows = json.load(f)
+                _measured.extend(rows if isinstance(rows, list) else rows.get("rows", []))
+            except (OSError, ValueError) as e:
+                logger.warning(
+                    f"collectives: decision table {cfg.decision_table!r} unreadable "
+                    f"({e}); falling back to the alpha-beta model")
+    return _config
+
+
+def get_config() -> SelectorConfig:
+    return _config
+
+
+def cache_info() -> Dict[str, int]:
+    with _lock:
+        return {"entries": len(_cache), **_stats}
+
+
+# ----------------------------------------------------------------- the model
+
+
+def _hops_and_volume(op: str, algorithm: str, nbytes: int, n: int) -> Tuple[int, float]:
+    """(serial hop count, bytes crossing the busiest link) for one op.
+
+    ``nbytes`` is what the facade queries with: the LOCAL payload. For
+    all_reduce / reduce_scatter that is the full pre-reduction array (link
+    volume ``2(n-1)/n * S`` / ``(n-1)/n * S``); for all_gather it is the
+    SHARD, of which every link relays n-1 peers' worth: ``(n-1) * s``.
+    """
+    ring_steps = n - 1
+    log_steps = max(int(math.ceil(math.log2(n))), 1) if n > 1 else 0
+    frac = (n - 1) / n if n > 1 else 0.0
+    if op == "all_reduce":
+        base = 2 * frac * nbytes
+    elif op == "all_gather":
+        base = ring_steps * nbytes
+    else:  # reduce_scatter
+        base = frac * nbytes
+    if algorithm == "lax":
+        # the native XLA lowering: assume the best exact schedule the
+        # hardware offers (bidirectional, so half the per-link volume) with
+        # no per-hop dispatch penalty — the conservative baseline every
+        # algorithmic candidate must beat, so exact-wire rerouting never
+        # wins and quantized routing must earn its keep
+        return 0, base / 2
+    if op == "all_reduce":
+        vol = base
+        if algorithm == "ring":
+            return 2 * ring_steps, vol
+        if algorithm == "bidir":
+            # two counter-rotating rings each carry half the payload
+            return 2 * ring_steps, vol / 2
+        if algorithm == "rhd":
+            return 2 * log_steps, vol
+        if algorithm == "ring2d":
+            # the SAME factorization the execution path uses
+            a, b = _factor_near_square(n)
+            hops = (b - 1) + 2 * (a - 1) + (b - 1)
+            vol = nbytes * ((b - 1) / b + 2 * (a - 1) / (a * b) + (b - 1) / b)
+            return hops, vol
+    else:  # all_gather / reduce_scatter
+        vol = base
+        if algorithm in ("ring", "ring2d"):
+            return ring_steps, vol
+        if algorithm == "bidir":
+            return ring_steps, vol / 2
+        if algorithm == "rhd":
+            return log_steps, vol
+    raise ValueError(f"no cost model for op={op!r} algorithm={algorithm!r}")
+
+
+def estimate_us(op: str, algorithm: str, codec: str, nbytes: int, n: int,
+                cfg: Optional[SelectorConfig] = None, itemsize: int = 4) -> float:
+    """Alpha-beta time estimate for one (algorithm, codec) pair.
+
+    ``itemsize`` is the payload element width: the link volume converts to
+    an element count before the codec's wire-byte model applies, so a bf16
+    payload's int8 wire is costed at ~1/2, not the fp32 default's ~1/4."""
+    cfg = cfg or _config
+    hops, vol = _hops_and_volume(op, algorithm, nbytes, n)
+    c = get_codec(codec, cfg.block_size)
+    wire = c.wire_bytes(max(int(vol // itemsize), 1), itemsize)
+    return hops * cfg.alpha_us + (wire / 1e6) * cfg.beta_us_per_mb
+
+
+def _model_pick(op: str, nbytes: int, n: int, codec: Optional[str],
+                cfg: SelectorConfig, itemsize: int = 4) -> Decision:
+    if nbytes < cfg.min_algorithmic_bytes and codec in (None, "none"):
+        # the native lowering cannot apply a wire codec, so the lax floor
+        # only covers queries that didn't force one
+        return Decision(op, "lax", "none", 0.0, "model")
+    codecs = (codec,) if codec else tuple(cfg.codecs) or ("none",)
+    if codec is None and nbytes < cfg.min_quant_bytes:
+        # small payloads never auto-quantize (scale overhead dominates); the
+        # exact wire is always a legal candidate even when the configured
+        # candidate list is all-lossy (e.g. codecs=["int8"])
+        codecs = tuple(c for c in codecs if c == "none") or ("none",)
+    pow2 = n > 0 and not (n & (n - 1))
+    # the native lowering is a candidate whenever no lossy codec is forced:
+    # an exact-wire algorithmic collective moves the same bytes as XLA's
+    # fused native one plus hop latency, so it can only win by shrinking
+    # the wire — but a FORCED lossy codec needs an algorithmic carrier
+    best: Optional[Decision] = None
+    if codec in (None, "none"):
+        best = Decision(op, "lax", "none",
+                        estimate_us(op, "lax", "none", nbytes, n, cfg, itemsize),
+                        "model")
+    for alg in ALGORITHMS:
+        if alg == "rhd" and not pow2:
+            continue
+        for cd in codecs:
+            est = estimate_us(op, alg, cd, nbytes, n, cfg, itemsize)
+            if best is None or est < best.est_us:
+                best = Decision(op, alg, cd, est, "model")
+    assert best is not None
+    return best
+
+
+def _measured_pick(op: str, nbytes: int, n: int, codec: Optional[str],
+                   cfg: SelectorConfig) -> Optional[Decision]:
+    if codec is not None:
+        allowed = {codec}
+    else:
+        # same guardrails as the model path: only configured codec
+        # candidates, and never a lossy wire under min_quant_bytes —
+        # measured rows for a bigger bucket must not smuggle one in
+        allowed = set(cfg.codecs) | {"none"}
+        if nbytes < cfg.min_quant_bytes:
+            allowed = {"none"}
+    rows = [r for r in _measured
+            if r.get("op") == op and int(r.get("world", 0)) == n
+            and r.get("codec", "none") in allowed]
+    if not rows:
+        return None
+    size_mb = nbytes / 1e6
+
+    def closeness(r):
+        return abs(math.log((float(r["size_mb"]) + 1e-9) / (size_mb + 1e-9)))
+
+    nearest = min(closeness(r) for r in rows)
+    bucket = [r for r in rows if closeness(r) <= nearest + 1e-12]
+    win = min(bucket, key=lambda r: float(r["latency_ms"]))
+    return Decision(op, win["algorithm"], win.get("codec", "none"),
+                    float(win["latency_ms"]) * 1e3, "measured")
+
+
+def pick_codec(op: str, nbytes: int, axis_size: int, algorithm: str,
+               itemsize: int = 4) -> str:
+    """Best wire codec from the configured candidates for a FORCED
+    algorithm (the config block's concrete ``algorithm`` + ``codec: auto``
+    combination) — same guardrails as the joint model pick."""
+    cfg = _config
+    if nbytes < cfg.min_quant_bytes:
+        return "none"
+    alg = algorithm if algorithm in ALGORITHMS else "ring"
+    candidates = tuple(cfg.codecs) or ("none",)
+    return min(candidates,
+               key=lambda cd: estimate_us(op, alg, cd, nbytes, axis_size, cfg, itemsize))
+
+
+def _bytes_bucket(nbytes: int) -> int:
+    """Power-of-two size bucket so near-identical payloads share a cache
+    entry (and one telemetry decision event)."""
+    return max(int(nbytes), 1).bit_length()
+
+
+def select(op: str, nbytes: int, axis_size: int, codec: Optional[str] = None,
+           itemsize: int = 4) -> Decision:
+    """Pick (algorithm, codec) for one collective; cached per
+    (op, bytes-bucket, axis-size, payload itemsize[, forced codec])."""
+    if op not in OPS:
+        raise ValueError(f"unknown op {op!r} (one of {OPS})")
+    key = (op, _bytes_bucket(nbytes), int(axis_size), codec, int(itemsize))
+    with _lock:
+        hit = _cache.get(key)
+        if hit is not None:
+            _stats["hits"] += 1
+            return hit
+        _stats["misses"] += 1
+        cfg = _config
+    decision = None
+    if nbytes < cfg.min_algorithmic_bytes and codec in (None, "none"):
+        # the lax floor applies in EVERY mode: a measured table's smallest
+        # swept size must not extrapolate onto tiny step-critical psums.
+        # A FORCED lossy codec needs an algorithmic path, so it bypasses it.
+        decision = Decision(op, "lax", "none", 0.0, "model")
+    elif cfg.mode == "measured" or (cfg.mode == "auto" and _measured):
+        decision = _measured_pick(op, nbytes, axis_size, codec, cfg)
+    if decision is None:
+        decision = _model_pick(op, nbytes, axis_size, codec, cfg, itemsize)
+    with _lock:
+        decision = _cache.setdefault(key, decision)
+    tracer = telemetry.get_tracer()
+    if tracer.enabled:
+        tracer.instant("coll:select", cat="coll", op=op, bytes=int(nbytes),
+                       world=int(axis_size), algorithm=decision.algorithm,
+                       codec=decision.codec, est_us=round(decision.est_us, 3),
+                       source=decision.source)
+    return decision

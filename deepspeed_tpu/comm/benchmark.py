@@ -43,6 +43,21 @@ def _collective_fn(op: str, axis: str):
 _busbw_factor = CommsLogger._bus_factor
 
 
+def _time_collective(f, x, iters: int, warmup: int) -> float:
+    """Compile + warm up, then mean seconds/call. Syncs by fetching a scalar
+    (block_until_ready is a no-op on some experimental platforms — PERF.md);
+    the ONE timing idiom for bench and sweep rows."""
+    r = f(x)  # compile + first run (counts as warmup)
+    for _ in range(max(warmup - 1, 0)):
+        r = f(x)
+    np.asarray(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = f(x)
+    np.asarray(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+    return (time.perf_counter() - t0) / iters
+
+
 def run_collective_bench(
     op: str,
     sizes_mb: List[float],
@@ -71,15 +86,7 @@ def run_collective_bench(
                           out_specs=P() if op == "all_reduce" else P(axis),
                           check_vma=False)
         )
-        r = f(x)  # compile + first run (counts as warmup)
-        for _ in range(max(warmup - 1, 0)):
-            r = f(x)
-        np.asarray(jax.tree_util.tree_leaves(r)[0].ravel()[0])
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            r = f(x)
-        np.asarray(jax.tree_util.tree_leaves(r)[0].ravel()[0])
-        dt = (time.perf_counter() - t0) / iters
+        dt = _time_collective(f, x, iters, warmup)
 
         payload = elems * itemsize  # global payload bytes
         algbw = payload / dt
@@ -93,6 +100,91 @@ def run_collective_bench(
     return rows
 
 
+_SWEEP_OPS = ("all_reduce", "all_gather", "reduce_scatter")
+
+
+def _algorithmic_fn(op: str, axis: str, algorithm: str, codec: str, block_size: int):
+    """Per-device body routing through the comm facade's algorithmic path
+    (so the sweep measures exactly what ``selector`` will later dispatch)."""
+    from deepspeed_tpu.comm import comm as dist
+
+    if op == "all_reduce":
+        return lambda x: dist.all_reduce(x, axis, algorithm=algorithm, codec=codec,
+                                         block_size=block_size)
+    if op == "all_gather":
+        return lambda x: dist.all_gather(x, axis, algorithm=algorithm, codec=codec,
+                                         block_size=block_size)
+    if op == "reduce_scatter":
+        return lambda x: dist.reduce_scatter(x, axis, algorithm=algorithm, codec=codec,
+                                             block_size=block_size)
+    raise ValueError(f"sweep op {op!r} not algorithmic (one of {_SWEEP_OPS})")
+
+
+def run_sweep(
+    ops=_SWEEP_OPS,
+    sizes_mb: Optional[List[float]] = None,
+    axis: str = "dp",
+    mesh: Optional[Mesh] = None,
+    algorithms: Optional[List[str]] = None,
+    codecs: Optional[List[str]] = None,
+    iters: int = 5,
+    warmup: int = 2,
+    block_size: int = 2048,
+    dtype=jnp.bfloat16,
+) -> List[Dict]:
+    """Measure every (op, size, algorithm, codec) combination and return the
+    decision-table rows ``selector.configure(decision_table=...)`` consumes
+    (one JSON row per measurement: op/world/size_mb/algorithm/codec/
+    latency_ms/busbw_gbps; ``size_mb`` is the PER-DEVICE payload, matching
+    the local-shard bytes the selector is queried with). The lax baseline
+    rides along as ``algorithm="lax"`` so measured mode can conclude
+    "don't bother"."""
+    from deepspeed_tpu.collectives.algorithms import ALGORITHMS
+
+    sizes_mb = sizes_mb if sizes_mb is not None else [0.25, 1.0, 4.0]
+    algorithms = algorithms if algorithms is not None else ["lax"] + list(ALGORITHMS)
+    codecs = codecs if codecs is not None else ["none"]
+    mesh = mesh if mesh is not None else build_mesh(axis_sizes={axis: -1})
+    n = mesh.shape[axis]
+    itemsize = jnp.dtype(dtype).itemsize
+    pow2 = not (n & (n - 1))
+
+    rows: List[Dict] = []
+    for op in ops:
+        for size_mb in sizes_mb:
+            elems = max(int(size_mb * 1e6 / itemsize), n)
+            # per-device shard must itself divide by n for reduce_scatter
+            # (lane-aligned too), so round to a multiple of n*n*128
+            base = n * n * 128
+            elems = (elems // base) * base or base
+            x = jax.device_put(jnp.ones((elems,), dtype), NamedSharding(mesh, P(axis)))
+            for alg in algorithms:
+                if alg == "rhd" and not pow2:
+                    continue
+                for codec in codecs:
+                    if alg == "lax" and codec != "none":
+                        continue  # the lax lowering has no wire codec
+                    fn = (_collective_fn(op, axis) if alg == "lax"
+                          else _algorithmic_fn(op, axis, alg, codec, block_size))
+                    out_spec = P() if op == "all_reduce" else P(axis)
+                    f = jax.jit(shard_map(fn, mesh=mesh, in_specs=P(axis),
+                                          out_specs=out_spec, check_vma=False))
+                    dt = _time_collective(f, x, iters, warmup)
+                    payload = elems * itemsize
+                    busbw = payload / dt * _busbw_factor(op, n)
+                    # size_mb is the PER-DEVICE payload: selector.select is
+                    # queried at trace time with the local shard's bytes
+                    # (inside shard_map), so table rows must bucket the same
+                    # quantity or measured mode matches a world-x-off regime
+                    rows.append({
+                        "op": op, "world": n, "size_mb": round(payload / n / 1e6, 4),
+                        "algorithm": alg, "codec": codec,
+                        "latency_ms": round(dt * 1e3, 4),
+                        "busbw_gbps": round(busbw / 1e9, 3),
+                    })
+    return rows
+
+
 def main(argv=None) -> int:  # pragma: no cover - CLI body exercised via run_collective_bench
     import argparse
     import json
@@ -102,10 +194,38 @@ def main(argv=None) -> int:  # pragma: no cover - CLI body exercised via run_col
     p.add_argument("--axis", default="dp")
     p.add_argument("--sizes-mb", default="1,8,64,256")
     p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--sweep", action="store_true",
+                   help="sweep algorithms x codecs and emit a selector decision table")
+    p.add_argument("--codecs", default="none",
+                   help="comma-separated wire codecs for --sweep (none,bf16,int8,fp8)")
+    p.add_argument("--output", default=None,
+                   help="write the --sweep decision table JSON here (default stdout)")
     a = p.parse_args(argv)
     sizes = [float(s) for s in a.sizes_mb.split(",")]
+    if a.sweep:
+        ops = _SWEEP_OPS if a.op == "all" else (a.op,)
+        bad = [op for op in ops if op not in _SWEEP_OPS]
+        if bad:
+            p.error(f"--sweep supports {_SWEEP_OPS}, not {bad} "
+                    f"(the algorithmic library has no all_to_all)")
+        rows = run_sweep(ops=ops, sizes_mb=sizes, axis=a.axis, iters=a.iters,
+                         codecs=[c for c in a.codecs.split(",") if c])
+        payload = json.dumps(rows, indent=1)
+        if a.output:
+            with open(a.output, "w") as f:
+                f.write(payload)
+            print(f"wrote {len(rows)} decision rows to {a.output}")
+        else:
+            print(payload)
+        return 0
     ops = OPS if a.op == "all" else (a.op,)
     for op in ops:
         for row in run_collective_bench(op, sizes, axis=a.axis, iters=a.iters):
             print(json.dumps(row))
     return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - bin/ds_bench is the usual entry
+    import sys
+
+    sys.exit(main())

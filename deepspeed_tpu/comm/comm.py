@@ -140,12 +140,16 @@ def log_summary():
 def _axis_size(axis) -> int:
     from deepspeed_tpu.utils.compat import axis_size
 
+    # compat resolves the axis-size API move (unit-psum fallback on older
+    # jax); outside a bound axis context the size is unknowable -> 1
+    return axis_size(axis, default=1)
+
+
+def _itemsize(x) -> int:
     try:
-        # compat resolves the axis-size API move (unit-psum fallback on
-        # older jax); outside a bound axis context the size is unknowable
-        return axis_size(axis)
+        return jnp.dtype(x.dtype).itemsize
     except Exception:
-        return 1
+        return 4
 
 
 def _nbytes(x) -> int:
@@ -155,7 +159,7 @@ def _nbytes(x) -> int:
         return 0
 
 
-def _record(op_name: str, axis, x):
+def _record(op_name: str, axis, x, **tags):
     """Record one collective into the comms logger AND the telemetry
     subsystem; returns a span context wrapping the ``jax.lax`` call.
 
@@ -163,6 +167,8 @@ def _record(op_name: str, axis, x):
     time: the span duration is host tracing time (one per compiled program,
     not per execution), while the (op, axis, dtype, bytes, world) tags are
     the exact per-execution collective workload of the traced step.
+    ``tags`` carries extra span attributes (algorithm/codec on the
+    algorithmic path) so routing decisions are visible in the trace.
     """
     axis_str = "+".join(axis) if isinstance(axis, (tuple, list)) else str(axis)
     nbytes, world = _nbytes(x), _axis_size(axis)
@@ -175,16 +181,91 @@ def _record(op_name: str, axis, x):
     tracer.count(f"comm/bytes/{op_name}", nbytes)
     dtype = str(getattr(x, "dtype", "unknown"))
     return tracer.span(f"comm:{op_name}", cat="comm", op=op_name, axis=axis_str,
-                       bytes=nbytes, dtype=dtype, world=world)
+                       bytes=nbytes, dtype=dtype, world=world, **tags)
 
 
 # --------------------------------------------------------------------------
 # collectives (usable inside shard_map / jit with bound axis names)
 # --------------------------------------------------------------------------
+#
+# ``algorithm=`` / ``codec=`` route through deepspeed_tpu.collectives (the
+# hop-composed algorithmic library): algorithm None keeps the plain jax.lax
+# lowering (XLA picks the implementation), "auto" asks collectives.selector
+# for the best (algorithm, codec) per (op, bytes, axis size), and a concrete
+# name ("ring" / "bidir" / "rhd" / "ring2d") forces it. The algorithmic path
+# must run inside FULL-MANUAL shard_map (see utils/compat.py).
 
 
-def all_reduce(x, axis, op: str = "sum"):
+def _algorithmic(op_name: str, x, axis, algorithm, codec, reduce_op: str = "sum"):
+    """Resolve (algorithm, codec) — consulting the selector for "auto" —
+    and tag the choice on the facade span.
+
+    A call with no explicit algorithm/codec first picks up the process
+    defaults the ``collectives`` config block installed
+    (``selector.SelectorConfig.facade_algorithm/codec``). Default-routed
+    calls stay on the lax lowering when the algorithmic path cannot serve
+    them (multi-axis tuples, max/min reductions) and never apply a lossy
+    codec to non-float payloads (token ids, the already-int8 zeropp wire);
+    an EXPLICIT algorithm/codec argument is honored verbatim and surfaces
+    the library's own errors instead."""
+    from deepspeed_tpu.collectives import selector
+
+    explicit = algorithm is not None or codec is not None
+    from_config = False
+    if not explicit:
+        cfg = selector.get_config()
+        if cfg.facade_algorithm is None:
+            return None, None
+        if isinstance(axis, (tuple, list)) and len(axis) > 1:
+            return None, None  # hierarchical tuples only when asked for
+        if reduce_op not in ("sum", "mean", "avg"):
+            return None, None  # algorithmic all_reduce has no max/min
+        if not jnp.issubdtype(getattr(x, "dtype", jnp.float32), jnp.floating):
+            # integer payloads (token ids, counters, the zeropp int8 wire)
+            # keep the native lowering under default routing
+            return None, None
+        algorithm, codec = cfg.facade_algorithm, cfg.facade_codec
+        from_config = True
+    if algorithm == "lax":
+        return None, None
+    if algorithm in (None, "auto"):
+        if codec is None and not jnp.issubdtype(
+                getattr(x, "dtype", jnp.float32), jnp.floating):
+            codec = "none"
+        d = selector.select(op_name, _nbytes(x), _axis_size(axis), codec,
+                            itemsize=_itemsize(x))
+        if d.algorithm == "lax":
+            # measured mode's "don't bother" verdict: the baseline won
+            return None, None
+        return d.algorithm, d.codec
+    if codec is None and from_config:
+        # concrete configured algorithm + codec "auto": the selector still
+        # picks the wire among the configured candidates
+        codec = selector.pick_codec(op_name, _nbytes(x), _axis_size(axis),
+                                    algorithm, itemsize=_itemsize(x))
+    return algorithm, codec or "none"
+
+
+def _resolved_block_size(block_size: Optional[int]) -> Optional[int]:
+    """The configured quantization block for auto-routed collectives (the
+    caller's explicit block_size wins)."""
+    if block_size is not None:
+        return block_size
+    from deepspeed_tpu.collectives import selector
+
+    return selector.get_config().block_size
+
+
+def all_reduce(x, axis, op: str = "sum", *, algorithm: Optional[str] = None,
+               codec: Optional[str] = None, block_size: Optional[int] = None):
     """psum/pmax/pmin over a named axis (reference ``all_reduce`` ``comm/comm.py``)."""
+    alg, cd = _algorithmic("all_reduce", x, axis, algorithm, codec, reduce_op=op)
+    if alg is not None:
+        from deepspeed_tpu import collectives
+
+        with _record(f"all_reduce_{op}", axis, x, algorithm=alg, codec=cd):
+            return collectives.all_reduce(x, axis, algorithm=alg, codec=cd, op=op,
+                                          block_size=_resolved_block_size(block_size))
     with _record(f"all_reduce_{op}", axis, x):
         if op == "sum":
             return jax.lax.psum(x, axis)
@@ -197,14 +278,46 @@ def all_reduce(x, axis, op: str = "sum"):
         raise ValueError(f"unsupported reduce op {op!r}")
 
 
-def all_gather(x, axis, *, concat_axis: int = 0, tiled: bool = True):
+def all_gather(x, axis, *, concat_axis: int = 0, tiled: bool = True,
+               algorithm: Optional[str] = None, codec: Optional[str] = None,
+               block_size: Optional[int] = None):
     """all_gather over a named axis (reference ``all_gather_into_tensor``)."""
+    if not tiled:
+        # untiled gathers have no algorithmic form: explicit requests get a
+        # clear error, default routing skips the selector entirely (no
+        # cached decision / coll:select event for a path never taken)
+        if algorithm is not None or codec is not None:
+            raise ValueError("algorithmic all_gather supports tiled=True only")
+        alg = cd = None
+    else:
+        alg, cd = _algorithmic("all_gather", x, axis, algorithm, codec)
+    if alg is not None:
+        from deepspeed_tpu import collectives
+        with _record("all_gather", axis, x, algorithm=alg, codec=cd):
+            return collectives.all_gather(x, axis, algorithm=alg, codec=cd,
+                                          concat_axis=concat_axis,
+                                          block_size=_resolved_block_size(block_size))
     with _record("all_gather", axis, x):
         return jax.lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
 
 
-def reduce_scatter(x, axis, *, scatter_axis: int = 0, tiled: bool = True):
+def reduce_scatter(x, axis, *, scatter_axis: int = 0, tiled: bool = True,
+                   algorithm: Optional[str] = None, codec: Optional[str] = None,
+                   block_size: Optional[int] = None):
     """psum_scatter (reference ``reduce_scatter_tensor``)."""
+    if not tiled:
+        # untiled scatters have no algorithmic form (see all_gather above)
+        if algorithm is not None or codec is not None:
+            raise ValueError("algorithmic reduce_scatter supports tiled=True only")
+        alg = cd = None
+    else:
+        alg, cd = _algorithmic("reduce_scatter", x, axis, algorithm, codec)
+    if alg is not None:
+        from deepspeed_tpu import collectives
+        with _record("reduce_scatter", axis, x, algorithm=alg, codec=cd):
+            return collectives.reduce_scatter(x, axis, algorithm=alg, codec=cd,
+                                              scatter_axis=scatter_axis,
+                                              block_size=_resolved_block_size(block_size))
     with _record("reduce_scatter", axis, x):
         return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=tiled)
 

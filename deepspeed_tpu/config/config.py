@@ -288,6 +288,42 @@ class DiagnosticsConfig(DeepSpeedConfigModel):
     flight_recorder: FlightRecorderConfig = Field(default_factory=FlightRecorderConfig)
 
 
+class CollectivesConfig(DeepSpeedConfigModel):
+    """collectives section — the algorithmic collective library
+    (``deepspeed_tpu/collectives``): hop-composed ring / bidirectional-ring /
+    recursive-halving-doubling / hierarchical-2D algorithms with per-hop wire
+    codecs, selected per (op, bytes, axis-size) by an alpha-beta cost model
+    or a measured decision table (``comm/benchmark.py --sweep``). Disabled
+    (the default), the ``comm`` facade keeps its plain ``jax.lax`` lowering
+    and the compiled program is unchanged."""
+
+    enabled: bool = False
+    # Facade default when a single-axis collective is issued without explicit
+    # arguments ("auto" consults the selector; a concrete name forces one
+    # algorithm). Installed process-wide by the engine when enabled, so ALL
+    # facade collectives — including the zeropp gathers — route through it.
+    algorithm: str = "auto"  # auto | ring | bidir | rhd | ring2d | lax
+    # "auto" lets the selector pick among `codecs`; any concrete name —
+    # including "none" — FORCES that wire for every default-routed collective.
+    codec: str = "auto"  # auto | none | fp32 | bf16 | int8 | fp8
+    # Candidate codecs the selector may choose among in auto mode.
+    codecs: List[str] = Field(default_factory=lambda: ["none"])
+    # auto = measured when decision_table is set, alpha-beta model otherwise
+    mode: str = "auto"  # auto | model (alpha-beta) | measured (decision table)
+    decision_table: Optional[str] = None  # JSON from `benchmark --sweep`
+    alpha_us: float = 1.0  # per-hop latency for the cost model
+    beta_us_per_mb: float = 10.0  # inverse link bandwidth (~100 GB/s)
+    block_size: int = 2048  # quantization block for int8/fp8 wire codecs
+    # Payloads below this never auto-quantize (scale overhead dominates).
+    min_quant_bytes: int = 65536
+    # Payloads below this stay on the native lax lowering in model mode
+    # (tiny collectives are latency-bound; serial hops lose to XLA's own).
+    min_algorithmic_bytes: int = 4096
+    # T3-style double buffering of the zeropp qwZ gather wire: chunk count
+    # (1 = off). Chunk k's dequantize overlaps chunk k+1's gather.
+    overlap_chunks: int = 1
+
+
 class CommsLoggerConfig(DeepSpeedConfigModel):
     enabled: bool = False
     verbose: bool = False
@@ -349,6 +385,7 @@ class EngineConfig(DeepSpeedConfigModel):
     wandb: WandbConfig = Field(default_factory=WandbConfig)
     flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
     comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    collectives: CollectivesConfig = Field(default_factory=CollectivesConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     diagnostics: DiagnosticsConfig = Field(default_factory=DiagnosticsConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)

@@ -6,20 +6,14 @@ dequant-reduce) and the qwZ quantized weight allgather
 (``zero/partition_parameters.py:1200`` ``all_gather_coalesced(quantize=True)``),
 backed by ``csrc/quantization/swizzled_quantize.cu`` / ``quant_reduce.cu``.
 
-TPU-native redesign: quantization is the Pallas/XLA int8 block quantizer
-(``ops/quant.py``) and the communication is a plain ``jax.lax`` collective the
-compiler schedules over ICI — the "2-hop intra-then-inter node" trick in the
-reference exists because NCCL trees are latency-bound across nodes; on a TPU
-slice XLA already routes all_to_all over ICI optimally, and on multi-slice
-meshes the hierarchical hop falls out of splitting the axis (ici x dcn) in the
-mesh rather than hand-written kernels.
+These are now thin wrappers over the shared wire codec layer
+(``collectives/codecs.py``): the int8 blockwise format (values + per-block
+fp32 scales, blocks never straddling a shard boundary) is defined exactly
+once there and reused by the hop-composed algorithms, the zeropp custom-vjp
+gathers, and these all_to_all helpers. Comm volume: int8 values + one f32
+scale per block ~= 4x reduction vs f32, 2x vs bf16.
 
-Blocking invariant: quantization blocks never straddle a shard boundary — each
-destination shard is padded up to a whole number of blocks before quantization
-so the (values, scales) pairs stay aligned through the collective.
-
-These functions must run inside ``shard_map`` (axis names bound). Comm volume:
-int8 values + one f32 scale per block ~= 4x reduction vs f32, 2x vs bf16.
+These functions must run inside ``shard_map`` (axis names bound).
 """
 
 from __future__ import annotations
@@ -27,70 +21,64 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.collectives.codecs import get_codec
 from deepspeed_tpu.comm import comm as dist
-from deepspeed_tpu.utils.compat import axis_size as _axis_size_compat
-from deepspeed_tpu.ops.quant import dequantize_int8, quantize_int8
+from deepspeed_tpu.utils.compat import axis_size
 
 DEFAULT_BLOCK = 2048
 
 
-def _padded(n: int, block: int) -> int:
-    return -(-n // block) * block
+def gather_wire(wire, axis):
+    """All-gather every non-empty leaf of a wire pytree (concat axis 0),
+    pinned to the plain lowering: an already-encoded wire must never route
+    back through the algorithmic/codec path. THE wire-movement idiom shared
+    with the zeropp custom-vjp gathers."""
+    return jax.tree_util.tree_map(
+        lambda w: w if w.size == 0 else dist.all_gather(
+            w, axis, concat_axis=0, algorithm="lax"), wire)
 
 
-def quantized_reduce_scatter(grad: jax.Array, axis: str, block_size: int = DEFAULT_BLOCK) -> jax.Array:
-    """qgZ analog: int8-quantized gradient reduce-scatter over ``axis``.
+def exchange_wire(wire, axis):
+    """All-to-all every non-empty leaf of a wire pytree (split/concat axis 0
+    — the qgZ destination-shard exchange)."""
+    return jax.tree_util.tree_map(
+        lambda w: w if w.size == 0 else dist.all_to_all(
+            w, axis, split_axis=0, concat_axis=0), wire)
+
+
+def quantized_reduce_scatter(grad: jax.Array, axis: str, block_size: int = DEFAULT_BLOCK,
+                             codec: str = "int8") -> jax.Array:
+    """qgZ analog: quantized gradient reduce-scatter over ``axis``.
 
     Input: full local gradient [N] (N divisible by axis size). Output: this
     rank's reduced shard [N / world], averaged over ranks. Exact math:
-    quantize per destination shard -> all_to_all -> dequantize -> mean.
+    encode per destination shard -> all_to_all -> decode -> mean.
     """
-    n = _axis_size_compat(axis)
+    n = axis_size(axis)
     flat = grad.reshape(-1)
     N = flat.shape[0]
     assert N % n == 0, f"grad numel {N} not divisible by axis size {n}"
     shard = N // n
-    block = min(block_size, shard)
-    shard_p = _padded(shard, block)  # blocks stay within one destination shard
-    rows = flat.reshape(n, shard)
-    if shard_p != shard:
-        rows = jnp.pad(rows, ((0, 0), (0, shard_p - shard)))
+    c = get_codec(codec, min(block_size, shard))
+    wire = c.encode_rows(flat.reshape(n, shard))  # row-aligned blocks per dest shard
 
-    vals, scales = quantize_int8(rows, block_size=block)  # row-aligned: shard_p % block == 0
-    vals = vals.reshape(n, shard_p)
-    scales = scales.reshape(n, shard_p // block)
-
-    # Each rank receives every peer's int8 copy of *its* shard (+ scales).
-    vals_t = dist.all_to_all(vals, axis, split_axis=0, concat_axis=0)  # [n, shard_p]
-    scales_t = dist.all_to_all(scales, axis, split_axis=0, concat_axis=0)
-
-    deq = dequantize_int8(
-        vals_t.reshape(-1), scales_t.reshape(-1), (n, shard_p), dtype=jnp.float32,
-        block_size=block,
-    )
-    return jnp.mean(deq[:, :shard], axis=0).astype(grad.dtype)
+    # Each rank receives every peer's encoded copy of *its* shard (+ scales).
+    deq = c.decode_rows(exchange_wire(wire, axis), shard, jnp.float32)  # [n, shard]
+    return jnp.mean(deq, axis=0).astype(grad.dtype)
 
 
-def quantized_all_gather(x: jax.Array, axis: str, block_size: int = DEFAULT_BLOCK) -> jax.Array:
-    """qwZ analog: int8-quantized weight allgather over ``axis``.
+def quantized_all_gather(x: jax.Array, axis: str, block_size: int = DEFAULT_BLOCK,
+                         codec: str = "int8") -> jax.Array:
+    """qwZ analog: quantized weight allgather over ``axis``.
 
-    Input: local shard [M]; output: dequantized full buffer [world * M] in
+    Input: local shard [M]; output: decoded full buffer [world * M] in
     x.dtype. Halves (vs bf16) the allgather bytes on the wire.
     """
     flat = x.reshape(-1)
     M = flat.shape[0]
-    block = min(block_size, M)
-    M_p = _padded(M, block)
-    if M_p != M:
-        flat = jnp.pad(flat, (0, M_p - M))
-
-    vals, scales = quantize_int8(flat, block_size=block)
-    # Gather the *padded* blocked buffers so per-rank block boundaries survive.
-    vals_g = dist.all_gather(vals.reshape(1, M_p), axis, concat_axis=0)  # [n, M_p]
-    scales_g = dist.all_gather(scales.reshape(1, -1), axis, concat_axis=0)
-    n = _axis_size_compat(axis)
-    deq = dequantize_int8(
-        vals_g.reshape(-1), scales_g.reshape(-1), (n, M_p), dtype=x.dtype,
-        block_size=block,
-    )
-    return deq[:, :M].reshape(n * M)
+    c = get_codec(codec, min(block_size, M))
+    wire = c.encode_rows(flat[None])  # [1, M] -> padded blocked wire
+    # Gather the *padded* blocked wire so per-rank block boundaries survive.
+    wire_g = gather_wire(wire, axis)
+    n = axis_size(axis)
+    return c.decode_rows(wire_g, M, x.dtype).reshape(n * M)

@@ -15,9 +15,14 @@ gradient computation inside a partial-manual ``shard_map`` over the data axes
 (dp/fsdp manual, tp/sp/... auto) so the collectives are addressable; XLA still
 schedules/overlaps them over ICI.
 
-Int8 block quantization comes from ``ops/quant.py`` (Pallas kernel on TPU);
-comm volume per gather/reduce is ~2x less than bf16, ~4x less than fp32 —
-the ZeRO++ headline (``docs/_tutorials/zeropp.md:6-17``).
+Int8 block quantization is the shared wire codec
+(``collectives/codecs.py`` — one format across the hop algorithms, the
+all_to_all helpers, and these custom-vjp gathers); comm volume per
+gather/reduce is ~2x less than bf16, ~4x less than fp32 — the ZeRO++
+headline (``docs/_tutorials/zeropp.md:6-17``). The weight gather optionally
+splits its wire into chunks double-buffered through
+``collectives/overlap.py`` so dequantize of chunk k overlaps the gather of
+chunk k+1 (T3-style).
 """
 
 from __future__ import annotations
@@ -30,8 +35,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
+from deepspeed_tpu.collectives.codecs import Int8BlockCodec
+from deepspeed_tpu.collectives.overlap import double_buffered
 from deepspeed_tpu.comm import comm as dist
-from deepspeed_tpu.ops.quant import dequantize_int8, quantize_int8
+from deepspeed_tpu.parallel.quant_collectives import exchange_wire, gather_wire
+from deepspeed_tpu.utils.compat import axis_size as _axis_size
 
 DEFAULT_BLOCK = 2048
 
@@ -72,30 +80,47 @@ def leaf_comm_plan(spec: Optional[PartitionSpec], live_axes: Tuple[str, ...]) ->
     return CommPlan(None)
 
 
-def _axis_size(axes) -> int:
-    from deepspeed_tpu.utils.compat import axis_size
+def _int8_all_gather_dim(x: jax.Array, dim: int, axes, block: int,
+                         overlap_chunks: int = 1) -> jax.Array:
+    """Encode the local shard once, gather the int8 wire, decode.
 
-    return axis_size(axes if isinstance(axes, tuple) else (axes,))
-
-
-def _int8_all_gather_dim(x: jax.Array, dim: int, axes, block: int) -> jax.Array:
-    """Quantize the local shard, gather int8 values+scales, dequantize."""
+    ``overlap_chunks > 1`` splits the wire into that many chunks and runs
+    them through the T3-style double buffer (``collectives/overlap.py``):
+    the decode of chunk k and the gather of chunk k+1 have no data
+    dependence, so XLA may overlap them — hiding dequantize time behind the
+    next chunk's transfer on an async-collective backend."""
     moved = jnp.moveaxis(x, dim, 0)
     rest = moved.shape[1:]
     flat = moved.reshape(-1)
     M = flat.shape[0]
-    blk = min(block, M)
-    M_p = -(-M // blk) * blk
-    if M_p != M:
-        flat = jnp.pad(flat, (0, M_p - M))
-    vals, scales = quantize_int8(flat, block_size=blk)
-    vals_g = dist.all_gather(vals.reshape(1, M_p), axes, concat_axis=0)
-    scales_g = dist.all_gather(scales.reshape(1, -1), axes, concat_axis=0)
+    codec = Int8BlockCodec(block_size=min(block, M))
     n = _axis_size(axes)
-    deq = dequantize_int8(
-        vals_g.reshape(-1), scales_g.reshape(-1), (n, M_p), dtype=x.dtype, block_size=blk
-    )
-    full = deq[:, :M].reshape((n * moved.shape[0],) + rest)
+
+    chunks = max(int(overlap_chunks), 1)
+    blk = codec.block_size
+    blocks_total = -(-M // blk)
+    chunks = min(chunks, blocks_total)  # a chunk is a whole number of blocks
+    if chunks <= 1:
+        wire = codec.encode_rows(flat[None])
+        deq = codec.decode_rows(gather_wire(wire, axes), M, x.dtype)  # [n, M]
+    else:
+        wire = codec.encode_rows(flat[None])  # q [1, Mp], s [1, Mp//blk]
+        Mp = wire.q.shape[1]
+        blocks_per = -(-blocks_total // chunks)
+        per = blocks_per * blk
+        chunks = -(-Mp // per)
+        pieces = [
+            type(wire)(q=wire.q[:, k * per:(k + 1) * per],
+                       s=wire.s[:, k * blocks_per:(k + 1) * blocks_per])
+            for k in range(chunks)
+        ]
+        gathered = double_buffered(
+            pieces,
+            comm_fn=lambda w: gather_wire(w, axes),
+            compute_fn=lambda wg: codec.decode_rows(wg, wg.q.shape[1], x.dtype),
+        )
+        deq = jnp.concatenate(gathered, axis=1)[:, :M]  # [n, M]
+    full = deq.reshape((n * moved.shape[0],) + rest)
     return jnp.moveaxis(full, 0, dim)
 
 
@@ -116,28 +141,19 @@ def _int8_rs_core(g: jax.Array, err, dim: int, axes, err_beta: float,
     D, rest = moved.shape[0], moved.shape[1:]
     flat = moved.reshape(-1)
     shard = flat.shape[0] // n
-    blk = min(block, shard)
-    shard_p = -(-shard // blk) * blk
+    codec = Int8BlockCodec(block_size=min(block, shard))
     rows = flat.reshape(n, shard)
-    if shard_p != shard:
-        rows = jnp.pad(rows, ((0, 0), (0, shard_p - shard)))
-    vals, scales = quantize_int8(rows, block_size=blk)
+    wire = codec.encode_rows(rows)
 
     new_err = None
     if err is not None:
         # local residual: exactly what this rank's wire payload dropped
-        local_deq = dequantize_int8(
-            vals.reshape(-1), scales.reshape(-1), (n, shard_p),
-            dtype=jnp.float32, block_size=blk)
-        new_err = (rows - local_deq)[:, :shard].reshape(moved.shape)
+        local_deq = codec.decode_rows(wire, shard, jnp.float32)
+        new_err = (rows - local_deq).reshape(moved.shape)
         new_err = jnp.moveaxis(new_err, 0, dim).astype(err.dtype)
 
-    vals_t = dist.all_to_all(vals.reshape(n, shard_p), axes, split_axis=0, concat_axis=0)
-    scales_t = dist.all_to_all(scales.reshape(n, -1), axes, split_axis=0, concat_axis=0)
-    deq = dequantize_int8(
-        vals_t.reshape(-1), scales_t.reshape(-1), (n, shard_p), dtype=jnp.float32,
-        block_size=blk)
-    red = jnp.mean(deq[:, :shard], axis=0)
+    deq = codec.decode_rows(exchange_wire(wire, axes), shard, jnp.float32)
+    red = jnp.mean(deq, axis=0)
     out = red.reshape((D // n,) + rest).astype(g.dtype)
     return jnp.moveaxis(out, 0, dim), new_err
 
@@ -164,7 +180,7 @@ def _exact_reduce_scatter_dim(g: jax.Array, dim: int, axes) -> jax.Array:
     return dist.reduce_scatter(g, axes, scatter_axis=dim) / n
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5, 6, 7))
 def sharded_weight_gather(
     shard: jax.Array,
     dim: int,
@@ -173,6 +189,7 @@ def sharded_weight_gather(
     quantize_weights: bool,
     quantize_grads: bool,
     block: int,
+    overlap_chunks: int = 1,
 ) -> jax.Array:
     """Differentiable ZeRO weight gather (must run inside shard_map).
 
@@ -183,15 +200,16 @@ def sharded_weight_gather(
               (data axes the weight was replicated over).
     """
     if quantize_weights:
-        return _int8_all_gather_dim(shard, dim, gather_axes, block)
+        return _int8_all_gather_dim(shard, dim, gather_axes, block, overlap_chunks)
     return _exact_all_gather_dim(shard, dim, gather_axes)
 
 
-def _swg_fwd(shard, dim, gather_axes, other_axes, qw, qg, block):
-    return sharded_weight_gather(shard, dim, gather_axes, other_axes, qw, qg, block), None
+def _swg_fwd(shard, dim, gather_axes, other_axes, qw, qg, block, overlap_chunks):
+    return sharded_weight_gather(shard, dim, gather_axes, other_axes, qw, qg,
+                                 block, overlap_chunks), None
 
 
-def _swg_bwd(dim, gather_axes, other_axes, qw, qg, block, _res, g):
+def _swg_bwd(dim, gather_axes, other_axes, qw, qg, block, overlap_chunks, _res, g):
     if qg:
         gs = _int8_reduce_scatter_dim(g, dim, gather_axes, block)
     else:
@@ -204,7 +222,7 @@ def _swg_bwd(dim, gather_axes, other_axes, qw, qg, block, _res, g):
 sharded_weight_gather.defvjp(_swg_fwd, _swg_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def sharded_weight_gather_loco(
     shard: jax.Array,
     err: jax.Array,
@@ -215,6 +233,7 @@ def sharded_weight_gather_loco(
     qw: bool,
     err_beta: float,
     block: int,
+    overlap_chunks: int = 1,
 ) -> jax.Array:
     """LoCo form of :func:`sharded_weight_gather`: same forward, but the
     backward's quantized reduce-scatter carries error feedback. The updated
@@ -227,17 +246,18 @@ def sharded_weight_gather_loco(
     dynamic loss-scale change between steps cannot corrupt the residuals
     (same invariant as the 1-bit path)."""
     if qw:
-        return _int8_all_gather_dim(shard, dim, gather_axes, block)
+        return _int8_all_gather_dim(shard, dim, gather_axes, block, overlap_chunks)
     return _exact_all_gather_dim(shard, dim, gather_axes)
 
 
-def _swgl_fwd(shard, err, inv, dim, gather_axes, other_axes, qw, err_beta, block):
+def _swgl_fwd(shard, err, inv, dim, gather_axes, other_axes, qw, err_beta, block,
+              overlap_chunks):
     out = sharded_weight_gather_loco(shard, err, inv, dim, gather_axes,
-                                     other_axes, qw, err_beta, block)
+                                     other_axes, qw, err_beta, block, overlap_chunks)
     return out, (err, inv)
 
 
-def _swgl_bwd(dim, gather_axes, other_axes, qw, err_beta, block, res, g):
+def _swgl_bwd(dim, gather_axes, other_axes, qw, err_beta, block, overlap_chunks, res, g):
     err_true, inv = res
     gs, new_err_wire = _int8_reduce_scatter_dim_loco(
         g, err_true / inv, dim, gather_axes, err_beta, block)
@@ -251,7 +271,8 @@ sharded_weight_gather_loco.defvjp(_swgl_fwd, _swgl_bwd)
 
 def gather_params_for_compute(params, plans, qw: bool, qg: bool, block: int = DEFAULT_BLOCK,
                               live_axes: Tuple[str, ...] = (),
-                              errors=None, err_beta: float = 0.8, inv=None):
+                              errors=None, err_beta: float = 0.8, inv=None,
+                              overlap_chunks: int = 1):
     """Map ``sharded_weight_gather`` over a param pytree inside shard_map.
 
     ``plans`` mirrors ``params`` with a ``CommPlan`` per leaf; replicated
@@ -267,7 +288,8 @@ def gather_params_for_compute(params, plans, qw: bool, qg: bool, block: int = DE
             if not plan.sharded:
                 return leaf
             other = tuple(a for a in live_axes if a not in plan.axes)
-            return sharded_weight_gather(leaf, plan.dim, plan.axes, other, qw, qg, block)
+            return sharded_weight_gather(leaf, plan.dim, plan.axes, other, qw, qg,
+                                         block, overlap_chunks)
 
         return jax.tree_util.tree_map(one, params, plans)
 
@@ -276,6 +298,6 @@ def gather_params_for_compute(params, plans, qw: bool, qg: bool, block: int = DE
             return leaf
         other = tuple(a for a in live_axes if a not in plan.axes)
         return sharded_weight_gather_loco(leaf, err, inv, plan.dim, plan.axes,
-                                          other, qw, err_beta, block)
+                                          other, qw, err_beta, block, overlap_chunks)
 
     return jax.tree_util.tree_map(one_loco, params, errors, plans)

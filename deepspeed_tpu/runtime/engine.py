@@ -108,6 +108,8 @@ class DeepSpeedTPUEngine:
             if bf16_cfg.enabled and not bf16_cfg.accumulate_grads_in_fp32
             else jnp.float32)
         seed = seed if seed is not None else self.config.model.seed
+        # resolved early: the step builders' closures read the overlap knob
+        self._collectives_cfg = self.config.model.collectives
         self._configure_offload()
 
         # ---- optimizer + schedule ----------------------------------------
@@ -244,6 +246,56 @@ class DeepSpeedTPUEngine:
                 memory_watermarks=tcfg.memory_watermarks,
                 trace_path=tcfg.trace_path, jsonl_path=tcfg.jsonl_path)
         self._tracer = telemetry_mod.get_tracer()
+        # Collectives (collectives/): install the selector tunables so comm
+        # facade calls with algorithm="auto" (and the zeropp overlap knob)
+        # follow this engine's config. Process-global like the tracer;
+        # disabled leaves the facade on the plain jax.lax lowering.
+        ccfg = self._collectives_cfg
+        from deepspeed_tpu.collectives import selector as coll_selector
+
+        if not ccfg.enabled:
+            # the selector is process-global: a disabled engine must restore
+            # the plain-lax defaults or it would inherit a previous engine's
+            # facade routing (the config block promises "disabled => the
+            # compiled program is unchanged"). Last-constructed engine wins —
+            # warn when this strips routing a live enabled engine installed.
+            if coll_selector.get_config().facade_algorithm is not None:
+                logger.warning(
+                    "collectives: resetting process-global facade routing "
+                    "installed by a previously constructed engine; set "
+                    "collectives.enabled in this engine's config to keep it")
+            coll_selector.configure()
+        else:
+            # Facade defaults inject ppermute hops into EVERY default-routed
+            # collective — including ones traced inside partial-manual
+            # shard_map regions (data axes manual, model axes auto), where
+            # ppermute hard-fails on this jax 0.4.37/XLA (PartitionId
+            # unsupported — see utils/compat.py). With nontrivial model
+            # axes, keep the selector tunables (explicit algorithm= calls
+            # still work in full-manual regions) but leave default routing
+            # on the lax lowering.
+            model_axes = [a for a in self.mesh.axis_names
+                          if a not in ("dp", "fsdp") and self.mesh.shape[a] > 1]
+            facade_alg = ccfg.algorithm
+            if model_axes and facade_alg not in (None, "lax"):
+                logger.warning(
+                    f"collectives: mesh has nontrivial model axes {model_axes} "
+                    f"(partial-manual shard_map regions; ppermute unsupported "
+                    f"there on this jax/XLA) — facade default routing stays on "
+                    f"the lax lowering; pass algorithm= explicitly inside "
+                    f"full-manual regions instead")
+                facade_alg = None
+            coll_selector.configure(
+                mode=ccfg.mode, alpha_us=ccfg.alpha_us,
+                beta_us_per_mb=ccfg.beta_us_per_mb,
+                codecs=tuple(ccfg.codecs), block_size=ccfg.block_size,
+                decision_table=ccfg.decision_table,
+                min_quant_bytes=ccfg.min_quant_bytes,
+                min_algorithmic_bytes=ccfg.min_algorithmic_bytes,
+                facade_algorithm=facade_alg,
+                # "auto" = no forced codec: the selector picks among `codecs`;
+                # a concrete name (incl. "none") pins that wire
+                facade_codec=ccfg.codec if ccfg.codec != "auto" else None)
         if self.config.model.dump_state:
             # reference engine.py dump_state: print the resolved config once
             log_dist(f"engine config: {self.config.model.model_dump()}", ranks=[0])
@@ -653,7 +705,14 @@ class DeepSpeedTPUEngine:
                     lambda x: x.sharding, opt_state)
             else:
                 self.opt_sharding = jax.tree_util.tree_map(lambda _: host_sh, opt_shapes)
-                opt_state = jax.jit(self.tx.init)(params)  # inputs committed to host => runs on the cpu backend
+                # out_shardings COMMITS the moments to the host device. A bare
+                # jit leaves its outputs uncommitted, while every later
+                # offload_update_step output is committed — that placement
+                # flip recompiled the host update once on call 2 (found by
+                # the PR-2 RecompileDetector).
+                opt_state = jax.jit(
+                    self.tx.init, out_shardings=self.opt_sharding
+                )(params)  # inputs committed to host => runs on the cpu backend
             ls_state = make_loss_scale_state(
                 enabled=self.fp16,
                 initial_scale_power=self.config.model.fp16.initial_scale_power,
@@ -894,7 +953,8 @@ class DeepSpeedTPUEngine:
                     shards, errs_ = shards_errs
                     full = zeropp.gather_params_for_compute(
                         shards, plans, qw, qg, live_axes=live,
-                        errors=errs_, err_beta=err_beta, inv=inv)
+                        errors=errs_, err_beta=err_beta, inv=inv,
+                        overlap_chunks=self._overlap_chunks())
                     loss, _aux = self._loss_and_aux(full, b, rr)
                     return (loss.astype(jnp.float32) * scale).astype(
                         self.compute_dtype if self.fp16 else jnp.float32), loss
@@ -926,7 +986,9 @@ class DeepSpeedTPUEngine:
             )
 
             def scaled_loss(shards, b, rr):
-                full = zeropp.gather_params_for_compute(shards, plans, qw, qg, live_axes=live)
+                full = zeropp.gather_params_for_compute(
+                    shards, plans, qw, qg, live_axes=live,
+                    overlap_chunks=self._overlap_chunks())
                 loss, _aux = self._loss_and_aux(full, b, rr)
                 return (loss.astype(jnp.float32) * scale).astype(self.compute_dtype if self.fp16 else jnp.float32), loss
 
@@ -946,6 +1008,12 @@ class DeepSpeedTPUEngine:
             axis_names=set(live),
             check_vma=False,
         )
+
+    def _overlap_chunks(self) -> int:
+        """zeropp gather chunking, honored only when the collectives block
+        is enabled (disabled must compile the identical program)."""
+        cfg = self._collectives_cfg
+        return cfg.overlap_chunks if cfg.enabled else 1
 
     def _onebit_config(self):
         """Live data axes when 1-bit compressed gradient allreduce is active.

