@@ -192,6 +192,7 @@ def bench_train_gpt2(on_tpu, peak_flops):
             "gradient_accumulation_steps": gas,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
             "zero_optimization": {"stage": 1},
+            "hbm_guard": {"enabled": True},
             "bf16": {"enabled": True},
             "gradient_clipping": 1.0,
             "steps_per_print": 10_000,
@@ -244,6 +245,7 @@ def bench_train_llama_z3(peak_flops):
             "train_micro_batch_size_per_gpu": 4,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
             "zero_optimization": {"stage": 3},
+            "hbm_guard": {"enabled": True},
             "bf16": {"enabled": True},
             "gradient_clipping": 1.0,
             "steps_per_print": 10_000,
@@ -281,6 +283,7 @@ def bench_train_moe(peak_flops):
             "train_micro_batch_size_per_gpu": 8,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
             "zero_optimization": {"stage": 1},
+            "hbm_guard": {"enabled": True},
             "bf16": {"enabled": True},
             "steps_per_print": 10_000,
         },
@@ -324,6 +327,7 @@ def _bench_train_dense(peak_flops, *, hidden, inter, layers, heads, kv_heads,
             "train_micro_batch_size_per_gpu": micro,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
             "zero_optimization": zero or {"stage": 3},
+            "hbm_guard": {"enabled": True},
             "bf16": bf16_section,
             "gradient_clipping": 1.0,
             "steps_per_print": 10_000,
@@ -554,7 +558,9 @@ def bench_inference_v2():
     from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
 
     cfg, params = _gpt2_inference_model()
-    eng = InferenceEngineV2(cfg, params, {"dtype": "bf16"})
+    # hbm_check="refuse": an oversized pool/params refuses BEFORE placement
+    # instead of wedging the relay mid-materialization
+    eng = InferenceEngineV2(cfg, params, {"dtype": "bf16", "hbm_check": "refuse"})
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, (200,), dtype=np.int32)
                for _ in range(8)]
@@ -607,6 +613,7 @@ def bench_train_long_context(peak_flops):
             "gradient_accumulation_steps": 4,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
             "zero_optimization": {"stage": 1},
+            "hbm_guard": {"enabled": True},
             "bf16": {"enabled": True},
             "steps_per_print": 10_000,
         },
@@ -650,6 +657,7 @@ def bench_train_fpdt_long_context(peak_flops):
             "train_micro_batch_size_per_gpu": 1,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
             "zero_optimization": {"stage": 1},
+            "hbm_guard": {"enabled": True},
             "bf16": {"enabled": True},
             "steps_per_print": 10_000,
         },
@@ -691,6 +699,7 @@ def bench_train_fpdt_131k(peak_flops):
             "train_micro_batch_size_per_gpu": 1,
             "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
             "zero_optimization": {"stage": 1},
+            "hbm_guard": {"enabled": True},
             "bf16": {"enabled": True},
             "steps_per_print": 10_000,
         },
@@ -706,10 +715,38 @@ def bench_train_fpdt_131k(peak_flops):
     }
 
 
+def bench_serving_overhead():
+    """Host-side v2 serving overhead (tools/bench_serving.py): allocator,
+    staged assembly, and host µs per decoded token at decode_chain 1 vs 8.
+    Pure host work — wedge-proof, and the same numbers PERF.md's "serving
+    overhead" section tracks."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "bench_serving.py")
+    spec = importlib.util.spec_from_file_location("bench_serving", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    host = mod.bench_host_path()
+    return {
+        "host_us_per_decode_token_k1":
+            host["per_token_loop"]["host_us_per_decode_token"],
+        "host_us_per_decode_token_k8":
+            host["chained"]["host_us_per_decode_token"],
+        "host_us_speedup": host["host_us_speedup"],
+        "programs_per_decode_token_k8":
+            host["chained"]["programs_per_decode_token"],
+        "allocator": mod.bench_allocator(),
+        "assembly": mod.bench_assembly(),
+    }
+
+
 # Confidence-ordered registry (safest first): a relay wedge mid-queue loses
 # everything after it, so known-good shapes go first and the big/novel
 # configs last. Each entry: name -> (fn(peak_flops)->dict, timeout_s).
 EXTRA_BENCHES = {
+    "serving_overhead_host": (lambda peak: bench_serving_overhead(), 420),
     "llama_550m_zero3_remat": (bench_train_llama_z3, 420),
     "mixtral_style_moe": (bench_train_moe, 420),
     "inference_v1_gpt2_125m": (lambda peak: bench_inference(), 420),
@@ -922,6 +959,12 @@ def main() -> None:
     tok_per_sec, mfu, seq, autotuned_stamp, telem = bench_train_gpt2(on_tpu, peak_flops)
 
     extras = {}
+    # Host-side serving overhead is measurable without the TPU (the point:
+    # inference perf evidence that doesn't need the relay, VERDICT r5 #5).
+    try:
+        extras["serving_overhead_host"] = bench_serving_overhead()
+    except Exception as e:  # noqa: BLE001 — smoke bench must still emit
+        extras["serving_overhead_host"] = {"error": str(e)[:200]}
     result = {
         "metric": f"tokens_per_sec_per_chip_gpt2_125m_bf16_seq{seq}" if on_tpu
         else f"tokens_per_sec_cpu_smoke_seq{seq}",

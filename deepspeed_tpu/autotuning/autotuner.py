@@ -24,13 +24,39 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 
 
 def estimate_state_memory(n_params: int, zero_stage: int, dp_world: int,
-                          dtype_bytes: int = 4, opt_factor: int = 2) -> int:
+                          dtype_bytes: int = 4, opt_factor: int = 2, *,
+                          compute_dtype_bytes: int = 0,
+                          accum_dtype_bytes: Optional[int] = None,
+                          micro_batch: int = 0,
+                          seq_len: int = 0,
+                          hidden_size: int = 0,
+                          num_layers: int = 0,
+                          vocab_size: int = 0,
+                          remat: bool = True,
+                          fused_ce: bool = False) -> int:
     """Bytes/device for params+grads+optimizer state under a ZeRO stage
     (reference ``tuner/model_based_tuner.py`` memory model; Adam opt_factor=2
-    fp32 moments)."""
+    fp32 moments), plus — when the model/batch geometry is given — the
+    transient terms the original model ignored and the round-5 relay wedge
+    proved load-bearing (VERDICT item 2):
+
+    - a compute-dtype parameter copy (``compute_dtype_bytes`` > 0): the
+      engine casts fp32 masters to bf16 per step; under ZeRO-3 the gather
+      materializes the full copy transiently
+    - the gradient ACCUMULATOR in its own dtype (``accum_dtype_bytes``,
+      default ``dtype_bytes``) — bf16 accumulation halves this term
+    - activations: with remat, ~2 residuals of [micro, seq, hidden] per
+      layer boundary; without, ~12 per layer (qkv/attn/mlp intermediates)
+    - logits + CE softmax grad: [micro, seq, vocab] in fp32 ×2 — the single
+      biggest transient for big-vocab models; fused (chunked) CE reduces it
+      to ~1/8
+
+    The positional-args form is unchanged (grads term == accumulator at
+    ``dtype_bytes``), so existing callers see identical estimates.
+    """
     P = n_params
     params_b = P * dtype_bytes
-    grads_b = P * dtype_bytes
+    grads_b = P * (accum_dtype_bytes if accum_dtype_bytes is not None else dtype_bytes)
     opt_b = P * dtype_bytes * opt_factor
     if zero_stage >= 1:
         opt_b //= dp_world
@@ -38,7 +64,18 @@ def estimate_state_memory(n_params: int, zero_stage: int, dp_world: int,
         grads_b //= dp_world
     if zero_stage >= 3:
         params_b //= dp_world
-    return params_b + grads_b + opt_b
+    total = params_b + grads_b + opt_b
+    if compute_dtype_bytes:
+        total += P * compute_dtype_bytes
+    tokens = micro_batch * seq_len
+    if tokens and hidden_size and num_layers:
+        act_bytes = compute_dtype_bytes or 2
+        per_layer = 2 if remat else 12
+        total += tokens * hidden_size * act_bytes * num_layers * per_layer
+    if tokens and vocab_size:
+        logit_b = tokens * vocab_size * 4 * 2  # fp32 logits + softmax grad
+        total += logit_b // 8 if fused_ce else logit_b
+    return total
 
 
 @dataclass

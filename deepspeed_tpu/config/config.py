@@ -197,6 +197,24 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     output_file: Optional[str] = None
 
 
+class HBMGuardConfig(DeepSpeedConfigModel):
+    """hbm_guard section — pre-flight memory-fit check (``utils/hbm.py``).
+
+    Before the engine materializes parameters on device it estimates the
+    per-device state bytes (params + grads/accumulator + optimizer state +
+    activations + logits, ``autotuning.estimate_state_memory``) against the
+    device budget. Default: warn-only. ``enabled=True`` REFUSES over-budget
+    configs with the estimate in the error — an oversized init on this
+    platform wedges the device without raising (round-5 relay incident), so
+    refusal is the only safe behavior on shared hardware."""
+
+    enabled: bool = False  # True: raise HBMBudgetError instead of warning
+    warn: bool = True  # False (with enabled=False): guard fully off
+    # Override budget discovery (jax memory_stats / DSTPU_DEVICE_MEMORY_GB).
+    device_memory_gb: Optional[float] = None
+    headroom: float = 0.92  # fraction of the budget the estimate may use
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """telemetry section — the unified observability substrate
     (``deepspeed_tpu/telemetry``): span tracer + metrics registry + trace
@@ -388,6 +406,7 @@ class EngineConfig(DeepSpeedConfigModel):
     collectives: CollectivesConfig = Field(default_factory=CollectivesConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     diagnostics: DiagnosticsConfig = Field(default_factory=DiagnosticsConfig)
+    hbm_guard: HBMGuardConfig = Field(default_factory=HBMGuardConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
     gradient_compression: GradientCompressionConfig = Field(default_factory=GradientCompressionConfig)

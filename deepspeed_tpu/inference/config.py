@@ -73,6 +73,13 @@ class InferenceConfig(DeepSpeedConfigModel):
     recompile_warnings: bool = True
     # distinct compiled generate programs before the cache-growth warning
     max_generate_buckets: int = 16
+    # Pre-flight HBM-fit check (utils/hbm.py) before param placement:
+    # "warn" | "refuse" | "off". An over-budget materialization on this
+    # platform wedges the device without raising (PERF.md round 5), so the
+    # bench extras run "refuse"; zero_inference/WOQ shrink the device
+    # footprint and the estimate accounts for neither, so the check uses the
+    # dense placement bytes (a conservative upper bound).
+    hbm_check: str = "warn"
 
     @property
     def jax_dtype(self) -> Any:

@@ -61,6 +61,16 @@ class InferenceEngine:
                 "dtype='int8' would truncate weights via astype; int8 weights "
                 "are weight-only quantization — use quant={'enabled': True, 'bits': 8}"
             )
+        if config.hbm_check != "off" and not config.zero_inference.enabled:
+            # refuse/warn BEFORE placement (an over-budget materialization
+            # wedges this platform without raising); dense-bytes upper bound,
+            # skipped when zero_inference keeps the big weights off-device
+            from deepspeed_tpu.utils.hbm import check_hbm_fit
+
+            n_elems = sum(x.size for x in jax.tree_util.tree_leaves(params))
+            check_hbm_fit(
+                n_elems * jnp.dtype(dtype).itemsize // max(mesh.shape["tp"], 1),
+                what="init_inference param placement", mode=config.hbm_check)
         self.params = place_parameters(params, mesh, causal_lm_partition_rules, dtype)
 
         nvme_mode = config.zero_inference.enabled and config.zero_inference.offload == "nvme"

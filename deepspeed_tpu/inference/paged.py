@@ -20,12 +20,13 @@ Static shapes everywhere: (rows, chunk, pages) are bucketed by the host layer
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.inference.model import _apply_norm, _attn_out, _mlp, _moe, _qkv
+from deepspeed_tpu.inference.model import _apply_norm, _attn_out, _logits, _mlp, _moe, _qkv
+from deepspeed_tpu.inference.sampling import sample_logits
 from deepspeed_tpu.models.transformer import TransformerConfig
 
 
@@ -109,7 +110,7 @@ def paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block_size
     )
 
 
-def ragged_forward(
+def _forward_hidden(
     params,
     cfg: TransformerConfig,
     pool: PagedKVPool,
@@ -119,11 +120,9 @@ def ragged_forward(
     block_tables: jax.Array,  # [N, P] int32
     block_size: int,
 ) -> Tuple[jax.Array, PagedKVPool]:
-    """One mixed prefill/decode step -> (last-token logits [N, V], pool).
-
-    Reference analog: the whole FastGen model forward over a
-    ``RaggedBatchWrapper`` (``inference/v2/engine_v2.py:107`` → model
-    implementations → ragged kernels), as one XLA program.
+    """One mixed prefill/decode layer-stack pass -> (last-token hidden [N, E],
+    pool). Shared by the single-step ``ragged_forward`` and the K-step
+    ``ragged_decode_chain`` — one definition of the serving transformer math.
     """
     N, C = tokens.shape
     bs = block_size
@@ -177,14 +176,92 @@ def ragged_forward(
     x, (k_new, v_new) = jax.lax.scan(body, x, (params["layers"], pool.k, pool.v))
     pool = pool._replace(k=k_new, v=v_new)
 
-    x = _apply_norm(params["final_norm"], cfg, x)
     last = jnp.take_along_axis(
         x, jnp.maximum(new_lens - 1, 0)[:, None, None], axis=1
     )[:, 0]  # [N, E]
-    if cfg.tie_embeddings:
-        logits = last @ params["embed"]["embedding"].T.astype(cfg.dtype)
-    else:
-        logits = last @ params["lm_head"]["kernel"].astype(cfg.dtype)
-        if "bias" in params["lm_head"]:
-            logits = logits + params["lm_head"]["bias"].astype(cfg.dtype)
-    return logits, pool
+    return last, pool
+
+
+def ragged_forward(
+    params,
+    cfg: TransformerConfig,
+    pool: PagedKVPool,
+    tokens: jax.Array,  # [N, C] int32
+    positions: jax.Array,  # [N, C] int32
+    new_lens: jax.Array,  # [N] int32
+    block_tables: jax.Array,  # [N, P] int32
+    block_size: int,
+) -> Tuple[jax.Array, PagedKVPool]:
+    """One mixed prefill/decode step -> (last-token logits [N, V], pool).
+
+    Reference analog: the whole FastGen model forward over a
+    ``RaggedBatchWrapper`` (``inference/v2/engine_v2.py:107`` → model
+    implementations → ragged kernels), as one XLA program. The final norm +
+    LM head run on the [N, E] last-token hiddens only (norm is positionwise,
+    so selecting first is the same math at 1/C the head cost).
+    """
+    last, pool = _forward_hidden(
+        params, cfg, pool, tokens, positions, new_lens, block_tables, block_size)
+    return _logits(params, cfg, last), pool
+
+
+def ragged_decode_chain(
+    params,
+    cfg: TransformerConfig,
+    pool: PagedKVPool,
+    tokens: jax.Array,  # [N] int32 — last sampled token per row (next input)
+    start_pos: jax.Array,  # [N] int32 — global position of that input token
+    block_tables: jax.Array,  # [N, P] int32, pre-extended for the K-token window
+    block_size: int,
+    active: jax.Array,  # [N] bool — live rows (pad rows False)
+    budgets: jax.Array,  # [N] int32 — max tokens this chain may emit per row
+    rng: jax.Array,  # PRNG key, threaded through the scan and returned
+    k_steps: int,
+    eos_id: Optional[int] = None,
+    *,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, PagedKVPool]:
+    """K decode iterations + on-device sampling as ONE compiled program.
+
+    The serving fast path: the host dispatches once and fetches once per K
+    decoded tokens instead of shipping [N, vocab] logits to the host for
+    every token (each dispatched program carries ~6-7 ms fixed relay overhead
+    on this platform — see PERF.md "secondary platform facts"). A
+    ``lax.scan`` runs the single-token forward, samples the next token with
+    the threaded PRNG key, writes the input token's KV through the
+    pre-extended block table, and masks finished rows in-scan: a row goes
+    inactive when it samples ``eos_id`` or exhausts its ``budgets`` entry,
+    after which its KV writes route to the trash slot and its emitted slots
+    are -1.
+
+    Returns ``(out_tokens [N, K], emitted [N], active [N], rng, pool)`` where
+    ``out_tokens[i, :emitted[i]]`` are valid and ``emitted[i]`` is also the
+    number of KV slots row i consumed (== seen_tokens advance).
+    """
+
+    def step(carry, _):
+        pool, tok, pos, live, emitted, key = carry
+        new_lens = live.astype(jnp.int32)
+        last, pool = _forward_hidden(
+            params, cfg, pool, tok[:, None], pos[:, None], new_lens,
+            block_tables, block_size)
+        logits = _logits(params, cfg, last)
+        key, sub = jax.random.split(key)
+        nxt = sample_logits(logits, sub, do_sample=do_sample,
+                            temperature=temperature, top_k=top_k, top_p=top_p)
+        emitted = emitted + new_lens
+        out = jnp.where(live, nxt, -1)
+        still = live & (emitted < budgets)
+        if eos_id is not None:
+            still = still & (nxt != eos_id)
+        return (pool, jnp.where(live, nxt, tok), pos + new_lens, still,
+                emitted, key), out
+
+    carry0 = (pool, tokens, start_pos, active,
+              jnp.zeros_like(start_pos), rng)
+    (pool, _, _, active, emitted, rng), outs = jax.lax.scan(
+        step, carry0, None, length=k_steps)
+    return outs.T, emitted, active, rng, pool

@@ -2,8 +2,12 @@
 
 The reference delegates sampling to HF ``generate`` (its engines only guard it,
 ``inference/engine.py:583``); FastGen's serving layer (MII) samples outside the
-engine. Here sampling is jit-compiled alongside decode so the whole generate
-loop is one XLA program.
+engine. Here sampling compiles INTO the serving step programs: the v1 engine
+jits it alongside its scan decode, and the v2 engine fuses it into both the
+ragged prefill step and the K-step decode chain
+(``paged.ragged_decode_chain``), so decode dispatches return int32 token ids
+and the ``[rows, vocab]`` logits never leave the device. All knobs are static
+(compile-time) arguments; the PRNG key is threaded through the step carry.
 """
 
 from __future__ import annotations

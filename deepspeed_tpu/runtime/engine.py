@@ -160,6 +160,23 @@ class DeepSpeedTPUEngine:
                 "single-chip, or use attn_impl='fpdt' without offload (or "
                 "sp_impl='ring') for multi-chip long context")
 
+        # MoE × TP is unverified here (round-5 verdict item 6): the reference
+        # composes them by gathering/dropping tokens across the tp group
+        # inside the MoE block (moe/mappings.py:105,113) — this engine has no
+        # such token mapping, so an ep×tp mesh would silently mis-route
+        # expert tokens. Refuse loudly instead.
+        if dict(self.mesh.shape).get("ep", 1) > 1 and dict(self.mesh.shape).get("tp", 1) > 1:
+            raise NotImplementedError(
+                f"ep={self.mesh.shape['ep']} × tp={self.mesh.shape['tp']} mesh: "
+                "MoE expert parallelism does not compose with tensor "
+                "parallelism here (no cross-tp token gather/drop, reference "
+                "moe/mappings.py). Use ep with dp/sp axes, or tp without ep.")
+
+        # ---- pre-flight HBM-fit guard (BEFORE any device materialization:
+        # an over-budget init on this platform wedges the device without
+        # raising — round-5 relay incident) -------------------------------
+        self._check_hbm_budget(mcfg)
+
         # ---- state init + placement --------------------------------------
         self._init_state(model_parameters, seed)
 
@@ -580,6 +597,59 @@ class DeepSpeedTPUEngine:
         if sched_cfg is not None and sched_cfg.type:
             return get_lr_schedule(sched_cfg.type, sched_cfg.params, base_lr=base_lr), None
         return constant_schedule(base_lr if base_lr is not None else 1e-3), None
+
+    def _check_hbm_budget(self, mcfg) -> None:
+        """Pre-flight fit check: estimated per-device state bytes vs device
+        memory, BEFORE ``_init_state`` materializes anything (VERDICT r5
+        item 2 — the ~890M extra wedged the shared relay for 9+ hours at
+        param init on a failure the existing math predicted).
+
+        Warn-only by default; ``hbm_guard.enabled=true`` refuses with the
+        estimate in the error. No-op when the device budget is undiscoverable
+        (CPU backends) and no override is configured."""
+        gcfg = self.config.model.hbm_guard
+        if not (gcfg.enabled or gcfg.warn):
+            return
+        from deepspeed_tpu.autotuning.autotuner import estimate_state_memory
+        from deepspeed_tpu.utils.hbm import check_hbm_fit
+
+        try:
+            shapes = jax.eval_shape(self.model.init_fn, jax.random.PRNGKey(0))
+            n_params = int(sum(np.prod(x.shape)
+                               for x in jax.tree_util.tree_leaves(shapes)))
+        except Exception as e:  # noqa: BLE001 — the guard is best-effort
+            logger.debug(f"hbm_guard: shape probe failed ({e}); skipping")
+            return
+        offloaded = self.offload_mode in ("host-jit", "nvme")
+        compute_b = jnp.dtype(self.compute_dtype).itemsize
+        need = estimate_state_memory(
+            n_params,
+            self.zero_config.stage,
+            get_data_parallel_world_size(self.mesh),
+            # offload keeps fp32 masters + moments on host; the device holds
+            # only the compute-dtype copy + the gradient accumulator
+            dtype_bytes=0 if offloaded else 4,
+            opt_factor=0 if offloaded else 2,
+            compute_dtype_bytes=compute_b,
+            accum_dtype_bytes=jnp.dtype(self._accum_dtype).itemsize,
+            micro_batch=self.config.train_micro_batch_size_per_gpu or 0,
+            seq_len=getattr(mcfg, "max_seq_len", 0) or 0,
+            hidden_size=getattr(mcfg, "hidden_size", 0) or 0,
+            num_layers=getattr(mcfg, "num_layers", 0) or 0,
+            vocab_size=getattr(mcfg, "vocab_size", 0) or 0,
+            remat=bool(getattr(mcfg, "remat", True)),
+            fused_ce=bool(getattr(mcfg, "fused_ce", False)),
+        )
+        override = (int(gcfg.device_memory_gb * (1 << 30))
+                    if gcfg.device_memory_gb else None)
+        check_hbm_fit(
+            need,
+            what=f"engine init ({n_params / 1e6:.0f}M params, "
+                 f"zero_stage={self.zero_config.stage})",
+            mode="refuse" if gcfg.enabled else "warn",
+            device_memory=override,
+            headroom=gcfg.headroom,
+        )
 
     def _init_state(self, model_parameters, seed: int) -> None:
         mesh = self.mesh

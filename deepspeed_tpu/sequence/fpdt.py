@@ -16,6 +16,15 @@ TPU design:
     sequence; the NEXT K/V chunk's host→device transfer is issued before
     computing the current one, so JAX's async dispatch overlaps DMA with
     compute (the reference's double-buffered CUDA streams).
+
+Multi-chip status (honest-docs, round-6): host offload is SINGLE-CHIP only
+on this jax/XLA version — the SPMD partitioner rejects host-memory placement
+annotations, and the engine refuses ``fpdt_offload`` on multi-device meshes
+(``runtime/engine.py``). The supported multi-chip long-context paths are
+no-offload FPDT composed with Ulysses SP, and ring attention
+(``parallel/ring_attention.py``); both cap sequence length at HBM rather
+than host RAM. The reference's defining 16×-longer-via-host-offload claim is
+NOT reproduced multi-chip here.
 """
 
 from __future__ import annotations
@@ -106,9 +115,16 @@ def fpdt_attention(
     surrounding all-to-all).
 
     ``offload=True`` parks the large residuals (q/k/v/out) in host memory
-    between forward and backward via sharding-preserving ``device_put``
-    transfers XLA schedules asynchronously — the reference's double-buffered
-    host offload (fpdt_layer.py:462 SequenceChunk), SPMD-safe.
+    between forward and backward via ``device_put`` transfers XLA schedules
+    asynchronously — the reference's double-buffered host offload
+    (fpdt_layer.py:462 SequenceChunk). **Single-chip only on this stack**:
+    the XLA SPMD partitioner rejects host-memory placement annotations on
+    multi-device meshes ("Side-effect HLO must have sharding"), and
+    ``runtime/engine.py`` raises if ``fpdt_offload`` meets a multi-device
+    mesh. Multi-chip long context uses no-offload FPDT (``attn_impl='fpdt'``,
+    composes with Ulysses SP via the surrounding all-to-all) or ring
+    attention (``sp_impl='ring'``) — sequence length capped by HBM, not by
+    host RAM. See docs/parallelism.md "long context beyond HBM".
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -170,8 +186,9 @@ def _fpdt_fwd(q, k, v, slopes, Cq, Ck, causal, q_offset, offload):
     _, (outs, lses) = jax.lax.scan(q_body, None, (jnp.arange(nq), qg))
     out = outs.transpose(1, 0, 2, 3, 4, 5).reshape(B, Sq, H, D).astype(q.dtype)
     if offload:
-        # big residuals park in (pinned) host memory until the backward —
-        # sharding-preserving transfers, safe under the SPMD partitioner
+        # big residuals park in (pinned) host memory until the backward;
+        # single-device placement only — the SPMD partitioner rejects these
+        # annotations on multi-device meshes (engine guards the combination)
         from deepspeed_tpu.utils.compat import memory_space
 
         host = lambda x: jax.device_put(x, memory_space("host"))  # noqa: E731

@@ -1,0 +1,243 @@
+"""Serving fast path: K-step chained decode, on-device sampling, O(1) host
+bookkeeping (ISSUE 4 tentpole).
+
+Contract under test:
+  - chained decode (``decode_chain=k``) is token-identical to the per-token
+    loop (``k=1``) and to the dense v1 engine, greedy
+  - one compiled program and one host sync per K decoded tokens (jit-cache +
+    dispatch/sync counter assertions)
+  - EOS mid-chain, ``max_new_tokens`` mid-chain, and preemption at chain
+    boundaries all preserve outputs
+  - the allocator free list never double-allocates; staged assembly buffers
+    are reused, not reallocated
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.inference import InferenceEngineV2, init_inference
+from deepspeed_tpu.inference.ragged import BatchStaging, BlockedAllocator, StateManager, build_ragged_batch
+
+from .test_inference_v2 import make_model
+
+
+def _v1_greedy(cfg, params, prompt, n_new, eos=None):
+    v1 = init_inference(model=cfg, params=params,
+                        config={"dtype": "fp32", "seq_bucket": 8})
+    out = v1.generate(prompt[None, :], max_new_tokens=n_new,
+                      eos_token_id=eos)[0, len(prompt):]
+    if eos is not None:
+        hits = np.nonzero(out == eos)[0]
+        if hits.size:
+            out = out[: hits[0] + 1]
+    return out
+
+
+def _engine(cfg, params, k, **over):
+    base = {"dtype": "fp32", "kv_block_size": 4, "num_kv_blocks": 64,
+            "chunk_bucket": 8, "decode_chain": k}
+    base.update(over)
+    return InferenceEngineV2(cfg, params, base)
+
+
+# -------------------------------------------------------------- chain parity
+def test_chained_decode_greedy_parity():
+    """k=4 chained decode is token-identical to k=1 and to the v1 engine."""
+    cfg, _, params = make_model()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)) for n in (7, 3, 5)]
+
+    outs_k4 = _engine(cfg, params, 4).generate(prompts, max_new_tokens=6)
+    outs_k1 = _engine(cfg, params, 1).generate(prompts, max_new_tokens=6)
+    for p, o4, o1 in zip(prompts, outs_k4, outs_k1):
+        np.testing.assert_array_equal(o4, o1)
+        np.testing.assert_array_equal(o4, _v1_greedy(cfg, params, p, 6))
+
+
+def test_chain_max_new_tokens_boundary():
+    """max_new_tokens not a multiple of k: the chain shrinks to the budget
+    and rows stop exactly at the cap."""
+    cfg, _, params = make_model()
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, cfg.vocab_size, (4,)) for _ in range(2)]
+    for n_new in (1, 3, 5):
+        outs = _engine(cfg, params, 4).generate(prompts, max_new_tokens=n_new)
+        for p, o in zip(prompts, outs):
+            assert len(o) == n_new
+            np.testing.assert_array_equal(o, _v1_greedy(cfg, params, p, n_new))
+
+
+def test_chain_eos_mid_chain():
+    """A row hitting EOS inside the chain stops there (device-side masking);
+    parity with k=1 and v1."""
+    cfg, _, params = make_model()
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(0, cfg.vocab_size, (6,))
+    # pick the 3rd greedily generated token as the EOS so it lands mid-chain
+    free_run = _engine(cfg, params, 4).generate([prompt], max_new_tokens=8)[0]
+    eos = int(free_run[2])
+    out_k4 = _engine(cfg, params, 4).generate(
+        [prompt], max_new_tokens=8, eos_token_id=eos)[0]
+    out_k1 = _engine(cfg, params, 1).generate(
+        [prompt], max_new_tokens=8, eos_token_id=eos)[0]
+    np.testing.assert_array_equal(out_k4, out_k1)
+    np.testing.assert_array_equal(out_k4, _v1_greedy(cfg, params, prompt, 8, eos=eos))
+    assert out_k4[-1] == eos and len(out_k4) <= 3
+
+
+def test_chain_preemption_under_kv_pressure():
+    """Pool sized to overflow mid-generation: preemption now happens at chain
+    boundaries and outputs still match the dense v1 baseline."""
+    cfg, _, params = make_model()
+    eng = _engine(cfg, params, 4, num_kv_blocks=6, max_seqs=4)
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, cfg.vocab_size, (8,)) for _ in range(2)]
+    outs = eng.generate(prompts, max_new_tokens=8)
+    for p, o in zip(prompts, outs):
+        np.testing.assert_array_equal(o, _v1_greedy(cfg, params, p, 8))
+    assert eng.state.free_blocks == 6  # everything released
+
+
+# ------------------------------------------------- dispatch/sync accounting
+def test_one_program_one_sync_per_k_tokens():
+    """The acceptance contract: a K-token window is exactly 1 compiled
+    program dispatched and ≤1 host sync, asserted via the jit cache and the
+    engine's dispatch/host-fetch counters."""
+    cfg, _, params = make_model()
+    k = 4
+    eng = _engine(cfg, params, k)
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(0, cfg.vocab_size, (5,)) for _ in range(2)]
+
+    n_new = 9  # 1 from prefill + 8 decoded in chains of 4
+    outs = eng.generate(prompts, max_new_tokens=n_new)
+    assert all(len(o) == n_new for o in outs)
+
+    # one chain program total: every K-token window reuses the same compile
+    assert eng.jit_cache_size("chain") == 1
+    assert eng.jit_cache_size("sample") == 1  # the fused prefill program
+    assert eng.jit_cache_size("logits") == 0  # no logits ever shipped
+
+    n_chains = eng.dispatch_count - 1  # minus the single prefill dispatch
+    assert n_chains == 2  # 8 decode tokens / k=4
+    assert eng.host_sync_count == eng.dispatch_count  # exactly 1 fetch per program
+    assert eng.tokens_decoded == 2 * (n_new - 1)
+    # ≤1 sync per K decoded tokens (per-row window; both rows share a chain)
+    assert n_chains <= -(-eng.tokens_decoded // (2 * k)) + 1
+
+
+def test_k1_matches_decode_chain_disabled():
+    """decode_chain=1 reproduces the per-token loop exactly — one dispatch
+    and one sync per decoded token, same outputs."""
+    cfg, _, params = make_model()
+    eng = _engine(cfg, params, 1)
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(0, cfg.vocab_size, (5,))]
+    outs = eng.generate(prompts, max_new_tokens=4)
+    assert len(outs[0]) == 4
+    assert eng.jit_cache_size("chain") == 1  # k=1 chain program
+    n_chains = eng.dispatch_count - 1
+    assert n_chains == 3  # 3 decoded tokens after the prefill-sampled one
+
+
+def test_sampled_generation_runs_on_device():
+    """do_sample generation through the chained path: correct shapes, no
+    logits program compiled, deterministic for a fixed seed."""
+    cfg, _, params = make_model()
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(0, cfg.vocab_size, (5,)) for _ in range(2)]
+    eng = _engine(cfg, params, 4)
+    a = eng.generate(prompts, max_new_tokens=6, do_sample=True,
+                     temperature=0.8, top_k=10, seed=7)
+    b = _engine(cfg, params, 4).generate(
+        prompts, max_new_tokens=6, do_sample=True, temperature=0.8,
+        top_k=10, seed=7)
+    assert eng.jit_cache_size("logits") == 0
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+        assert len(x) == 6 and ((0 <= x) & (x < cfg.vocab_size)).all()
+
+
+# ----------------------------------------------------- host-side properties
+def test_allocator_never_double_allocates():
+    """Free-list property fuzz: across random alloc/free interleavings the
+    allocator never hands out a live block and conserves the block count."""
+    rng = np.random.RandomState(0)
+    a = BlockedAllocator(64)
+    live = []  # allocated, not yet freed
+    for _ in range(2000):
+        if live and (rng.rand() < 0.45 or a.free_blocks == 0):
+            i = rng.randint(len(live))
+            a.free(live.pop(i))
+        else:
+            n = rng.randint(1, min(8, a.free_blocks) + 1)
+            got = a.allocate(n)
+            flat = [b for blk in live for b in blk]
+            assert len(set(got.tolist())) == n
+            assert not set(got.tolist()) & set(flat), "double allocation"
+            live.append(got)
+        assert a.free_blocks + sum(len(b) for b in live) == 64
+    for blk in live:
+        a.free(blk)
+    assert a.free_blocks == 64
+    with pytest.raises(ValueError):
+        a.free([0, 0])  # duplicate ids within one call
+
+
+def test_staging_buffers_reused_not_reallocated():
+    """Steady-state assembly reuses the per-bucket staging arrays."""
+    m = StateManager(num_blocks=64, block_size=4, max_seqs=8)
+    st = BatchStaging(max_pages=8)
+    b1 = build_ragged_batch(m, [1], [np.arange(5)], 8, staging=st)
+    tok_id = id(b1.tokens)
+    for i in range(10):
+        b = build_ragged_batch(m, [1], [np.asarray([i])], 8, staging=st)
+        assert id(b.tokens) == tok_id, "buffer reallocated"
+    assert st.allocations == 1  # prefill and decode share the (8, 8) bucket
+    assert st.reuses >= 9
+    # pad rows/columns stay zeroed across reuse
+    assert (b.tokens[1:] == 0).all() and (b.new_lens[1:] == 0).all()
+
+
+def test_zero_length_row_in_decode_batch():
+    """A zero-length token list among 1-token decodes assembles as a pad row
+    (the decode fast path must not index t[0] on it)."""
+    m = StateManager(num_blocks=64, block_size=4, max_seqs=8)
+    st = BatchStaging(max_pages=8)
+    b = build_ragged_batch(m, [1, 2], [np.asarray([5], np.int32),
+                                       np.asarray([], np.int32)], 8, staging=st)
+    assert b.new_lens.tolist()[:2] == [1, 0]
+    assert b.tokens[0, 0] == 5 and b.tokens[1, 0] == 0
+
+
+def test_staging_zeroes_previous_step():
+    """A wide batch followed by a narrow one in the same bucket must not leak
+    the wide step's tokens/tables into the narrow step's pad area."""
+    m = StateManager(num_blocks=64, block_size=4, max_seqs=8)
+    st = BatchStaging(max_pages=8)
+    wide = build_ragged_batch(m, [1, 2, 3], [np.arange(1, 6)] * 3, 8,
+                              row_bucket=4, chunk_bucket=8, staging=st)
+    assert wide.new_lens.tolist() == [5, 5, 5, 0]
+    narrow = build_ragged_batch(m, [1], [np.asarray([9])], 8,
+                                row_bucket=4, chunk_bucket=8, staging=st)
+    assert narrow.new_lens.tolist() == [1, 0, 0, 0]
+    assert (narrow.tokens[1:] == 0).all() and (narrow.tokens[0, 1:] == 0).all()
+    assert (narrow.block_tables[1:] == 0).all()
+
+
+def test_engine_staging_steady_state():
+    """A full generate run allocates at most one staging set per (rows,chunk)
+    bucket and reuses them for every subsequent step."""
+    cfg, _, params = make_model()
+    eng = _engine(cfg, params, 2)
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, cfg.vocab_size, (6,)) for _ in range(3)]
+    eng.generate(prompts, max_new_tokens=8)
+    st = eng._staging
+    assert st.allocations <= 2  # prefill bucket(s) only; chains use chain bufs
+    total_steps = st.allocations + st.reuses
+    assert st.reuses >= 0 and total_steps >= 1
+    # chain staging: one buffer set per rows bucket
+    assert len(eng._chain_buf) == 1

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Nightly deep-tier run with a COMMITTED, hash-stamped artifact
+# (VERDICT r5 weak item 8: the nightly tier was builder's-word-only).
+#
+# Usage: tools/run_nightly.sh [rNN]
+# Writes NIGHTLY_rNN.log at the repo root: tree identity (HEAD sha + sha256
+# of the uncommitted diff), per-test pass/fail lines, and pytest's census
+# summary. Commit the log with the round notes so any auditor can match it
+# to the exact tree it ran on.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+ROUND="${1:-r$(date -u +%y%m%d)}"
+OUT="NIGHTLY_${ROUND}.log"
+
+HEAD_SHA=$(git rev-parse HEAD 2>/dev/null || echo unknown)
+DIFF_SHA=$(git diff HEAD 2>/dev/null | sha256sum | cut -d' ' -f1)
+
+{
+  echo "# nightly tier — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  echo "# HEAD: ${HEAD_SHA}"
+  echo "# uncommitted-diff sha256: ${DIFF_SHA}"
+  echo "# cmd: pytest tests/ -q -m nightly"
+} > "${OUT}"
+
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m nightly \
+  -p no:cacheprovider -p no:xdist -p no:randomly \
+  --continue-on-collection-errors -rA --tb=line 2>&1 | tee -a "${OUT}"
+rc=${PIPESTATUS[0]}
+
+{
+  echo "# exit code: ${rc}"
+  echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
+} >> "${OUT}"
+echo "wrote ${OUT}"
+exit "${rc}"
