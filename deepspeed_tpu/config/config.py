@@ -236,6 +236,13 @@ class TelemetryConfig(DeepSpeedConfigModel):
     jsonl_path: Optional[str] = None
     # Per-step device-memory gauges (PJRT memory_stats / jax.live_arrays).
     memory_watermarks: bool = True
+    # Prometheus text exposition of the whole registry, rewritten at every
+    # monitor flush (node-exporter textfile-collector style). None = off.
+    prometheus_path: Optional[str] = None
+    # Opt-in /metrics HTTP endpoint (stdlib thread, telemetry/exposition.py):
+    # GET /metrics (Prometheus text) + /metrics.json (snapshot). 0 binds a
+    # free port; None (default) starts no server.
+    http_port: Optional[int] = None
 
 
 class HealthConfig(DeepSpeedConfigModel):

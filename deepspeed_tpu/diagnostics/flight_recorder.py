@@ -16,9 +16,17 @@ Process-wide hooks are installed ONCE and dispatch to every live recorder
 through a WeakSet — engines come and go (tests build dozens) without handler
 stacking or teardown ordering hazards.
 
+Serving mode (``request_capacity > 0``): the recorder additionally keeps a
+ring of per-request records — request id, phase, lifecycle stamps, chain
+count — updated by the v2 engine's ``LifecycleTracker`` at every request
+transition. A crashed serving run's dump then NAMES the in-flight requests
+(which uid was decoding, which were queued, how far each had gotten), the
+serving analog of the step ring.
+
 Dump schema (JSONL, one object per line):
   {"kind": "header", "reason", "time_unix", "pid", "context", "n_records"}
   {"kind": "step_record", "step", "t_unix", "metrics": {...}, "health": {...}}
+  {"kind": "request_record", "rid", "uid", "phase", "tokens", "chains", ...}
   {"kind": "span" | "instant" | "counter", ...}   # recent tracer events
 """
 
@@ -31,6 +39,7 @@ import sys
 import threading
 import time
 import weakref
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.utils.logging import logger
@@ -109,10 +118,15 @@ class FlightRecorder:
         dump_dir: Optional[str] = None,
         tracer=None,
         max_trace_events: int = 512,
+        request_capacity: int = 0,
     ):
         self.capacity = max(int(capacity), 1)
         self.dump_dir = dump_dir
         self.max_trace_events = max_trace_events
+        # serving mode: bounded ring of per-request records (0 = off);
+        # latest state per request id, LRU-evicted past capacity
+        self.request_capacity = max(int(request_capacity), 0)
+        self._requests: "OrderedDict[Any, Dict[str, Any]]" = OrderedDict()
         self._ring: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
         self._context: Dict[str, Any] = {}
@@ -138,6 +152,21 @@ class FlightRecorder:
             if len(self._ring) > self.capacity:
                 del self._ring[: len(self._ring) - self.capacity]
 
+    def record_request(self, rid: Any, **fields: Any) -> None:
+        """Update (or create) the serving ring's record for request ``rid``
+        — plain host values only, so recording never touches the device.
+        No-op unless serving mode (``request_capacity > 0``) is on."""
+        if self.request_capacity <= 0:
+            return
+        with self._lock:
+            rec = self._requests.pop(rid, None)
+            if rec is None:
+                rec = {"rid": rid}
+            rec.update(fields)
+            self._requests[rid] = rec  # most-recently-updated last
+            while len(self._requests) > self.request_capacity:
+                self._requests.popitem(last=False)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._ring)
@@ -158,6 +187,7 @@ class FlightRecorder:
 
         with self._lock:
             ring = [dict(r) for r in self._ring]
+            requests = [dict(r) for r in self._requests.values()]
         fetched = jax.device_get([r["metrics"] for r in ring])
         path = self._resolve_path(path)
         d = os.path.dirname(os.path.abspath(path))
@@ -170,6 +200,7 @@ class FlightRecorder:
                 "pid": os.getpid(),
                 "context": self._context,
                 "n_records": len(ring),
+                "n_requests": len(requests),
             }
             f.write(json.dumps(header) + "\n")
             for rec, metrics in zip(ring, fetched):
@@ -188,6 +219,8 @@ class FlightRecorder:
                     if k not in ("step", "t_unix", "metrics"):
                         row[k] = v
                 f.write(json.dumps(row) + "\n")
+            for rec in requests:  # serving mode: name the in-flight requests
+                f.write(json.dumps({"kind": "request_record", **rec}) + "\n")
             for ev in self._tracer.events()[-self.max_trace_events:]:
                 f.write(json.dumps({"pid": os.getpid(), **ev}) + "\n")
         if self._tracer.enabled:

@@ -51,6 +51,23 @@ class ZeroInferenceConfig(DeepSpeedConfigModel):
     num_buffers: int = 2  # layers resident at once in nvme mode (double buffer)
 
 
+class ServingSLOConfig(DeepSpeedConfigModel):
+    """``serving_slo`` block — the targets that turn per-request latency
+    records into **goodput** (fraction of finished requests meeting SLO,
+    the number a capacity plan is written against).
+
+    A finished request meets its SLO when TTFT (arrival -> first token) is
+    within ``ttft_ms`` AND its mean per-output-token latency is within
+    ``tpot_ms``; a ``None`` target is not enforced. ``window_s`` bounds the
+    rolling windows behind the ``serving/goodput``, ``serving/tokens_per_s``
+    and ``serving/preemption_rate`` gauges (see ``inference/lifecycle.py``).
+    """
+
+    ttft_ms: Optional[float] = None  # time-to-first-token target
+    tpot_ms: Optional[float] = None  # mean time-per-output-token target
+    window_s: float = 30.0  # rolling window for goodput/rate gauges
+
+
 class InferenceConfig(DeepSpeedConfigModel):
     """Reference ``DeepSpeedInferenceConfig`` (inference/config.py:77)."""
 

@@ -28,6 +28,7 @@ Serving fast path (the host leaves the per-token critical path):
 from __future__ import annotations
 
 import functools
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -35,8 +36,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from pydantic import Field
 
 from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
+from deepspeed_tpu.inference.config import ServingSLOConfig
+from deepspeed_tpu.inference.lifecycle import LifecycleTracker
 from deepspeed_tpu.inference.paged import (
     PagedKVPool,
     init_pool,
@@ -77,6 +81,14 @@ class RaggedInferenceConfig(DeepSpeedConfigModel):
     # Pre-flight HBM-fit check (utils/hbm.py) before param/pool
     # materialization: "warn" | "refuse" | "off".
     hbm_check: str = "warn"
+    # SLO targets for the per-request lifecycle metrics (TTFT/TPOT goodput —
+    # inference/lifecycle.py). Tracking itself keys off the telemetry tracer;
+    # this block only sets the targets and rolling-window length.
+    serving_slo: ServingSLOConfig = Field(default_factory=ServingSLOConfig)
+    # Serving flight-recorder mode (diagnostics/flight_recorder.py): keep a
+    # bounded ring of per-request records (id, phase stamps, chain count) so
+    # a crashed serving run's post-mortem names the in-flight requests.
+    flight_recorder: bool = False
 
     @property
     def jax_dtype(self):
@@ -170,6 +182,25 @@ class InferenceEngineV2:
         self._step_cache: Dict[Tuple, Any] = {}
         self._chain_buf: Dict[int, Dict[str, np.ndarray]] = {}
         self._tracer = get_tracer()
+        # Serving flight recorder (opt-in): per-request ring so a crash dump
+        # names the in-flight requests even with the tracer disabled.
+        self._recorder = None
+        if config.flight_recorder:
+            from deepspeed_tpu.diagnostics.flight_recorder import (
+                FlightRecorder,
+                install_process_hooks,
+            )
+
+            self._recorder = FlightRecorder(
+                request_capacity=max(2 * config.max_seqs, 32))
+            self._recorder.set_context(
+                kind="serving", max_seqs=config.max_seqs,
+                decode_chain=config.decode_chain,
+                kv_blocks=config.num_kv_blocks)
+            install_process_hooks()
+        # Most recent generate()'s per-request tracker (None when telemetry
+        # is disabled and no recorder is configured — no records allocated).
+        self.lifecycle: Optional[LifecycleTracker] = None
         # Serving-loop accounting (always on — plain int adds). The parity
         # tests assert the dispatch/sync contract on these; the serving
         # benchmark and telemetry gauges read them too.
@@ -285,13 +316,17 @@ class InferenceEngineV2:
         self.host_sync_count += 1
         return np.asarray(logits[: len(uids)])
 
-    def _put_sample(self, uids, token_lists, rng, sample_kw: Tuple) -> Tuple[np.ndarray, jax.Array]:
+    def _put_sample(self, uids, token_lists, rng, sample_kw: Tuple,
+                    tracker: Optional[LifecycleTracker] = None,
+                    rids: Optional[Sequence[int]] = None) -> Tuple[np.ndarray, jax.Array]:
         """Fused put+sample: push tokens, return (sampled next-token ids
         [len(uids)] host numpy, new rng). One dispatch, one host sync, no
         logits transfer."""
         batch = self._build_batch(uids, token_lists)
         step = self._sample_step_fn(batch.n_rows, batch.tokens.shape[1], sample_kw)
         with self._tracer.span("serve:dispatch", kind="prefill", rows=batch.n_rows):
+            if tracker is not None and rids is not None:
+                tracker.mark_dispatch(rids, "prefill")
             toks, rng, self.pool = step(
                 self.params, self.pool,
                 jnp.asarray(batch.tokens), jnp.asarray(batch.positions),
@@ -333,6 +368,8 @@ class InferenceEngineV2:
         rng: jax.Array,
         eos_id: Optional[int] = None,
         sample_kw: Tuple = (("do_sample", False),),
+        tracker: Optional[LifecycleTracker] = None,
+        rids: Optional[Sequence[int]] = None,
     ) -> Tuple[np.ndarray, np.ndarray, jax.Array]:
         """Run one K-step chained decode over ``uids``.
 
@@ -359,6 +396,8 @@ class InferenceEngineV2:
             buf["budgets"][:n] = np.minimum(budgets, k)
         chain = self._chain_fn(rows, k, eos_id, sample_kw)
         with self._tracer.span("serve:dispatch", kind="chain", rows=rows, k=k):
+            if tracker is not None and rids is not None:
+                tracker.mark_dispatch(rids, "chain")
             out, emitted, _, rng, self.pool = chain(
                 self.params, self.pool,
                 jnp.asarray(buf["tokens"]), jnp.asarray(buf["pos"]),
@@ -385,6 +424,7 @@ class InferenceEngineV2:
         top_k: int = 0,
         top_p: float = 1.0,
         seed: int = 0,
+        arrival_times: Optional[Sequence[float]] = None,
     ) -> List[np.ndarray]:
         """Convenience continuous-batching loop (the MII serving-layer analog).
 
@@ -396,6 +436,21 @@ class InferenceEngineV2:
         window, the chain first shrinks, then the youngest active sequence is
         preempted (flushed and re-queued with its full context, reference
         FastGen scheduler behavior) rather than crashing mid-generation.
+
+        ``arrival_times`` (seconds relative to the call, one per prompt)
+        turns the batch call into an open-loop workload: a prompt enters the
+        admission queue only once its arrival time has passed — this is what
+        ``tools/bench_serving.py --slo`` drives to measure TTFT/queue-wait
+        under a synthetic arrival pattern. None (default) queues everything
+        immediately, exactly the previous behavior.
+
+        When the telemetry tracer is enabled (or ``flight_recorder`` is
+        configured) every request is lifecycle-tracked (arrival -> admission
+        -> first token -> chain boundaries -> finish): ``serving/*`` SLO
+        metrics land in the shared registry and each finished request emits
+        its own Perfetto track with flow arrows into the dispatch spans that
+        served it (``inference/lifecycle.py``). Disabled, no per-request
+        records are allocated and the loop is unchanged.
         """
         prompts = [np.asarray(p, np.int32) for p in prompts]
         pool_tokens = self.config.num_kv_blocks * self.config.kv_block_size
@@ -413,7 +468,17 @@ class InferenceEngineV2:
                 )
         sample_kw = (("do_sample", do_sample), ("temperature", temperature),
                      ("top_k", top_k), ("top_p", top_p))
-        queue: deque = deque(range(len(prompts)))  # idx, FIFO
+        t_start = time.perf_counter()
+        arr: Optional[List[float]] = None
+        if arrival_times is not None:
+            if len(arrival_times) != len(prompts):
+                raise ValueError(
+                    f"arrival_times has {len(arrival_times)} entries for "
+                    f"{len(prompts)} prompts")
+            arr = [float(a) for a in arrival_times]
+            queue: deque = deque(sorted(range(len(prompts)), key=lambda i: arr[i]))
+        else:
+            queue = deque(range(len(prompts)))  # idx, FIFO
         gen: Dict[int, List[int]] = {i: [] for i in range(len(prompts))}
         active: Dict[int, int] = {}  # uid -> idx
         order: Dict[int, None] = {}  # admission order (insertion-ordered set)
@@ -421,6 +486,28 @@ class InferenceEngineV2:
         rng = jax.random.PRNGKey(seed)
         next_uid = 0
         registry = self._tracer.registry if self._tracer.enabled else None
+
+        # ---- per-request lifecycle tracking (None = nothing allocated)
+        tracker: Optional[LifecycleTracker] = None
+        if self._tracer.enabled or self._recorder is not None:
+            tracker = LifecycleTracker(
+                self._tracer, slo=self.config.serving_slo,
+                labels={"k": self.config.decode_chain},
+                recorder=self._recorder)
+            for i in range(len(prompts)):
+                tracker.arrive(i, now=t_start + (arr[i] if arr is not None else 0.0))
+        self.lifecycle = tracker
+        if registry is not None:
+            # the cheap scheduler/pool gauges, refreshed at chain boundaries
+            # (handles resolved once — the loop pays plain attribute sets)
+            g_queue = registry.gauge("serving/queue_depth")
+            g_occ = registry.gauge("serving/batch_occupancy")
+            g_free = registry.gauge("serving/kv_pool_free_blocks")
+            g_util = registry.gauge("serving/kv_pool_utilization")
+            c_preempt = registry.counter("serving/preemptions")
+            c_tokens = registry.counter("serving/tokens_decoded")
+            c_chains = registry.counter("serving/chains")
+            h_chain_len = registry.histogram("serving/chain_len")
 
         def context(idx: int) -> np.ndarray:
             return np.concatenate([prompts[idx], np.asarray(gen[idx], np.int32)])
@@ -436,6 +523,8 @@ class InferenceEngineV2:
                 active.pop(u)
                 order.pop(u)
                 self.flush(u)
+                if tracker is not None:
+                    tracker.finish(idx)
 
         while queue or active:
             # ---- admit pending prompts (fused prefill + first-token sample)
@@ -445,6 +534,8 @@ class InferenceEngineV2:
             decoding = list(active.keys())  # reserve 1-token decode headroom
             while queue and len(active) < self.config.max_seqs:
                 idx = queue[0]
+                if arr is not None and time.perf_counter() - t_start < arr[idx]:
+                    break  # open-loop workload: not arrived yet
                 cand = context(idx)
                 if not self.state.can_schedule(
                         decoding + adm_uids + [next_uid],
@@ -454,15 +545,26 @@ class InferenceEngineV2:
                 adm_uids.append(next_uid)
                 adm_tokens.append(cand)
                 adm_counts.append(len(cand))
+                if tracker is not None:
+                    tracker.admit(idx, next_uid)
                 active[next_uid] = idx
                 order[next_uid] = None
                 next_uid += 1
             if adm_uids:
-                toks, rng = self._put_sample(adm_uids, adm_tokens, rng, sample_kw)
+                adm_rids = [active[u] for u in adm_uids]
+                toks, rng = self._put_sample(adm_uids, adm_tokens, rng, sample_kw,
+                                             tracker=tracker, rids=adm_rids)
+                if tracker is not None:
+                    tracker.emitted_batch(adm_rids, (1,) * len(adm_rids))
                 for u, t in zip(adm_uids, toks):
                     accept(u, t)
             if not active:
                 if queue and not adm_uids:
+                    if arr is not None:
+                        wait = t_start + arr[queue[0]] - time.perf_counter()
+                        if wait > 0:  # idle until the next synthetic arrival
+                            time.sleep(min(wait, 0.05))
+                            continue
                     raise RuntimeError(
                         f"KV pool too small for a single sequence "
                         f"({self.config.num_kv_blocks} blocks x {self.config.kv_block_size})"
@@ -492,6 +594,10 @@ class InferenceEngineV2:
                 idx = active.pop(victim)
                 self.flush(victim)
                 queue.appendleft(idx)
+                if tracker is not None:
+                    tracker.preempt(idx)
+                if registry is not None:
+                    c_preempt.add(1.0)
                 if not uids:
                     raise RuntimeError(
                         f"KV pool too small for a single sequence "
@@ -499,16 +605,36 @@ class InferenceEngineV2:
                     )
                 k = self.config.decode_chain
             last = [gen[active[u]][-1] for u in uids]
+            chain_rids = [active[u] for u in uids]
             out, emitted, rng = self.decode_chain(
                 uids, last, budgets, k, rng, eos_id=eos_token_id,
-                sample_kw=sample_kw)
-            self.tokens_decoded += int(emitted.sum())
+                sample_kw=sample_kw, tracker=tracker, rids=chain_rids)
+            n_emitted = int(emitted.sum())
+            self.tokens_decoded += n_emitted
+            if tracker is not None:
+                # ONE stamp per chain boundary; TPOT = boundary delta / tokens
+                now = time.perf_counter()
+                tracker.emitted_batch(chain_rids, emitted, now=now)
+                tracker.sample_gauges(now=now)
             if registry is not None:
-                registry.counter("serving/tokens_decoded").add(float(emitted.sum()))
-                registry.counter("serving/chains").add(1.0)
-                registry.histogram("serving/chain_len").observe(float(k))
+                c_tokens.add(n_emitted)
+                c_chains.add(1.0)
+                h_chain_len.observe(float(k))
+                g_queue.set(float(len(queue)))
+                g_occ.set(len(active) / self.config.max_seqs)
+                g_free.set(float(self.state.free_blocks))
+                g_util.set(self.state.utilization)
             for i, u in enumerate(uids):
                 for t in out[i, : emitted[i]]:
                     if u in active:
                         accept(u, t)
+        if tracker is not None:
+            # final refresh: the last finishes land after the last chain
+            # boundary's sample, so goodput/tokens-per-s see them here
+            tracker.sample_gauges()
+        if registry is not None:
+            g_queue.set(0.0)
+            g_occ.set(0.0)
+            g_free.set(float(self.state.free_blocks))
+            g_util.set(self.state.utilization)
         return [outputs[i] for i in range(len(prompts))]

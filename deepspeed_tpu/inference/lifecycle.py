@@ -1,0 +1,394 @@
+"""Per-request lifecycle tracking for the v2 serving engine.
+
+The request-level half of serving observability (the span tracer sees the
+*engine's* phases; this sees each *request's*). Every request carries one
+lightweight ``RequestRecord`` stamped at: arrival -> admission (queue wait)
+-> prefill dispatch -> first token (TTFT) -> each decode-chain boundary
+(TPOT) -> finish / preempt / re-admit.
+
+Hot-path discipline (the reason this can ride the PR-4 fast path):
+
+  - **O(1) per chain boundary.** A chain boundary costs one
+    ``perf_counter()`` plus a float append and a histogram observe per live
+    row. There are NO per-token host timestamps — the K tokens inside a
+    chained program are invisible to the host by design, so TPOT derives
+    from consecutive boundary stamps divided by the tokens the chain
+    emitted.
+  - **Deferred trace emission.** Per-request Perfetto output (one virtual
+    track per request: queue/prefill/decode slices, plus flow arrows linking
+    its admission to the prefill and every chain dispatch span on the engine
+    thread) is materialized ONCE at request finish from the stamps — the
+    steady-state loop never appends trace events per row.
+  - **Nothing allocated when disabled.** The engine constructs a tracker
+    only when the tracer is enabled (or a serving flight recorder is
+    configured); otherwise the serving path is byte-identical to PR 4.
+
+Metrics (shared ``MetricsRegistry``; all labelled with the engine's chain
+length ``k`` so multi-config processes stay separable):
+
+  histograms  serving/ttft_ms, serving/tpot_ms, serving/queue_wait_ms,
+              serving/e2e_ms           (log-bucketed -> cheap p50/p95/p99)
+  counters    serving/requests, serving/requests_finished,
+              serving/readmissions, serving/slo_met, serving/slo_missed
+  gauges      serving/goodput, serving/tokens_per_s,
+              serving/preemption_rate  (rolling ``slo.window_s`` windows)
+
+The engine adds the unlabelled process-level scheduler/pool series at chain
+boundaries (``serving/queue_depth``, ``serving/batch_occupancy``,
+``serving/kv_pool_free_blocks``, ``serving/kv_pool_utilization``, and the
+``serving/preemptions`` counter).
+
+SLO targets come from the ``serving_slo`` config block
+(``inference/config.py:ServingSLOConfig``); goodput = fraction of finished
+requests meeting both targets, over the rolling window and cumulatively
+(the ``serving/slo_met``/``serving/slo_missed`` counters).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+# virtual Perfetto track ids for per-request tracks: far above any real
+# thread id's low bits, stable per request index
+TRACK_BASE = 0x5E51_0000
+
+
+class RequestRecord:
+    """One request's phase stamps + accounting (plain floats/ints only)."""
+
+    __slots__ = ("rid", "uid", "arrival", "admit", "first_admit", "first_token",
+                 "last_emit", "finish", "tokens", "chains", "preemptions",
+                 "readmissions", "decode_s", "dispatch_stamps", "phase")
+
+    def __init__(self, rid: int, arrival: float):
+        self.rid = rid
+        self.uid: Optional[int] = None
+        self.arrival = arrival
+        self.admit: Optional[float] = None  # most recent admission
+        self.first_admit: Optional[float] = None
+        self.first_token: Optional[float] = None
+        self.last_emit: Optional[float] = None  # previous boundary stamp
+        self.finish: Optional[float] = None
+        self.tokens = 0  # output tokens emitted
+        self.chains = 0  # decode-chain dispatches that served this request
+        self.preemptions = 0
+        self.readmissions = 0
+        self.decode_s = 0.0  # summed post-first-token boundary deltas
+        # perf_counter stamp per dispatch that carried this request (the
+        # dispatch thread id lives on the tracker) — flow-arrow targets,
+        # emitted at finish
+        self.dispatch_stamps: List[float] = []
+        self.phase = "queued"
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token is None:
+            return None
+        return self.first_token - self.arrival
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.first_admit is None:
+            return None
+        return self.first_admit - self.arrival
+
+    @property
+    def mean_tpot_s(self) -> Optional[float]:
+        # per-output-token latency AFTER the first token (the TTFT token)
+        n = self.tokens - 1
+        if n <= 0:
+            return None
+        return self.decode_s / n
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Flight-recorder view: what a post-mortem needs to name this
+        request and see where it was."""
+        return {
+            "rid": self.rid, "uid": self.uid, "phase": self.phase,
+            "arrival": self.arrival, "admit": self.admit,
+            "first_token": self.first_token, "finish": self.finish,
+            "tokens": self.tokens, "chains": self.chains,
+            "preemptions": self.preemptions, "readmissions": self.readmissions,
+        }
+
+
+class LifecycleTracker:
+    """Stamps request lifecycles, feeds the labelled SLO metrics, and emits
+    per-request Perfetto tracks + flow events at finish.
+
+    ``clock`` is injectable (tests pin TTFT/TPOT against a fake clock); every
+    method also takes an explicit ``now`` so callers can reuse one stamp
+    across a batch. ``emit_metrics=False`` (flight-recorder-only mode, tracer
+    disabled) keeps the registry and trace untouched.
+    """
+
+    def __init__(self, tracer, slo=None, labels: Optional[Dict[str, Any]] = None,
+                 clock=time.perf_counter, recorder=None, emit_metrics: bool = True):
+        self._tracer = tracer
+        self._slo = slo
+        self._clock = clock
+        self._recorder = recorder
+        self._labels = {k: str(v) for k, v in (labels or {}).items()}
+        self._records: Dict[int, RequestRecord] = {}
+        self._emit = emit_metrics and getattr(tracer, "enabled", False)
+        window = float(getattr(slo, "window_s", 30.0) or 30.0)
+        self._window_s = window
+        # rolling windows with running sums — pruning and reading are O(1)
+        # amortized per chain boundary, never a scan
+        self._win_tokens: deque = deque()  # (t, n)
+        self._win_tokens_sum = 0
+        self._win_preempts: deque = deque()  # t
+        self._win_slo: deque = deque()  # (t, 1|0)
+        self._win_slo_met = 0
+        self._dispatch_tid: Optional[int] = None
+        if self._emit:
+            reg = tracer.registry
+            lb = self._labels
+            self._h_ttft = reg.histogram("serving/ttft_ms", **lb)
+            self._h_tpot = reg.histogram("serving/tpot_ms", **lb)
+            self._h_queue = reg.histogram("serving/queue_wait_ms", **lb)
+            self._h_e2e = reg.histogram("serving/e2e_ms", **lb)
+            self._c_requests = reg.counter("serving/requests", **lb)
+            self._c_finished = reg.counter("serving/requests_finished", **lb)
+            self._c_readmit = reg.counter("serving/readmissions", **lb)
+            self._c_slo_met = reg.counter("serving/slo_met", **lb)
+            self._c_slo_missed = reg.counter("serving/slo_missed", **lb)
+            self._g_goodput = reg.gauge("serving/goodput", **lb)
+            self._g_tps = reg.gauge("serving/tokens_per_s", **lb)
+            self._g_preempt_rate = reg.gauge("serving/preemption_rate", **lb)
+
+    # ------------------------------------------------------------- helpers
+    def _now(self, now: Optional[float]) -> float:
+        return self._clock() if now is None else now
+
+    def get(self, rid: int) -> Optional[RequestRecord]:
+        return self._records.get(rid)
+
+    def records(self) -> Dict[int, RequestRecord]:
+        return self._records
+
+    def _record_to_recorder(self, rec: RequestRecord) -> None:
+        if self._recorder is not None:
+            snap = rec.snapshot()
+            self._recorder.record_request(snap.pop("rid"), **snap)
+
+    # ------------------------------------------------------------ lifecycle
+    def arrive(self, rid: int, now: Optional[float] = None) -> RequestRecord:
+        now = self._now(now)
+        rec = self._records.get(rid)
+        if rec is None:
+            rec = self._records[rid] = RequestRecord(rid, now)
+            if self._emit:
+                self._c_requests.add(1.0)
+            self._record_to_recorder(rec)
+        return rec
+
+    def admit(self, rid: int, uid: int, now: Optional[float] = None) -> None:
+        now = self._now(now)
+        rec = self._records[rid]
+        rec.uid = uid
+        rec.admit = now
+        rec.phase = "prefill"
+        if rec.first_admit is None:
+            rec.first_admit = now
+            if self._emit:
+                self._h_queue.observe((now - rec.arrival) * 1e3)
+        else:
+            rec.readmissions += 1
+            if self._emit:
+                self._c_readmit.add(1.0)
+        self._record_to_recorder(rec)
+
+    def mark_dispatch(self, rids: Sequence[int], kind: str,
+                      now: Optional[float] = None) -> None:
+        """Stamp a dispatch that carries these requests — called INSIDE the
+        engine's ``serve:dispatch`` span so the deferred flow arrows land
+        within that slice. One float append per row; no trace events here."""
+        now = self._now(now)
+        if self._dispatch_tid is None:
+            self._dispatch_tid = threading.get_ident()
+        recs = self._records
+        if kind == "chain":
+            for rid in rids:
+                rec = recs.get(rid)
+                if rec is not None:
+                    rec.dispatch_stamps.append(now)
+                    rec.chains += 1
+        else:
+            for rid in rids:
+                rec = recs.get(rid)
+                if rec is not None:
+                    rec.dispatch_stamps.append(now)
+
+    def emitted_batch(self, rids: Sequence[int], counts: Sequence[int],
+                      now: Optional[float] = None) -> None:
+        """Record new output tokens for a whole boundary in one call — the
+        chain fetch passes every live row. First emission per request stamps
+        TTFT; later ones contribute per-token TPOT samples. Rows of one
+        chain typically share (boundary delta, tokens), so their identical
+        TPOT values collapse into grouped ``observe_n`` bucket hits."""
+        now = self._now(now)
+        recs = self._records
+        emit = self._emit
+        tpot_groups: Dict[float, int] = {}
+        new_tokens = 0
+        for rid, n in zip(rids, counts):
+            n = int(n)
+            if n <= 0:
+                continue
+            rec = recs.get(rid)
+            if rec is None:
+                continue
+            if rec.first_token is None:
+                rec.first_token = now
+                rec.phase = "decoding"
+                if emit:
+                    self._h_ttft.observe((now - rec.arrival) * 1e3)
+                # the TTFT token itself is not a TPOT sample
+                n_tpot = n - 1
+            else:
+                n_tpot = n
+            if n_tpot > 0 and rec.last_emit is not None:
+                dt = now - rec.last_emit
+                rec.decode_s += dt
+                if emit:
+                    v = dt / n_tpot * 1e3
+                    tpot_groups[v] = tpot_groups.get(v, 0) + 1
+            rec.tokens += n
+            rec.last_emit = now
+            new_tokens += n
+        if emit:
+            for v, c in tpot_groups.items():
+                self._h_tpot.observe_n(v, c)
+            if new_tokens:
+                self._win_tokens.append((now, new_tokens))
+                self._win_tokens_sum += new_tokens
+
+    def emitted(self, rid: int, n_tokens: int, now: Optional[float] = None) -> None:
+        """Single-request convenience wrapper over ``emitted_batch``."""
+        self.emitted_batch((rid,), (n_tokens,), now=now)
+
+    def preempt(self, rid: int, now: Optional[float] = None) -> None:
+        now = self._now(now)
+        rec = self._records.get(rid)
+        if rec is None:
+            return
+        rec.preemptions += 1
+        rec.phase = "preempted"
+        # decode pauses while re-queued: break the TPOT chain so queue time
+        # is charged to the (re)admission wait, not to per-token latency
+        rec.last_emit = None
+        if self._emit:
+            self._win_preempts.append(now)
+        self._record_to_recorder(rec)
+
+    def _meets_slo_counted(self, rec: RequestRecord, now: float) -> None:
+        met = self._meets_slo(rec)
+        if met is not None:
+            (self._c_slo_met if met else self._c_slo_missed).add(1.0)
+            self._win_slo.append((now, 1 if met else 0))
+            self._win_slo_met += 1 if met else 0
+
+    def finish(self, rid: int, now: Optional[float] = None) -> None:
+        now = self._now(now)
+        rec = self._records.get(rid)
+        if rec is None:
+            return
+        rec.finish = now
+        rec.phase = "finished"
+        self._record_to_recorder(rec)
+        if not self._emit:
+            return
+        self._c_finished.add(1.0)
+        self._h_e2e.observe((now - rec.arrival) * 1e3)
+        self._meets_slo_counted(rec, now)
+        self._emit_request_track(rec)
+
+    def _meets_slo(self, rec: RequestRecord) -> Optional[bool]:
+        """True/False against the configured targets; None when no target is
+        configured (goodput undefined — never counted)."""
+        slo = self._slo
+        ttft_t = getattr(slo, "ttft_ms", None) if slo is not None else None
+        tpot_t = getattr(slo, "tpot_ms", None) if slo is not None else None
+        if ttft_t is None and tpot_t is None:
+            return None
+        ok = True
+        if ttft_t is not None:
+            ttft = rec.ttft_s
+            ok &= ttft is not None and ttft * 1e3 <= ttft_t
+        if tpot_t is not None:
+            tpot = rec.mean_tpot_s
+            if tpot is not None:  # single-token requests have no TPOT
+                ok &= tpot * 1e3 <= tpot_t
+        return bool(ok)
+
+    # -------------------------------------------------------------- gauges
+    def sample_gauges(self, now: Optional[float] = None) -> None:
+        """Refresh the rolling-window gauges (called at chain boundaries).
+        Running sums make this O(expired entries), not a window scan."""
+        if not self._emit:
+            return
+        now = self._now(now)
+        horizon = now - self._window_s
+        wt = self._win_tokens
+        while wt and wt[0][0] < horizon:
+            self._win_tokens_sum -= wt.popleft()[1]
+        wp = self._win_preempts
+        while wp and wp[0] < horizon:
+            wp.popleft()
+        ws = self._win_slo
+        while ws and ws[0][0] < horizon:
+            self._win_slo_met -= ws.popleft()[1]
+        if wt:
+            span = max(now - wt[0][0], 1e-6)
+            self._g_tps.set(self._win_tokens_sum / span)
+        self._g_preempt_rate.set(len(wp) / self._window_s)
+        if ws:
+            self._g_goodput.set(self._win_slo_met / len(ws))
+
+    # ------------------------------------------------------ trace emission
+    def _emit_request_track(self, rec: RequestRecord) -> None:
+        """Materialize the request's Perfetto track + flow arrows (deferred
+        to finish — the whole batch lands under ONE tracer lock; the
+        steady-state loop appends zero trace events per row)."""
+        tr = self._tracer
+        rid = rec.rid
+        tid = TRACK_BASE + rid
+        tr.name_track(tid, f"req {rid}")
+        o = tr.origin()
+        # one shared args dict referenced by all three phase slices (the
+        # exporter only reads it); flat literals — no closures, no merges
+        args = {"rid": rid, "tokens": rec.tokens, "chains": rec.chains,
+                "preemptions": rec.preemptions}
+        fa, ft, fin = rec.first_admit, rec.first_token, rec.finish
+        flow_name = f"req-{rid}"
+        evs: List[Dict[str, Any]] = []
+        if fa is not None:
+            evs.append({"kind": "span", "name": "queue", "cat": "serve_req",
+                        "ts": rec.arrival - o, "dur": max(fa - rec.arrival, 0.0),
+                        "tid": tid, "args": args})
+            if ft is not None:
+                evs.append({"kind": "span", "name": "prefill", "cat": "serve_req",
+                            "ts": fa - o, "dur": max(ft - fa, 0.0),
+                            "tid": tid, "args": args})
+        if ft is not None and fin is not None:
+            evs.append({"kind": "span", "name": "decode", "cat": "serve_req",
+                        "ts": ft - o, "dur": max(fin - ft, 0.0), "tid": tid,
+                        "args": {"ttft_ms": round((ft - rec.arrival) * 1e3, 3),
+                                 **args}})
+        # flow: start on the request track at admission, one step inside every
+        # dispatch span that carried the request, end back on the track
+        if fa is not None:
+            evs.append({"kind": "flow", "name": flow_name, "cat": "flow",
+                        "ph": "s", "id": rid, "ts": fa + 1e-7 - o, "tid": tid})
+        dtid = self._dispatch_tid or tid
+        for t in rec.dispatch_stamps:
+            evs.append({"kind": "flow", "name": flow_name, "cat": "flow",
+                        "ph": "t", "id": rid, "ts": t - o, "tid": dtid})
+        if fin is not None:
+            evs.append({"kind": "flow", "name": flow_name, "cat": "flow",
+                        "ph": "f", "id": rid, "ts": fin - 1e-7 - o, "tid": tid})
+        tr.append_events(evs)

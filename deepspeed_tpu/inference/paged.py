@@ -240,6 +240,13 @@ def ragged_decode_chain(
     Returns ``(out_tokens [N, K], emitted [N], active [N], rng, pool)`` where
     ``out_tokens[i, :emitted[i]]`` are valid and ``emitted[i]`` is also the
     number of KV slots row i consumed (== seen_tokens advance).
+
+    Observability contract: the chain boundary is the host's ONLY visibility
+    quantum — the K in-scan tokens carry no host timestamps by design, so
+    per-token latency (TPOT) is derived as (boundary delta) / ``emitted``
+    by the request lifecycle layer (``inference/lifecycle.py``). Anything
+    that needs per-token host stamps would reintroduce the per-token sync
+    this program exists to eliminate.
     """
 
     def step(carry, _):

@@ -144,6 +144,13 @@ class StateManager:
     def free_blocks(self) -> int:
         return self.allocator.free_blocks
 
+    @property
+    def utilization(self) -> float:
+        """Fraction of the KV pool's blocks currently allocated (the
+        ``serving/kv_pool_utilization`` gauge)."""
+        total = self.allocator.num_blocks
+        return (total - self.allocator.free_blocks) / total
+
     def get(self, uid: int) -> Optional[SequenceDescriptor]:
         return self._seqs.get(uid)
 

@@ -53,6 +53,29 @@ from deepspeed_tpu.topology.mesh import (
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import ThroughputTimer
 
+# /metrics HTTP servers, one per configured port for the process lifetime
+# (daemon threads over the process-global registry — engines come and go,
+# the exposition endpoint stays; port 0 always binds a fresh free port).
+_METRICS_SERVERS: dict = {}
+
+
+def _get_metrics_server(port: int):
+    """Start (or reuse) the process-global /metrics server for ``port``.
+    Never raises — an unbindable port logs a warning and returns None."""
+    from deepspeed_tpu import telemetry as telemetry_mod
+
+    srv = _METRICS_SERVERS.get(port)
+    if srv is not None and srv.port is not None:
+        return srv
+    try:
+        srv = telemetry_mod.serve_metrics(port=port)
+    except OSError as e:  # port taken by something that is not ours
+        logger.warning(f"telemetry: could not bind /metrics on port {port}: {e}")
+        return None
+    if port != 0:  # every port-0 request gets its own fresh server
+        _METRICS_SERVERS[port] = srv
+    return srv
+
 
 class TrainState(NamedTuple):
     """Entire training state — one pytree, placed once on the mesh."""
@@ -256,12 +279,25 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu import telemetry as telemetry_mod
 
         tcfg = self.config.model.telemetry
+        self._metrics_server = None
         if tcfg.enabled:
             telemetry_mod.configure(
                 enabled=True, sync_spans=tcfg.sync_spans,
                 max_events=tcfg.max_events,
                 memory_watermarks=tcfg.memory_watermarks,
-                trace_path=tcfg.trace_path, jsonl_path=tcfg.jsonl_path)
+                trace_path=tcfg.trace_path, jsonl_path=tcfg.jsonl_path,
+                prometheus_path=tcfg.prometheus_path)
+            if tcfg.http_port is not None:
+                # scrapeable /metrics for the whole registry (training scalars
+                # ride the same exposition the serving SLO metrics use). The
+                # server is PROCESS-global state like the tracer it exposes:
+                # one per configured port, reused by later engines (tests
+                # build dozens; a second bind would EADDRINUSE).
+                self._metrics_server = _get_metrics_server(tcfg.http_port)
+                if self._metrics_server is not None:
+                    log_dist(
+                        f"telemetry: /metrics on port {self._metrics_server.port}",
+                        ranks=[0])
         self._tracer = telemetry_mod.get_tracer()
         # Collectives (collectives/): install the selector tunables so comm
         # facade calls with algorithm="auto" (and the zeropp overlap knob)

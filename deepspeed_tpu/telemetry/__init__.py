@@ -1,8 +1,11 @@
 """deepspeed_tpu.telemetry: unified observability substrate.
 
 One process-global ``Tracer`` (nestable wall-clock spans, bounded buffer)
-plus a shared ``MetricsRegistry`` (counters/gauges/histograms) and two
-exporters (Chrome trace-event JSON for Perfetto, JSONL for tooling).
+plus a shared ``MetricsRegistry`` (labelled counters/gauges + log-bucketed
+quantile histograms), two trace exporters (Chrome trace-event JSON for
+Perfetto, JSONL for tooling), and a metrics exposition layer
+(``exposition.py``: Prometheus text format, JSON snapshot, opt-in stdlib
+``/metrics`` HTTP endpoint).
 
 Wired into:
   - ``runtime/engine.py``   — train_batch/data/step + fwd/bwd/step parity
@@ -31,6 +34,14 @@ from deepspeed_tpu.telemetry.exporters import (
     export_chrome_trace,
     export_jsonl,
 )
+from deepspeed_tpu.telemetry.exposition import (
+    MetricsServer,
+    export_json_snapshot,
+    export_prometheus,
+    render_json_snapshot,
+    render_prometheus,
+    serve_metrics,
+)
 from deepspeed_tpu.telemetry.registry import (
     Counter,
     Gauge,
@@ -52,6 +63,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "MetricsServer",
     "NOOP_SPAN",
     "Tracer",
     "chrome_trace_events",
@@ -60,7 +72,12 @@ __all__ = [
     "enabled",
     "env_enabled",
     "export_chrome_trace",
+    "export_json_snapshot",
     "export_jsonl",
+    "export_prometheus",
     "get_tracer",
+    "render_json_snapshot",
+    "render_prometheus",
+    "serve_metrics",
     "span",
 ]

@@ -46,6 +46,15 @@ def chrome_trace_events(tracer=None) -> Dict[str, Any]:
             "args": {"name": "deepspeed_tpu"},
         }
     ]
+    # virtual-track labels (per-request serving tracks): thread_name metadata
+    for tid, tname in sorted(tracer.track_names().items()):
+        out.append({
+            "name": "thread_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": tname},
+        })
     for ev in tracer.events():
         ts_us = ev["ts"] * 1e6
         if ev["kind"] == "span":
@@ -72,6 +81,21 @@ def chrome_trace_events(tracer=None) -> Dict[str, Any]:
             }
             if "args" in ev:
                 rec["args"] = ev["args"]
+        elif ev["kind"] == "flow":
+            # flow arrows: "s" (start) -> "t" (step)* -> "f" (end); matching
+            # (cat, name, id) bind them; "bp": "e" attaches the end to its
+            # enclosing slice instead of the next one
+            rec = {
+                "name": ev["name"],
+                "cat": ev.get("cat", "flow"),
+                "ph": ev["ph"],
+                "id": ev["id"],
+                "ts": ts_us,
+                "pid": pid,
+                "tid": ev["tid"],
+            }
+            if ev["ph"] == "f":
+                rec["bp"] = "e"
         else:  # counter
             rec = {
                 "name": ev["name"],

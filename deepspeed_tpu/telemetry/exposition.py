@@ -1,0 +1,222 @@
+"""Standard-format metric exposition: Prometheus text format + JSON snapshot.
+
+The registry half of the telemetry subsystem gains a scrapeable surface:
+
+  - ``render_prometheus()``   -> Prometheus text exposition format 0.0.4
+  - ``export_prometheus(p)``  -> write it to a file (node-exporter textfile
+    collector style, or for tests/artifacts)
+  - ``render_json_snapshot()`` / ``export_json_snapshot(p)`` -> the registry's
+    flat snapshot (labelled keys, histogram summaries incl. p50/p95/p99)
+  - ``MetricsServer``         -> opt-in stdlib ``http.server`` thread serving
+    ``GET /metrics`` (text) and ``GET /metrics.json`` (snapshot) — no new
+    dependencies, daemon thread, ``port=0`` picks a free port
+
+Name mapping: registry names use ``subsystem/name`` (enforced by
+``tests/unit/test_metric_names.py``); Prometheus identifiers cannot contain
+``/``, so ``serving/ttft_ms`` exports as ``dstpu_serving_ttft_ms`` (every
+non-identifier character becomes ``_``, one ``dstpu_`` namespace prefix).
+Counters get the conventional ``_total`` suffix. Labelled registry children
+(``name{k="8"}``) export as one family with proper label sets.
+
+Histograms export the standard cumulative ``_bucket{le=...}`` series straight
+from the registry's sparse log buckets (upper bound of populated buckets
+only, plus ``+Inf``), ``_sum`` and ``_count`` — PromQL's
+``histogram_quantile`` reproduces the same bounded-error percentiles the
+in-process ``Histogram.quantile`` answers. For operators reading the raw
+exposition, precomputed ``<name>_p50/_p95/_p99`` gauges ride along.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+PROM_PREFIX = "dstpu_"
+_IDENT_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _resolve_registry(registry) -> Any:
+    if registry is None:
+        from deepspeed_tpu.telemetry.tracer import get_tracer
+
+        registry = get_tracer().registry
+    return registry
+
+
+def prom_name(name: str) -> str:
+    """Registry ``subsystem/name`` -> Prometheus identifier."""
+    return PROM_PREFIX + _IDENT_RE.sub("_", name)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labels_str(labels: Dict[str, str], extra: Optional[Dict[str, str]] = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+def _fmt(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    return repr(float(v))
+
+
+def render_prometheus(registry=None) -> str:
+    """Render the whole registry in Prometheus text exposition format."""
+    from deepspeed_tpu.telemetry.registry import bucket_upper_bound
+
+    registry = _resolve_registry(registry)
+    families: Dict[str, Dict[str, Any]] = {}  # pname -> {kind, help, rows}
+    for kind, base, metric in registry.iter_metrics():
+        pname = prom_name(base) + ("_total" if kind == "counter" else "")
+        fam = families.setdefault(
+            pname, {"kind": kind, "help": base, "rows": [], "extra": []})
+        if kind in ("counter", "gauge"):
+            fam["rows"].append((metric.labels, metric.value))
+            continue
+        # histogram: cumulative buckets from the sparse log buckets
+        s = metric.summary()
+        cum = 0
+        bucket_rows: List[str] = []
+        for idx, c in metric.buckets():
+            cum += c
+            le = bucket_upper_bound(idx)
+            bucket_rows.append(
+                f"{pname}_bucket{_labels_str(metric.labels, {'le': _fmt(le)})} {cum}")
+        bucket_rows.append(
+            f"{pname}_bucket{_labels_str(metric.labels, {'le': '+Inf'})} {s['count']}")
+        bucket_rows.append(f"{pname}_sum{_labels_str(metric.labels)} {_fmt(s['total'])}")
+        bucket_rows.append(f"{pname}_count{_labels_str(metric.labels)} {s['count']}")
+        fam["rows"].append((metric.labels, bucket_rows))
+        # precomputed quantile gauges for humans reading the raw exposition
+        if s["count"]:
+            for q in ("p50", "p95", "p99"):
+                fam["extra"].append(
+                    f"{pname}_{q}{_labels_str(metric.labels)} {_fmt(s[q])}")
+
+    lines: List[str] = []
+    for pname in sorted(families):
+        fam = families[pname]
+        lines.append(f"# HELP {pname} registry metric {fam['help']}")
+        lines.append(f"# TYPE {pname} {fam['kind']}")
+        if fam["kind"] in ("counter", "gauge"):
+            for labels, value in fam["rows"]:
+                lines.append(f"{pname}{_labels_str(labels)} {_fmt(value)}")
+        else:
+            for _labels, bucket_rows in fam["rows"]:
+                lines.extend(bucket_rows)
+        for row in fam["extra"]:
+            lines.append(row)
+    return "\n".join(lines) + "\n"
+
+
+def render_json_snapshot(registry=None, indent: Optional[int] = 2) -> str:
+    """The registry's flat snapshot as JSON (labelled keys preserved,
+    histogram summaries carry p50/p95/p99)."""
+    registry = _resolve_registry(registry)
+    doc = {"time_unix": time.time(), "metrics": registry.snapshot()}
+    return json.dumps(doc, indent=indent, sort_keys=True)
+
+
+def _write(path: str, text: str) -> str:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
+def export_prometheus(path: Optional[str] = None, registry=None) -> str:
+    """Write the Prometheus text exposition; returns the path written."""
+    from deepspeed_tpu.telemetry.exporters import default_output_dir
+
+    path = path or os.path.join(default_output_dir(), "metrics.prom")
+    return _write(path, render_prometheus(registry))
+
+
+def export_json_snapshot(path: Optional[str] = None, registry=None) -> str:
+    """Write the JSON metrics snapshot; returns the path written."""
+    from deepspeed_tpu.telemetry.exporters import default_output_dir
+
+    path = path or os.path.join(default_output_dir(), "metrics.json")
+    return _write(path, render_json_snapshot(registry) + "\n")
+
+
+class MetricsServer:
+    """Opt-in ``/metrics`` HTTP endpoint (stdlib only, daemon thread).
+
+    ``GET /metrics`` serves the Prometheus text exposition (content type
+    ``text/plain; version=0.0.4``), ``GET /metrics.json`` the JSON snapshot.
+    ``port=0`` binds a free port (``server.port`` holds the real one) —
+    tests and multi-engine processes never collide. The handler renders at
+    request time, so a scraper always sees the live registry.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1", registry=None):
+        self._registry = _resolve_registry(registry)
+        self._host = host
+        self._requested_port = port
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        import http.server
+
+        registry = self._registry
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib handler contract
+                if self.path.split("?")[0] == "/metrics":
+                    body = render_prometheus(registry).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?")[0] == "/metrics.json":
+                    body = render_json_snapshot(registry).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-scrape stderr noise
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dstpu-metrics", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+            self.port = None
+
+
+def serve_metrics(port: int = 0, host: str = "127.0.0.1", registry=None) -> MetricsServer:
+    """Start a ``MetricsServer`` and return it (``.port`` has the bound port)."""
+    return MetricsServer(port=port, host=host, registry=registry).start()

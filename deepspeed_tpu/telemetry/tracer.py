@@ -75,9 +75,34 @@ class _Span:
         return self
 
     def __exit__(self, *exc):
-        if self._tracer.sync_spans:
+        # close is inlined (no helper-call indirection): the serving loop
+        # closes three spans per decode chain, so every fixed cost here is
+        # paid on the hot path
+        tracer = self._tracer
+        if tracer.sync_spans:
             _drain_device()
-        self._tracer._finish_span(self)
+        t1 = time.perf_counter()
+        dur_s = t1 - self._t0
+        ev = {
+            "kind": "span",
+            "name": self.name,
+            "cat": self.cat,
+            "ts": self._t0 - tracer._origin,
+            "dur": dur_s,
+            "tid": threading.get_ident(),
+        }
+        if self.args:
+            ev["args"] = self.args
+        with tracer._lock:
+            if len(tracer._events) >= tracer.max_events:
+                tracer.dropped_events += 1
+            else:
+                tracer._events.append(ev)
+        h = tracer._span_hists.get(self.name)
+        if h is None:  # get-or-create once, then plain dict hits
+            h = tracer._span_hists[self.name] = tracer.registry.histogram(
+                "span/" + self.name)
+        h.observe(dur_s)
         return False
 
 
@@ -97,19 +122,27 @@ class Tracer:
         self.memory_watermarks = memory_watermarks
         self.trace_path: Optional[str] = None
         self.jsonl_path: Optional[str] = None
+        self.prometheus_path: Optional[str] = None
         self.dropped_events = 0
         self.registry = MetricsRegistry()
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
         self._origin = time.perf_counter()
         self._last_counts: Dict[str, float] = {}
+        # virtual-track names (e.g. per-request serving tracks): tid -> label,
+        # exported as Chrome thread_name metadata so Perfetto shows the label
+        self._track_names: Dict[int, str] = {}
+        # span-name -> Histogram handle cache: skips the f-string + registry
+        # RLock on every span close (the serving hot path closes 3 per chain)
+        self._span_hists: Dict[str, Any] = {}
 
     # ------------------------------------------------------------ config
     def configure(self, enabled: bool = True, sync_spans: Optional[bool] = None,
                   max_events: Optional[int] = None,
                   memory_watermarks: Optional[bool] = None,
                   trace_path: Optional[str] = None,
-                  jsonl_path: Optional[str] = None) -> "Tracer":
+                  jsonl_path: Optional[str] = None,
+                  prometheus_path: Optional[str] = None) -> "Tracer":
         self.enabled = enabled
         if sync_spans is not None:
             self.sync_spans = sync_spans
@@ -121,6 +154,8 @@ class Tracer:
             self.trace_path = trace_path
         if jsonl_path is not None:
             self.jsonl_path = jsonl_path
+        if prometheus_path is not None:
+            self.prometheus_path = prometheus_path
         return self
 
     def reset(self) -> None:
@@ -130,6 +165,8 @@ class Tracer:
             self.dropped_events = 0
             self._origin = time.perf_counter()
             self._last_counts = {}
+            self._track_names = {}
+            self._span_hists = {}
         self.registry.reset()
 
     # ------------------------------------------------------------- spans
@@ -138,22 +175,6 @@ class Tracer:
         if not self.enabled:
             return NOOP_SPAN
         return _Span(self, name, cat, args or None)
-
-    def _finish_span(self, s: _Span) -> None:
-        t1 = time.perf_counter()
-        dur_s = t1 - s._t0
-        ev = {
-            "kind": "span",
-            "name": s.name,
-            "cat": s.cat,
-            "ts": s._t0 - self._origin,
-            "dur": dur_s,
-            "tid": threading.get_ident(),
-        }
-        if s.args:
-            ev["args"] = s.args
-        self._append(ev)
-        self.registry.histogram(f"span/{s.name}").observe(dur_s)
 
     def instant(self, name: str, cat: str = "event", **args: Any) -> None:
         """Record a zero-duration marker event."""
@@ -188,6 +209,87 @@ class Tracer:
             "ts": time.perf_counter() - self._origin,
             "value": value,
         })
+
+    # ------------------------------------------ virtual tracks + flow events
+    # (serving per-request observability: each request gets its own Perfetto
+    # track, and flow arrows link its admission to the prefill/chain dispatch
+    # spans on the engine thread — see inference/lifecycle.py)
+    def name_track(self, tid: int, name: str) -> None:
+        """Label a virtual track (exported as Chrome thread_name metadata)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._track_names[tid] = name
+
+    def track_names(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._track_names)
+
+    def emit_span(self, name: str, t0: float, t1: float, tid: Optional[int] = None,
+                  cat: str = "span", **args: Any) -> None:
+        """Record a span from explicit ``time.perf_counter()`` stamps —
+        deferred emission for lifecycles whose phases are stamped on the hot
+        path but materialized (one cheap append per phase) only at request
+        finish. ``tid`` selects a virtual track; default: calling thread."""
+        if not self.enabled:
+            return
+        ev: Dict[str, Any] = {
+            "kind": "span",
+            "name": name,
+            "cat": cat,
+            "ts": t0 - self._origin,
+            "dur": max(t1 - t0, 0.0),
+            "tid": threading.get_ident() if tid is None else tid,
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def flow(self, name: str, flow_id: int, phase: str,
+             ts: Optional[float] = None, tid: Optional[int] = None,
+             cat: str = "flow") -> None:
+        """Record one flow event (``phase``: 'start' | 'step' | 'end').
+
+        Chrome flow events with a shared (cat, name, id) draw arrows between
+        the slices enclosing them — this is what links a request's admission
+        on its own track to every dispatch span that served it."""
+        if not self.enabled:
+            return
+        ev: Dict[str, Any] = {
+            "kind": "flow",
+            "name": name,
+            "cat": cat,
+            "ph": {"start": "s", "step": "t", "end": "f"}[phase],
+            "id": flow_id,
+            "ts": (time.perf_counter() if ts is None else ts) - self._origin,
+            "tid": threading.get_ident() if tid is None else tid,
+        }
+        self._append(ev)
+
+    def origin(self) -> float:
+        """The ``perf_counter`` stamp event ``ts`` values are relative to —
+        for callers building deferred event batches (``append_events``)."""
+        return self._origin
+
+    def append_events(self, evs: List[Dict[str, Any]]) -> None:
+        """Append a pre-built event batch under ONE lock acquisition.
+
+        Events must already carry origin-relative ``ts`` (see ``origin()``)
+        and the raw tracer schema (``kind`` span/instant/flow/counter). This
+        is the deferred-emission path: a request lifecycle materializes its
+        whole track (spans + flow arrows) in one call at finish instead of
+        paying a lock per event on the serving hot path."""
+        if not self.enabled or not evs:
+            return
+        with self._lock:
+            space = self.max_events - len(self._events)
+            if space <= 0:
+                self.dropped_events += len(evs)
+                return
+            if len(evs) > space:
+                self.dropped_events += len(evs) - space
+                evs = evs[:space]
+            self._events.extend(evs)
 
     def _append(self, ev: Dict[str, Any]) -> None:
         with self._lock:
@@ -284,6 +386,10 @@ class Tracer:
             exporters.export_chrome_trace(self.trace_path, tracer=self)
         if self.jsonl_path:
             exporters.export_jsonl(self.jsonl_path, tracer=self)
+        if self.prometheus_path:
+            from deepspeed_tpu.telemetry import exposition
+
+            exposition.export_prometheus(self.prometheus_path, registry=self.registry)
 
 
 def env_enabled() -> bool:

@@ -1,0 +1,185 @@
+"""Exposition + labelled-registry tests (ISSUE 5 serving SLO observability).
+
+Contract under test:
+  - Prometheus text round-trip: render -> parse (small in-test parser) ->
+    counters/gauges/histogram buckets and labels match the registry
+  - log-bucketed histogram quantiles carry bounded relative error vs numpy
+    percentiles
+  - labels create separable children; unlabelled call sites are unchanged
+  - the /metrics HTTP server serves the live registry (text + JSON)
+  - tracer.prometheus_path export rides maybe_export
+"""
+
+import json
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.telemetry import exposition
+from deepspeed_tpu.telemetry.registry import MetricsRegistry, bucket_upper_bound
+from deepspeed_tpu.telemetry.tracer import Tracer
+
+
+# ------------------------------------------------------- in-test parser
+def parse_prometheus(text):
+    """Tiny exposition-format parser: returns (types, samples) where samples
+    maps (name, frozenset(labels.items())) -> float."""
+    types = {}
+    samples = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        m = re.match(r'^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(\S+)$', line)
+        assert m, f"unparseable exposition line: {line!r}"
+        name, labelstr, value = m.groups()
+        labels = {}
+        if labelstr:
+            for part in re.findall(r'(\w+)="((?:[^"\\]|\\.)*)"', labelstr):
+                labels[part[0]] = part[1]
+        v = float("inf") if value == "+Inf" else float(value)
+        samples[(name, frozenset(labels.items()))] = v
+    return types, samples
+
+
+# ---------------------------------------------------------- round-trip
+def test_prometheus_round_trip_counters_gauges():
+    r = MetricsRegistry()
+    r.counter("comm/bytes").add(512)
+    r.counter("serving/requests", k=8, model="tiny").add(3)
+    r.gauge("serving/queue_depth").set(5)
+    types, samples = parse_prometheus(exposition.render_prometheus(r))
+
+    assert types["dstpu_comm_bytes_total"] == "counter"
+    assert samples[("dstpu_comm_bytes_total", frozenset())] == 512.0
+    assert types["dstpu_serving_requests_total"] == "counter"
+    assert samples[("dstpu_serving_requests_total",
+                    frozenset({("k", "8"), ("model", "tiny")}.union()))] == 3.0
+    assert types["dstpu_serving_queue_depth"] == "gauge"
+    assert samples[("dstpu_serving_queue_depth", frozenset())] == 5.0
+
+
+def test_prometheus_round_trip_histogram_buckets():
+    r = MetricsRegistry()
+    h = r.histogram("serving/ttft_ms", k=4)
+    values = [0.5, 1.0, 5.0, 5.0, 40.0, 900.0]
+    for v in values:
+        h.observe(v)
+    text = exposition.render_prometheus(r)
+    types, samples = parse_prometheus(text)
+    assert types["dstpu_serving_ttft_ms"] == "histogram"
+
+    base = frozenset({("k", "4")})
+    assert samples[("dstpu_serving_ttft_ms_count", base)] == len(values)
+    assert samples[("dstpu_serving_ttft_ms_sum", base)] == pytest.approx(sum(values))
+    # +Inf bucket equals the count
+    assert samples[("dstpu_serving_ttft_ms_bucket",
+                    frozenset({("k", "4"), ("le", "+Inf")}))] == len(values)
+    # cumulative bucket counts reproduce the registry's sparse log buckets
+    cum = 0
+    for idx, c in h.buckets():
+        cum += c
+        le = bucket_upper_bound(idx)
+        key = ("dstpu_serving_ttft_ms_bucket",
+               frozenset({("k", "4"), ("le", repr(float(le)))}))
+        assert samples[key] == cum
+        # the bucket bound really is an upper bound for everything below it
+        assert sum(1 for v in values if v <= le) >= cum
+    # precomputed quantile gauges ride along for raw-exposition readers
+    assert ("dstpu_serving_ttft_ms_p50", base) in samples
+    assert ("dstpu_serving_ttft_ms_p99", base) in samples
+
+
+def test_quantile_bounded_relative_error_vs_numpy():
+    r = MetricsRegistry()
+    h = r.histogram("serving/tpot_ms")
+    rng = np.random.default_rng(0)
+    data = rng.lognormal(mean=2.0, sigma=1.2, size=8000)
+    for v in data:
+        h.observe(float(v))
+    for q in (0.50, 0.90, 0.95, 0.99):
+        est = h.quantile(q)
+        ref = float(np.percentile(data, q * 100))
+        assert abs(est - ref) / ref < 0.06, (q, est, ref)
+    # extremes: p0 within one bucket's relative error of the min (estimates
+    # clamp to the exact observed range), p100 exactly the max
+    assert h.quantile(0.0) <= float(data.min()) * 1.05
+    assert h.quantile(1.0) == pytest.approx(float(data.max()))
+    s = h.summary()
+    assert {"p50", "p95", "p99"} <= set(s)
+
+
+def test_observe_n_matches_repeated_observe():
+    r = MetricsRegistry()
+    a = r.histogram("serving/a")
+    b = r.histogram("serving/b")
+    for _ in range(7):
+        a.observe(3.25)
+    b.observe_n(3.25, 7)
+    assert a.summary() == b.summary()
+    assert a.buckets() == b.buckets()
+
+
+def test_labels_separate_children_unlabelled_unchanged():
+    r = MetricsRegistry()
+    assert r.counter("comm/bytes") is r.counter("comm/bytes")
+    c8 = r.counter("serving/chains", k=8)
+    c1 = r.counter("serving/chains", k=1)
+    assert c8 is not c1
+    assert c8 is r.counter("serving/chains", k=8)
+    c8.add(2)
+    c1.add(5)
+    snap = r.snapshot()
+    assert snap['serving/chains{k="8"}'] == 2
+    assert snap['serving/chains{k="1"}'] == 5
+    # unlabelled key format untouched
+    r.counter("comm/bytes").add(7)
+    assert r.snapshot()["comm/bytes"] == 7
+
+
+def test_json_snapshot_has_quantiles_and_labels(tmp_path):
+    r = MetricsRegistry()
+    r.histogram("serving/ttft_ms", k=2).observe(12.0)
+    path = exposition.export_json_snapshot(str(tmp_path / "m.json"), registry=r)
+    doc = json.load(open(path))
+    m = doc["metrics"]['serving/ttft_ms{k="2"}']
+    assert m["count"] == 1 and "p99" in m and m["p50"] == pytest.approx(12.0)
+
+
+# ------------------------------------------------------------- /metrics
+def test_metrics_http_server_serves_live_registry():
+    r = MetricsRegistry()
+    r.counter("serving/requests").add(1)
+    srv = exposition.serve_metrics(port=0, registry=r)
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(url + "/metrics").read().decode()
+        assert "dstpu_serving_requests_total 1.0" in body
+        r.counter("serving/requests").add(2)  # live: next scrape sees it
+        body = urllib.request.urlopen(url + "/metrics").read().decode()
+        assert "dstpu_serving_requests_total 3.0" in body
+        doc = json.loads(urllib.request.urlopen(url + "/metrics.json").read())
+        assert doc["metrics"]["serving/requests"] == 3.0
+        with pytest.raises(Exception):
+            urllib.request.urlopen(url + "/nope")
+    finally:
+        srv.stop()
+    assert srv.port is None
+
+
+def test_tracer_prometheus_path_export(tmp_path):
+    tr = Tracer(enabled=True)
+    tr.configure(enabled=True, prometheus_path=str(tmp_path / "m.prom"))
+    with tr.span("phase_a"):
+        pass
+    tr.maybe_export()
+    text = open(tmp_path / "m.prom").read()
+    assert "dstpu_span_phase_a" in text  # whole registry is scrapeable

@@ -1,0 +1,122 @@
+"""Tier-1 lint: registry metric names follow the ``subsystem/name`` convention.
+
+The telemetry registry is get-or-create by string, so a typo'd or
+unconventioned name silently creates a new metric family that no dashboard,
+exposition scrape, or doc catalogue knows about. Same pattern as
+``test_no_bare_shard_map.py``: grep the tree so the regression can't land
+quietly.
+
+Rules (docs/telemetry.md "label conventions"):
+  - every name passed to ``registry.counter/gauge/histogram``,
+    ``tracer.count`` or ``tracer.sample_counter`` is ``subsystem/name``
+  - the subsystem prefix is a literal (an f-string may interpolate only
+    after ``subsystem/``) and comes from the known set below
+  - name characters are ``[a-z0-9_/.:]`` (metric names are registry-side;
+    the Prometheus exposition handles identifier mapping)
+"""
+
+import os
+import re
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# one place to extend when a PR adds a legitimate new subsystem
+ALLOWED_SUBSYSTEMS = {
+    "anomaly",
+    "coll",
+    "comm",
+    "data",
+    "flops",
+    "health",
+    "mem",
+    "recompile",
+    "serving",
+    "span",
+}
+
+# .counter("x") / .gauge( / .histogram( / .sample_counter( are registry- or
+# tracer-specific method names; bare .count( is too generic (str.count), so
+# it is matched only on tracer-ish receivers.
+CALL_RE = re.compile(
+    r"\.(?:counter|gauge|histogram|sample_counter)\(\s*f?\"([^\"]+)\"")
+TRACER_COUNT_RE = re.compile(
+    r"\b(?:tracer|_tracer|tr)\.count\(\s*f?\"([^\"]+)\"")
+
+NAME_RE = re.compile(r"^[a-z0-9_]+/[a-z0-9_/.:{}]*$")
+
+SCAN_DIRS = ("deepspeed_tpu", "tools")
+SCAN_FILES = ("bench.py",)
+
+
+def _python_files():
+    for d in SCAN_DIRS:
+        for root, _dirs, files in os.walk(os.path.join(REPO_ROOT, d)):
+            if ".jax_cache" in root or "__pycache__" in root:
+                continue
+            for f in files:
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+    for f in SCAN_FILES:
+        p = os.path.join(REPO_ROOT, f)
+        if os.path.exists(p):
+            yield p
+
+
+def _check_name(name: str):
+    """Returns a violation string or None. ``name`` is the string literal as
+    written; f-string placeholders may only appear after ``subsystem/``."""
+    brace = name.find("{")
+    slash = name.find("/")
+    if slash < 0 or (0 <= brace < slash):
+        return f"no literal 'subsystem/' prefix in {name!r}"
+    subsystem = name[:slash]
+    if subsystem not in ALLOWED_SUBSYSTEMS:
+        return (f"unknown subsystem {subsystem!r} in {name!r} "
+                f"(extend ALLOWED_SUBSYSTEMS if intentional)")
+    if not NAME_RE.match(name):
+        return f"bad characters in metric name {name!r}"
+    return None
+
+
+def test_registry_metric_names_follow_convention():
+    offenders = []
+    for path in _python_files():
+        rel = os.path.relpath(path, REPO_ROOT)
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        for pat in (CALL_RE, TRACER_COUNT_RE):
+            for m in pat.finditer(src):
+                err = _check_name(m.group(1))
+                if err:
+                    line = src.count("\n", 0, m.start()) + 1
+                    offenders.append(f"{rel}:{line}: {err}")
+    assert not offenders, (
+        "registry metric names violating the subsystem/name convention "
+        "(docs/telemetry.md):\n  " + "\n  ".join(offenders))
+
+
+def test_lint_scans_telemetry_and_serving_sources():
+    """The files that mint most metric names must be inside the walk —
+    guards against a src-layout move silently dropping them."""
+    scanned = {os.path.relpath(p, REPO_ROOT) for p in _python_files()}
+    expected = {
+        os.path.join("deepspeed_tpu", "telemetry", f)
+        for f in ("tracer.py", "registry.py", "exposition.py")
+    } | {
+        os.path.join("deepspeed_tpu", "inference", f)
+        for f in ("engine_v2.py", "lifecycle.py")
+    } | {os.path.join("tools", "bench_serving.py")}
+    missing = expected - scanned
+    assert not missing, f"metric-minting files escaped the lint walk: {sorted(missing)}"
+
+
+def test_known_names_pass_and_bad_names_fail():
+    """The checker itself: real names from the tree pass, malformed fail."""
+    for good in ("serving/ttft_ms", "span/serve:dispatch", "comm/bytes",
+                 "mem/device_bytes_in_use", "anomaly/step_straggler"):
+        assert _check_name(good) is None, good
+    for bad in ("ttft", "Serving/ttft", "serving ttft", "{x}/y", "bogus/name"):
+        assert _check_name(bad) is not None, bad

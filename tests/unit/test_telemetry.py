@@ -36,11 +36,13 @@ def _clean_global_tracer():
     tr.configure(enabled=False)
     tr.trace_path = None
     tr.jsonl_path = None
+    tr.prometheus_path = None
     tr.reset()
     yield
     tr.configure(enabled=False)
     tr.trace_path = None
     tr.jsonl_path = None
+    tr.prometheus_path = None
     tr.reset()
 
 

@@ -19,7 +19,24 @@ No TPU required and nothing is materialized beyond a toy model — safe to run
 inside any relay window or on a laptop. Results feed PERF.md's "serving
 overhead" section.
 
+Two extra modes (ISSUE 5, serving SLO observability):
+
+  4. telemetry overhead guard   — the host-path benchmark re-runs with the
+                                  tracer ENABLED; per-request lifecycle
+                                  tracking + spans must cost < 5% host
+                                  µs/decoded-token vs disabled
+  5. --slo                      — open-loop synthetic arrival pattern
+                                  (Poisson at --rate req/s) through the real
+                                  engine with telemetry on: emits the
+                                  TTFT/TPOT/queue-wait p50/p95/p99 +
+                                  goodput table, and writes the Prometheus
+                                  text exposition, the JSON metrics
+                                  snapshot, and a Perfetto trace with
+                                  per-request tracks + flow events
+
 Usage: python tools/bench_serving.py [--rows 8] [--tokens 64] [--chain 8]
+                                     [--slo] [--rate 40] [--requests 24]
+                                     [--slo-ttft-ms 500] [--slo-tpot-ms 50]
                                      [--output serving.json]
 """
 
@@ -27,10 +44,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 from typing import Dict, List, Sequence
 
 import numpy as np
+
+# run_autotune.py idiom: `python tools/bench_serving.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 # --------------------------------------------------------------------------
@@ -258,13 +280,47 @@ def bench_host_path(rows=8, n_new=64, chain=8, prompt_len=32) -> Dict:
 
     before = run(1)
     after = run(chain)
-    return {
+
+    # --- telemetry overhead guard: same chained run with the tracer ON
+    # (spans + per-request lifecycle tracking + SLO histograms). The
+    # acceptance bound (ISSUE 5) is < 5% host µs/decoded-token vs the
+    # committed PR-4 number (SERVING_r06.json, telemetry off); the same-run
+    # enabled-vs-disabled delta is reported alongside since absolute numbers
+    # drift with the machine.
+    from deepspeed_tpu.telemetry import get_tracer
+
+    R06_HOST_US = 9.38  # SERVING_r06.json host_path.chained, rows=8 k=8
+
+    tr = get_tracer()
+    was_enabled = tr.enabled
+    tr.configure(enabled=True)
+    try:
+        with_telemetry = run(chain)
+    finally:
+        tr.configure(enabled=was_enabled)
+        if not was_enabled:
+            # leave no residue in a previously-disabled tracer; an already-
+            # enabled one (bench.py under DSTPU_TELEMETRY=1) keeps its data
+            tr.reset()
+    overhead_pct = round(
+        (with_telemetry["host_us_per_decode_token"]
+         - after["host_us_per_decode_token"])
+        / max(after["host_us_per_decode_token"], 1e-9) * 100, 2)
+
+    out = {
         "rows": rows, "new_tokens": n_new,
         "per_token_loop": before, "chained": after,
+        "chained_telemetry_on": with_telemetry,
+        "telemetry_overhead_pct_same_run": overhead_pct,
         "host_us_speedup": round(
             before["host_us_per_decode_token"]
             / max(after["host_us_per_decode_token"], 1e-9), 2),
     }
+    if rows == 8 and chain == 8:  # the committed-reference shape
+        out["telemetry_vs_r06_pct"] = round(
+            (with_telemetry["host_us_per_decode_token"] - R06_HOST_US)
+            / R06_HOST_US * 100, 2)
+    return out
 
 
 def bench_end_to_end(rows=8, n_new=64, chain=8, prompt_len=32) -> Dict:
@@ -296,11 +352,126 @@ def bench_end_to_end(rows=8, n_new=64, chain=8, prompt_len=32) -> Dict:
             "per_token_loop": run(1), "chained": run(chain)}
 
 
+def bench_slo(n_requests=24, rate=40.0, n_new=32, chain=8, prompt_len=24,
+              ttft_ms=500.0, tpot_ms=50.0, seed=0, out_dir=None) -> Dict:
+    """Open-loop SLO run: Poisson arrivals at ``rate`` req/s through the real
+    engine with telemetry enabled. Emits the per-request percentile table
+    (TTFT / TPOT / queue wait p50/p95/p99 + goodput) and writes the three
+    exposition artifacts: Prometheus text, JSON snapshot, Perfetto trace
+    (per-request tracks + admission->dispatch flow events)."""
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.inference import InferenceEngineV2
+    from deepspeed_tpu.telemetry import get_tracer
+
+    cfg, params = _tiny_model()
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, cfg.vocab_size, (prompt_len,))
+               for _ in range(n_requests)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests)).tolist()
+
+    tr = get_tracer()
+    was_enabled = tr.enabled
+    if was_enabled:
+        # the SLO table needs a clean registry, so the resets below are
+        # unavoidable — warn rather than silently eating accumulated data
+        print("bench_slo: tracer already enabled; its accumulated "
+              "events/metrics will be reset for the SLO measurement",
+              file=sys.stderr)
+    tr.configure(enabled=True)
+    tr.reset()
+    try:
+        eng = InferenceEngineV2(cfg, params, {
+            "dtype": "fp32", "kv_block_size": 16, "num_kv_blocks": 1024,
+            "max_seqs": min(n_requests, 16), "decode_chain": chain,
+            "hbm_check": "off",
+            "serving_slo": {"ttft_ms": ttft_ms, "tpot_ms": tpot_ms}})
+        # compile the prefill + chain programs outside the measured window
+        eng.generate(prompts[:2], max_new_tokens=chain + 1)
+        for u in list(eng.state._seqs):
+            eng.flush(u)
+        tr.reset()
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=n_new,
+                            arrival_times=arrivals)
+        wall = time.perf_counter() - t0
+
+        reg = tr.registry
+        table: Dict[str, Dict] = {}
+        for base in ("serving/ttft_ms", "serving/tpot_ms",
+                     "serving/queue_wait_ms", "serving/e2e_ms"):
+            for kind, name, metric in reg.iter_metrics():
+                if kind == "histogram" and name == base:
+                    table[base.split("/")[1]] = {
+                        "count": metric.count,
+                        "p50": round(metric.quantile(0.50), 3),
+                        "p95": round(metric.quantile(0.95), 3),
+                        "p99": round(metric.quantile(0.99), 3),
+                        "mean": round(metric.summary()["mean"], 3),
+                    }
+        counters = reg.counters()
+        met = sum(v for k, v in counters.items() if k.startswith("serving/slo_met"))
+        missed = sum(v for k, v in counters.items()
+                     if k.startswith("serving/slo_missed"))
+        goodput = met / max(met + missed, 1)
+
+        out_dir = out_dir or telemetry.default_output_dir()
+        prom_path = telemetry.export_prometheus(
+            os.path.join(out_dir, "serving_metrics.prom"))
+        snap_path = telemetry.export_json_snapshot(
+            os.path.join(out_dir, "serving_metrics.json"))
+        trace_path = telemetry.export_chrome_trace(
+            os.path.join(out_dir, "serving_trace.json"))
+
+        # exposition sanity: quantiles + goodput present in both formats,
+        # per-request tracks + flow events present in the trace
+        prom_text = open(prom_path).read()
+        assert "dstpu_serving_ttft_ms_p50" in prom_text
+        assert "dstpu_serving_goodput" in prom_text
+        snap = json.load(open(snap_path))["metrics"]
+        assert any(k.startswith("serving/ttft_ms") and "p99" in v
+                   for k, v in snap.items() if isinstance(v, dict))
+        doc = json.load(open(trace_path))
+        n_tracks = sum(1 for e in doc["traceEvents"]
+                       if e.get("ph") == "M" and e["name"] == "thread_name"
+                       and str(e["args"]["name"]).startswith("req "))
+        n_flows = sum(1 for e in doc["traceEvents"] if e.get("ph") in ("s", "t", "f"))
+        assert n_tracks == n_requests and n_flows >= 3 * n_requests
+
+        total_tokens = sum(len(o) for o in outs)
+        return {
+            "requests": n_requests, "rate_req_s": rate, "new_tokens": n_new,
+            "decode_chain": chain,
+            "slo": {"ttft_ms": ttft_ms, "tpot_ms": tpot_ms},
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(total_tokens / wall, 1),
+            "percentiles_ms": table,
+            "goodput": round(goodput, 4),
+            "slo_met": int(met), "slo_missed": int(missed),
+            "preemptions": int(counters.get("serving/preemptions", 0)),
+            "trace": {"request_tracks": n_tracks, "flow_events": n_flows},
+            "artifacts": {"prometheus": prom_path, "snapshot": snap_path,
+                          "perfetto": trace_path},
+        }
+    finally:
+        tr.configure(enabled=was_enabled)
+        if not was_enabled:
+            tr.reset()  # leave a previously-disabled tracer empty
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rows", type=int, default=8)
     ap.add_argument("--tokens", type=int, default=64)
     ap.add_argument("--chain", type=int, default=8)
+    ap.add_argument("--slo", action="store_true",
+                    help="run the open-loop SLO mode (TTFT/TPOT/queue-wait "
+                         "percentiles + goodput + exposition artifacts)")
+    ap.add_argument("--rate", type=float, default=40.0,
+                    help="--slo arrival rate, requests/s (Poisson)")
+    ap.add_argument("--requests", type=int, default=24,
+                    help="--slo number of synthetic requests")
+    ap.add_argument("--slo-ttft-ms", type=float, default=500.0)
+    ap.add_argument("--slo-tpot-ms", type=float, default=50.0)
     ap.add_argument("--output", type=str, default=None)
     args = ap.parse_args()
 
@@ -312,6 +483,11 @@ def main() -> None:
         "end_to_end": bench_end_to_end(rows=args.rows, n_new=args.tokens,
                                        chain=args.chain),
     }
+    if args.slo:
+        out["slo"] = bench_slo(n_requests=args.requests, rate=args.rate,
+                               n_new=args.tokens, chain=args.chain,
+                               ttft_ms=args.slo_ttft_ms,
+                               tpot_ms=args.slo_tpot_ms)
     text = json.dumps(out, indent=2)
     print(text)
     if args.output:

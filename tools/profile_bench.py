@@ -1,25 +1,39 @@
-"""Profile the bench train step on the real chip: where does the time go?
+#!/usr/bin/env python
+"""Profile the bench train step on the real chip — all four stages in one
+parameterized tool (formerly profile_bench.py + profile_bench{2,3,4}.py).
 
-Breakdown measured:
-  1. pure jitted step latency (device program, steady-state, async dispatch)
-  2. engine.train_batch latency (adds batch placement + metrics sync)
-  3. XLA cost analysis flops of the compiled step vs model flops estimate
-  4. dispatch-only latency (tiny no-op jit) to bound per-call RPC overhead
+  --stage 1   step/engine/dispatch breakdown + XLA cost analysis: pure jitted
+              step latency, chained x10 amortized dispatch, engine.train_batch,
+              batch placement, no-op dispatch floor, flops + MFU
+  --stage 2   block_until_ready honesty + true device times: chained
+              dispatch/block/fetch split, fwd-only, fwd+bwd, 8k matmul rate
+  --stage 3   step decomposition: in-program matmul rate (50x fori_loop),
+              fwd and fwd+bwd at micro 8/32, optimizer-only update, lm-head
+              matmul
+  --stage 4   per-shape matmul sweep, flash-vs-xla attention fwd/bwd, and a
+              jax.profiler trace attempt
+  --stage all run every stage in order
+
+Usage: python tools/profile_bench.py [--stage 1|2|3|4|all]
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import sys
 import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-
-import deepspeed_tpu
-from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
 
 
+# --------------------------------------------------------------- shared bits
 def timeit(fn, n=10, warmup=3, block=lambda r: jax.block_until_ready(r)):
     for _ in range(warmup):
         r = fn()
@@ -31,47 +45,80 @@ def timeit(fn, n=10, warmup=3, block=lambda r: jax.block_until_ready(r)):
     return (time.perf_counter() - t0) / n
 
 
-def main():
+def fetch_time(fn, out_leaf=lambda r: r, n=5, warmup=2):
+    """Time dispatch->device->host-fetch of one output leaf (the honest
+    per-call latency on an async-dispatch runtime)."""
+    for _ in range(warmup):
+        r = fn()
+    _ = np.asarray(out_leaf(r))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn()
+    _ = np.asarray(out_leaf(r))
+    return (time.perf_counter() - t0) / n
+
+
+def _gpt2_cfg():
+    from deepspeed_tpu.models import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=50304, hidden_size=768, intermediate_size=3072,
+        num_layers=12, num_heads=12, max_seq_len=1024,
+        norm="layernorm", activation="gelu", position="learned",
+        tie_embeddings=True, dtype=jnp.bfloat16,
+    )
+
+
+def _engine(cfg, micro, seq, stage3=False):
+    import deepspeed_tpu
+    from deepspeed_tpu.models import causal_lm_spec
+
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}
+                      if stage3 else {"lr": 1e-4, "weight_decay": 0.1}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "steps_per_print": 10_000,
+    }
+    if not stage3:
+        config["gradient_clipping"] = 1.0
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq), config=config)
+    return engine
+
+
+# ------------------------------------------------------------------ stage 1
+def stage1():
+    """Where does the time go: step vs engine vs dispatch floor + MFU."""
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     print(f"backend={backend}")
 
     if on_tpu:
-        cfg = TransformerConfig(
-            vocab_size=50304, hidden_size=768, intermediate_size=3072,
-            num_layers=12, num_heads=12, max_seq_len=1024,
-            norm="layernorm", activation="gelu", position="learned",
-            tie_embeddings=True, dtype=jnp.bfloat16,
-        )
+        cfg = _gpt2_cfg()
         micro, seq = 8, 1024
         peak_flops = 197e12
     else:
+        from deepspeed_tpu.models import TransformerConfig
+
         cfg = TransformerConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
                                 num_layers=2, num_heads=4, max_seq_len=256)
         micro, seq = 2, 128
         peak_flops = 1e12
 
-    config = {
-        "train_micro_batch_size_per_gpu": micro,
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4, "weight_decay": 0.1}},
-        "zero_optimization": {"stage": 1},
-        "bf16": {"enabled": True},
-        "gradient_clipping": 1.0,
-        "steps_per_print": 10_000,
-    }
-    engine, *_ = deepspeed_tpu.initialize(model=causal_lm_spec(cfg, example_seq_len=seq), config=config)
-
+    engine = _engine(cfg, micro, seq)
     rng = np.random.default_rng(0)
     batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
 
-    # 4. dispatch floor: trivial jit call round-trip
+    # dispatch floor: trivial jit call round-trip
     f_nop = jax.jit(lambda x: x + 1)
     x = jnp.zeros((8,), jnp.float32)
     t_nop_async = timeit(lambda: f_nop(x), n=50, warmup=5, block=lambda r: None)
     t_nop_sync = timeit(lambda: jax.block_until_ready(f_nop(x)), n=50, warmup=5)
     print(f"dispatch nop: async={t_nop_async*1e3:.2f} ms, sync-roundtrip={t_nop_sync*1e3:.2f} ms")
 
-    # 1. pure jitted step
+    # pure jitted step
     placed = engine._shard_global_batch(batch)
     state = engine.state
     step_fn = engine._train_step
@@ -85,27 +132,27 @@ def main():
     print(f"pure jitted step: {t_pure*1e3:.1f} ms")
     engine.state = state
 
-    # 1b. pure step without re-placing batch, async chain of 10 then block
+    # pure step, async chain of 10 then block (amortized dispatch)
     def chain10():
         nonlocal state
         for _ in range(10):
             state, m = step_fn(state, placed)
         return m["loss"]
+
     t_chain = timeit(chain10, n=3, warmup=1) / 10
     engine.state = state
     print(f"chained x10 step (amortized dispatch): {t_chain*1e3:.1f} ms")
 
-    # 2. engine.train_batch (includes _shard_global_batch + metrics np.asarray sync)
+    # engine.train_batch (adds _shard_global_batch + metrics np.asarray sync)
     t_engine = timeit(lambda: engine.train_batch(batch)["loss"], n=10, warmup=3,
                       block=lambda r: None)
     print(f"engine.train_batch: {t_engine*1e3:.1f} ms")
 
-    # batch placement cost alone
     t_place = timeit(lambda: engine._shard_global_batch(batch), n=10, warmup=3,
                      block=lambda r: jax.block_until_ready(r))
     print(f"batch placement: {t_place*1e3:.1f} ms")
 
-    # 3. cost analysis
+    # XLA cost analysis vs model flops
     lowered = step_fn.lower(engine.state, placed)
     compiled = lowered.compile()
     ca = compiled.cost_analysis()
@@ -116,15 +163,226 @@ def main():
     print(f"xla flops/step: {xla_flops:.3e}; model flops/step (6ND-style): {model_flops:.3e}")
 
     best = min(t_pure, t_chain)
-    mfu_pure = model_flops / best / peak_flops
-    mfu_engine = model_flops / t_engine / peak_flops
     print(json.dumps({
         "t_pure_ms": t_pure * 1e3, "t_chain_ms": t_chain * 1e3,
         "t_engine_ms": t_engine * 1e3, "t_place_ms": t_place * 1e3,
         "nop_async_ms": t_nop_async * 1e3, "nop_sync_ms": t_nop_sync * 1e3,
-        "mfu_pure": mfu_pure, "mfu_engine": mfu_engine,
+        "mfu_pure": model_flops / best / peak_flops,
+        "mfu_engine": model_flops / t_engine / peak_flops,
         "xla_flops": xla_flops, "model_flops": model_flops,
     }))
+
+
+# ------------------------------------------------------------------ stage 2
+def stage2():
+    """Is block_until_ready honest, and what is the true device time?"""
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.topology.mesh import set_mesh
+
+    cfg = _gpt2_cfg()
+    micro, seq = 8, 1024
+    engine = _engine(cfg, micro, seq)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    placed = engine._shard_global_batch(batch)
+    state = engine.state
+    step_fn = engine._train_step
+
+    for _ in range(2):
+        state, m = step_fn(state, placed)
+    _ = np.asarray(m["loss"])
+
+    # A: chain 5 steps; dispatch vs block vs fetch
+    t0 = time.perf_counter()
+    for _ in range(5):
+        state, m = step_fn(state, placed)
+    t_dispatch = time.perf_counter() - t0
+    jax.block_until_ready(m["loss"])
+    t_block = time.perf_counter() - t0
+    _ = np.asarray(m["loss"])
+    t_fetch = time.perf_counter() - t0
+    print(f"5 steps: dispatch={t_dispatch*1e3:.1f}ms block={t_block*1e3:.1f}ms fetch={t_fetch*1e3:.1f}ms")
+    print(f"=> true per-step: {t_fetch*1e3/5:.1f} ms")
+
+    # B: forward-only loss
+    module = CausalLM(cfg)
+    set_mesh(engine.mesh)
+    params16 = jax.jit(lambda p: jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        p))(state.params)
+    micro_b = {"input_ids": jnp.asarray(batch["input_ids"])}
+
+    @jax.jit
+    def fwd(p, b):
+        loss, _ = module.apply({"params": p}, b, train=False)
+        return loss
+
+    t_fwd = fetch_time(lambda: fwd(params16, micro_b))
+    print(f"fwd-only: {t_fwd*1e3:.1f} ms")
+
+    # C: fwd+bwd grads only (no optimizer)
+    @jax.jit
+    def fwdbwd(p, b):
+        def loss_fn(pp):
+            loss, _ = module.apply({"params": pp}, b, train=False)
+            return loss
+        return jax.value_and_grad(loss_fn)(p)[0]
+
+    t_fb = fetch_time(lambda: fwdbwd(params16, micro_b))
+    print(f"fwd+bwd: {t_fb*1e3:.1f} ms")
+
+    # D: big matmul sanity
+    a = jnp.zeros((8192, 8192), jnp.bfloat16)
+    b = jnp.zeros((8192, 8192), jnp.bfloat16)
+    mm = jax.jit(lambda a, b: a @ b)
+    t_mm = fetch_time(lambda: mm(a, b), lambda r: r[0, 0], n=10)
+    fl = 2 * 8192**3
+    print(f"8k matmul: {t_mm*1e3:.2f} ms => {fl/t_mm/1e12:.1f} TFLOP/s")
+
+
+# ------------------------------------------------------------------ stage 3
+def stage3():
+    """Decompose the step: honest fwd+bwd, optimizer-only, in-program rate."""
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.topology.mesh import set_mesh
+
+    cfg = _gpt2_cfg()
+    seq = 1024
+    module = CausalLM(cfg)
+    engine = _engine(cfg, 8, seq, stage3=True)
+    set_mesh(engine.mesh)
+    state = engine.state
+    params16 = jax.jit(lambda p: jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        p))(state.params)
+    rng = np.random.default_rng(0)
+
+    # true device matmul rate: 50 matmuls inside one program
+    a = jnp.zeros((8192, 8192), jnp.bfloat16)
+
+    @jax.jit
+    def mm50(a):
+        def body(i, acc):
+            return acc + a @ a * (1.0 / (i + 1))
+        return jax.lax.fori_loop(0, 50, body, jnp.zeros_like(a))[0, 0]
+
+    t = fetch_time(lambda: mm50(a), n=2, warmup=1)
+    print(f"50x 8k matmul in-program: {t*1e3:.1f} ms => {50*2*8192**3/t/1e12:.1f} TFLOP/s")
+
+    for micro in (8, 32):
+        b = {"input_ids": jnp.asarray(rng.integers(0, cfg.vocab_size, (micro, seq), dtype=np.int32))}
+
+        @jax.jit
+        def fwd(p, b):
+            loss, _ = module.apply({"params": p}, b, train=False)
+            return loss
+
+        @jax.jit
+        def fwdbwd(p, b):
+            def loss_fn(pp):
+                loss, _ = module.apply({"params": pp}, b, train=False)
+                return loss
+            return jax.value_and_grad(loss_fn)(p)
+
+        t_f = fetch_time(lambda: fwd(params16, b))
+        t_fb = fetch_time(
+            lambda: fwdbwd(params16, b),
+            lambda r: r[1]["lm_head"]["embedding"] if "lm_head" in r[1]
+            else jax.tree_util.tree_leaves(r[1])[0])
+        fwd_fl = 2 * 124e6 * micro * seq  # 2*N*T matmul flops approx (fwd)
+        print(f"micro={micro}: fwd={t_f*1e3:.1f}ms ({fwd_fl/t_f/1e12:.1f} TF/s) "
+              f"fwd+bwd={t_fb*1e3:.1f}ms ({3*fwd_fl/t_fb/1e12:.1f} TF/s)")
+
+    # optimizer-only update (adamw on fp32 master)
+    tx = engine.tx
+    grads = jax.tree_util.tree_map(lambda x: jnp.ones(x.shape, jnp.float32), state.params)
+
+    @jax.jit
+    def opt_only(params, opt_state, grads):
+        import optax
+
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt
+
+    t_o = fetch_time(lambda: opt_only(state.params, state.opt_state, grads),
+                     lambda r: jax.tree_util.tree_leaves(r[0])[0])
+    print(f"optimizer-only: {t_o*1e3:.1f} ms")
+
+    # lm-head matmul microbench (vocab is the big matmul)
+    emb = jnp.zeros((50304, 768), jnp.bfloat16)
+    h = jnp.zeros((8 * 1024, 768), jnp.bfloat16)
+    head = jax.jit(lambda h, emb: (h @ emb.T)[0, 0])
+    t_h = fetch_time(lambda: head(h, emb))
+    print(f"lm head matmul (8k x 768 x 50k): {t_h*1e3:.2f} ms => {2*8192*768*50304/t_h/1e12:.1f} TF/s")
+
+
+# ------------------------------------------------------------------ stage 4
+def stage4():
+    """Per-shape matmul sweep, flash-vs-xla attention, profiler trace."""
+    def mm_rate(M, K, N, dtype=jnp.bfloat16, n=10):
+        a = jnp.zeros((M, K), dtype)
+        b = jnp.zeros((K, N), dtype)
+        f = jax.jit(lambda a, b: (a @ b).sum())
+        t = fetch_time(lambda: f(a, b), n=n, warmup=3)
+        return t, 2 * M * K * N / t / 1e12
+
+    print("matmul shape sweep (bf16):")
+    for (M, K, N) in [(8192, 768, 768), (8192, 768, 3072), (8192, 3072, 768),
+                      (8192, 768, 50304), (32768, 768, 3072), (8192, 8192, 8192)]:
+        t, r = mm_rate(M, K, N)
+        print(f"  [{M},{K}]x[{K},{N}]: {t*1e3:.2f} ms {r:.1f} TF/s")
+
+    # attention: flash vs xla, fwd + bwd
+    from deepspeed_tpu.ops.registry import dispatch
+    B, S, H, D = 8, 1024, 12, 64
+    q = jnp.zeros((B, S, H, D), jnp.bfloat16)
+    k = jnp.zeros((B, S, H, D), jnp.bfloat16)
+    v = jnp.zeros((B, S, H, D), jnp.bfloat16)
+    att_fl = 4 * B * H * S * S * D
+    for impl in ("pallas", "xla"):
+        try:
+            fn = jax.jit(lambda q, k, v, f=dispatch("causal_attention", impl): f(q, k, v, mask=None).sum())
+            t = fetch_time(lambda: fn(q, k, v), n=10, warmup=3)
+            print(f"attention {impl}: {t*1e3:.2f} ms ({att_fl/t/1e12:.1f} TF/s)")
+        except Exception as e:
+            print(f"attention {impl}: FAILED {type(e).__name__} {e}")
+    for impl in ("pallas", "xla"):
+        try:
+            f = dispatch("causal_attention", impl)
+            fn = jax.jit(lambda q, k, v: jax.grad(
+                lambda qq: f(qq, k, v, mask=None).astype(jnp.float32).sum())(q).sum())
+            t = fetch_time(lambda: fn(q, k, v), n=10, warmup=3)
+            print(f"attention-bwd {impl}: {t*1e3:.2f} ms")
+        except Exception as e:
+            print(f"attention-bwd {impl}: FAILED {type(e).__name__} {e}")
+
+    # profiler trace attempt
+    try:
+        a = jnp.zeros((4096, 4096), jnp.bfloat16)
+        f = jax.jit(lambda a: a @ a)
+        with jax.profiler.trace("/tmp/jaxtrace"):
+            r = f(a)
+            np.asarray(r[0, 0])
+        import glob
+        files = glob.glob("/tmp/jaxtrace/**/*", recursive=True)
+        print(f"profiler trace files: {len(files)}")
+        for p in files[:8]:
+            print("  ", p, os.path.getsize(p) if os.path.isfile(p) else "dir")
+    except Exception as e:
+        print(f"profiler trace FAILED: {type(e).__name__} {e}")
+
+
+STAGES = {"1": stage1, "2": stage2, "3": stage3, "4": stage4}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--stage", choices=[*STAGES, "all"], default="1")
+    args = ap.parse_args()
+    for name in STAGES if args.stage == "all" else [args.stage]:
+        if args.stage == "all":
+            print(f"\n===== stage {name} =====")
+        STAGES[name]()
 
 
 if __name__ == "__main__":
