@@ -13,6 +13,12 @@ from deepspeed_tpu.checkpoint.engine import (
     OrbaxCheckpointEngine,
     get_checkpoint_engine,
 )
+from deepspeed_tpu.checkpoint.snapshot import (
+    SnapshotCorruptionError,
+    SnapshotError,
+    SnapshotManager,
+    restore_snapshot,
+)
 from deepspeed_tpu.checkpoint.universal import (
     convert_to_fp32_file,
     get_fp32_state_dict_from_checkpoint,

@@ -16,6 +16,7 @@ import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
@@ -23,6 +24,7 @@ from deepspeed_tpu import telemetry
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 LATEST_FILE = "latest"
+HOST_SIDECAR_SUFFIX = ".host.npz"
 
 
 def _tag(step: int) -> str:
@@ -40,6 +42,43 @@ def _canonical_opt_state(engine, opt_state):
 def _departition_opt_state(engine, opt_state):
     canon = getattr(engine, "opt_state_from_canonical", None)
     return canon(opt_state) if canon is not None else opt_state
+
+
+def _sidecar_path(save_dir: str, tag: str) -> str:
+    return os.path.join(save_dir, f"{tag}{HOST_SIDECAR_SUFFIX}")
+
+
+def _write_sidecar(save_dir: str, tag: str, payload) -> str:
+    """Write the fresh-restore payload: one .npz of host numpy atoms keyed by
+    pytree path, next to the orbax checkpoint dir.
+
+    This is what ``restore='fresh'`` reads — with plain numpy, no orbax — so
+    a training process never runs tensorstore restore machinery in-process
+    (see :func:`_restore_placement` for why that matters). 16-bit floats are
+    widened to fp32 (np.savez stores ml_dtypes as raw void, losing the
+    dtype); the restore casts back to the live leaf's dtype, value-exact.
+
+    Cost: this consolidates the full logical state on ONE host (process 0)
+    and writes synchronously — the price of the landmine-safe restore.
+    ``checkpoint: {"sidecar": false}`` skips it for models too large to
+    consolidate; their restores must then use ``restore='streamed'`` (or
+    eat the in-process orbax host-read fallback). The async-off-the-step-
+    clock save path is the elastic snapshot layer (docs/elastic.md), not
+    this one.
+    """
+    from deepspeed_tpu.checkpoint.universal import _flatten
+
+    atoms = {}
+    for key, leaf in _flatten(payload).items():
+        if leaf is None:
+            continue
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype in (np.dtype(jnp.bfloat16), np.float16):
+            arr = arr.astype(np.float32)
+        atoms[key] = arr
+    from deepspeed_tpu.checkpoint.universal import write_npz_atomic
+
+    return write_npz_atomic(_sidecar_path(save_dir, tag), atoms)
 
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
@@ -84,6 +123,18 @@ def _save_checkpoint(engine, save_dir, tag, client_state, save_latest,
     checkpoint_engine.save(payload, path)
     # async engines: the write continues in the background; durability is
     # guaranteed at the next load()/commit() barrier (Nebula tier semantics)
+    cfg = getattr(getattr(engine, "config", None), "model", None)
+    if cfg is None or cfg.checkpoint.get("sidecar", True):
+        if jax.process_count() > 1:
+            # device_get cannot consolidate shards living on OTHER hosts —
+            # multi-process saves keep the orbax payload only and restore
+            # via the streamed path (ROADMAP: multi-host sharded writes)
+            log_dist("checkpoint sidecar skipped: multi-process run cannot "
+                     "consolidate cross-host shards; use restore='streamed'",
+                     ranks=[0])
+        else:
+            # the orbax-free fresh-restore payload (one host copy; docstring)
+            _write_sidecar(save_dir, tag, payload)
 
     meta = {
         "client_state": client_state or {},
@@ -122,6 +173,95 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                                 checkpoint_engine)
 
 
+def _restore_placement(engine) -> str:
+    """'fresh' (default) or 'streamed' — how restored leaves reach the device.
+
+    ``fresh``: the restore reads the numpy sidecar payload
+    (``<tag>.host.npz``) with plain numpy — no orbax/tensorstore runs in the
+    restoring process — and every leaf is placed through
+    ``utils.compat.device_put_unaliased`` into a buffer XLA owns EXCLUSIVELY.
+    That unaliased placement is the actual fix for the PR-1 landmine, whose
+    mechanism the PR-6 fault-injection work isolated: ``jax.device_put`` of
+    64-byte-aligned host numpy is ZERO-COPY on the CPU backend, so a
+    restored leaf aliases numpy-owned memory; the engine's compiled steps
+    then DONATE that buffer, XLA reuses memory it does not exclusively own,
+    and the glibc heap corrupts ("corrupted double-linked list" /
+    segfaults, detected nondeterministically a few steps later — the
+    nondeterminism is malloc alignment luck per array).
+
+    ``streamed`` keeps the direct-to-device tensorstore restore (each host
+    reads only its slices — scales with the local shard size, but orbax
+    materializes the buffers itself, outside the unaliased fence); opt in
+    via ``checkpoint: {"restore": "streamed"}`` only for engines that never
+    step after restoring (export/eval)."""
+    mode = "fresh"
+    cfg = getattr(getattr(engine, "config", None), "model", None)
+    if cfg is not None:
+        mode = cfg.checkpoint.get("restore", "fresh")
+    if mode not in ("fresh", "streamed"):
+        raise ValueError(f"checkpoint.restore={mode!r}: must be 'fresh' or 'streamed'")
+    if mode == "streamed":
+        logger.warning(
+            "checkpoint.restore='streamed': orbax materializes the restored "
+            "device arrays itself, outside the unaliased-placement fence — "
+            "do not step this engine afterwards (donated steps over "
+            "host-aliased buffers corrupt the heap; see "
+            "utils.compat.device_put_unaliased)")
+    return mode
+
+
+def _place_fresh(host_leaf, live_leaf):
+    """One restored host atom -> a freshly allocated committed device buffer
+    with the live leaf's sharding. Placement goes through
+    ``device_put_unaliased``: a plain device_put of aligned host numpy is
+    ZERO-COPY on CPU, and the engine's donated steps then reuse memory
+    numpy still owns — the actual mechanism behind the PR-1 heap-corruption
+    landmine."""
+    if live_leaf is None or host_leaf is None:
+        return host_leaf
+    if isinstance(live_leaf, jax.Array):
+        from deepspeed_tpu.utils.compat import device_put_unaliased
+
+        arr = np.asarray(host_leaf)
+        if arr.dtype != live_leaf.dtype:
+            arr = arr.astype(live_leaf.dtype)
+        return device_put_unaliased(arr, live_leaf.sharding)
+    return host_leaf
+
+
+def _load_fresh(checkpoint_engine, load_dir, tag, path, target):
+    """Fresh-placement restore: numpy sidecar when present (orbax-free — the
+    landmine-safe path), else the in-process orbax host-read with a loud
+    warning (pre-sidecar checkpoints only; re-saving upgrades them)."""
+    from deepspeed_tpu.checkpoint.universal import _flatten
+
+    sidecar = _sidecar_path(load_dir, tag)
+    if os.path.exists(sidecar):
+        data = np.load(sidecar, allow_pickle=False)
+        flat_target = _flatten(target)
+        missing = [k for k, v in flat_target.items()
+                   if v is not None and k not in data.files]
+        if not missing:
+            def place(path_keys, leaf):
+                return _place_fresh(data[jax.tree_util.keystr(path_keys)], leaf)
+
+            return jax.tree_util.tree_map_with_path(place, target)
+        logger.warning(
+            f"checkpoint sidecar {sidecar} does not match the engine state "
+            f"tree (missing {missing[:3]}…) — falling back to the in-process "
+            f"orbax host restore")
+    else:
+        logger.warning(
+            f"checkpoint {path} has no {HOST_SIDECAR_SUFFIX} sidecar "
+            "(pre-PR-6 format): restoring via in-process orbax host-read; "
+            "re-save to upgrade to the orbax-free restore payload")
+    host_target = jax.tree_util.tree_map(lambda _x: 0, target)
+    host_args = jax.tree_util.tree_map(lambda _x: ocp.RestoreArgs(), target)
+    restored_host = checkpoint_engine.load(path, target=host_target,
+                                           restore_args=host_args)
+    return jax.tree_util.tree_map(_place_fresh, restored_host, target)
+
+
 def _load_checkpoint(engine, load_dir, tag, load_optimizer_states,
                      checkpoint_engine) -> Tuple[Optional[str], Dict]:
     if checkpoint_engine is None:
@@ -153,11 +293,14 @@ def _load_checkpoint(engine, load_dir, tag, load_optimizer_states,
         "loss_scale": state.loss_scale._asdict(),
         "rng": state.rng,
     }
-    restore_args = jax.tree_util.tree_map(
-        lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding) if isinstance(x, jax.Array) else ocp.RestoreArgs(),
-        target,
-    )
-    restored = checkpoint_engine.load(path, target=target, restore_args=restore_args)
+    if _restore_placement(engine) == "fresh":
+        restored = _load_fresh(checkpoint_engine, load_dir, tag, path, target)
+    else:
+        restore_args = jax.tree_util.tree_map(
+            lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding) if isinstance(x, jax.Array) else ocp.RestoreArgs(),
+            target,
+        )
+        restored = checkpoint_engine.load(path, target=target, restore_args=restore_args)
 
     from deepspeed_tpu.runtime.engine import TrainState
     from deepspeed_tpu.runtime.precision import LossScaleState

@@ -313,6 +313,41 @@ class DiagnosticsConfig(DeepSpeedConfigModel):
     flight_recorder: FlightRecorderConfig = Field(default_factory=FlightRecorderConfig)
 
 
+class SnapshotConfig(DeepSpeedConfigModel):
+    """snapshot section — elastic async sharded snapshots
+    (``checkpoint/snapshot.py``). At every ``every_n_steps`` step boundary the
+    engine copies the canonical fp32 train state device→host (the one
+    synchronous cost) and a background thread serializes, checksums, fsyncs
+    and atomically commits it under ``<dir>/snapshots/<tag>`` with a
+    ``latest`` pointer updated only after durability — the step clock never
+    blocks on disk, and a crash mid-save can never publish a torn snapshot.
+    Snapshots restore onto ANY mesh (``engine.restore_snapshot`` /
+    ``elasticity.run_resilient``). See ``docs/elastic.md``."""
+
+    enabled: bool = False
+    dir: Optional[str] = None  # snapshot base directory (required when enabled)
+    every_n_steps: int = 100  # snapshot cadence in optimizer-step boundaries
+    keep: int = 2  # committed snapshots retained (older ones pruned)
+    shard_megabytes: int = 64  # per-shard-file ceiling (atoms sliced on dim 0)
+    fsync: bool = True  # fsync shards+manifest before commit (durability)
+    blocking: bool = False  # debug: write synchronously at the boundary
+
+
+class RecoveryConfig(DeepSpeedConfigModel):
+    """recovery section — the auto-recovery policy ``elasticity.run_resilient``
+    applies when diagnostics abort a run (``TrainingHealthError``) or a
+    snapshot turns out corrupt: dump the flight recorder, rewind to the
+    last-good snapshot with exponential backoff, re-arm the health monitor,
+    and give up (re-raise, naming the flight record) after
+    ``max_rewinds_per_snapshot`` rewinds land on the SAME snapshot — a fault
+    that reproduces from identical state is deterministic, not transient."""
+
+    max_rewinds_per_snapshot: int = 2  # same-snapshot rewinds before giving up
+    max_total_rewinds: int = 8  # across the whole run
+    backoff_base_s: float = 1.0  # first-rewind sleep; doubles per consecutive rewind
+    backoff_max_s: float = 60.0
+
+
 class CollectivesConfig(DeepSpeedConfigModel):
     """collectives section — the algorithmic collective library
     (``deepspeed_tpu/collectives``): hop-composed ring / bidirectional-ring /
@@ -414,6 +449,8 @@ class EngineConfig(DeepSpeedConfigModel):
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     diagnostics: DiagnosticsConfig = Field(default_factory=DiagnosticsConfig)
     hbm_guard: HBMGuardConfig = Field(default_factory=HBMGuardConfig)
+    snapshot: SnapshotConfig = Field(default_factory=SnapshotConfig)
+    recovery: RecoveryConfig = Field(default_factory=RecoveryConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
     gradient_compression: GradientCompressionConfig = Field(default_factory=GradientCompressionConfig)

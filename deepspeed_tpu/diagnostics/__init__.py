@@ -15,6 +15,10 @@ package WATCHES it and the training math itself:
   - ``flight_recorder`` — bounded ring of recent step records dumped to
     JSONL (+ Perfetto trace) on unhandled exception, SIGTERM/SIGUSR1, or an
     explicit ``engine.diagnostics.dump()``
+  - ``faultinject``     — deterministic fault injection (NaN grads at step K,
+    snapshot writer killed mid-save, shard truncated on disk): the harness
+    that proves the resilience stack (``checkpoint/snapshot.py`` +
+    ``elasticity/resilience.py``) actually recovers
 
 Enable via the ``diagnostics`` config block (see ``config/config.py``);
 disabled (the default) the engine carries no health state, compiles the same
@@ -23,6 +27,11 @@ program as before, and every hook is one attribute check. See
 """
 
 from deepspeed_tpu.diagnostics.anomaly import StepTimeAnomalyDetector
+from deepspeed_tpu.diagnostics.faultinject import (
+    FaultInjector,
+    InjectedWriterCrash,
+    poison_batch,
+)
 from deepspeed_tpu.diagnostics.flight_recorder import (
     FlightRecorder,
     dump_all,
@@ -38,9 +47,11 @@ from deepspeed_tpu.diagnostics.recompile import RecompileDetector, diff_signatur
 
 __all__ = [
     "DiagnosticsManager",
+    "FaultInjector",
     "FlightRecorder",
     "HealthMonitor",
     "HealthState",
+    "InjectedWriterCrash",
     "RecompileDetector",
     "StepTimeAnomalyDetector",
     "TrainingHealthError",
@@ -48,4 +59,5 @@ __all__ = [
     "dump_all",
     "group_nonfinite_counts",
     "install_process_hooks",
+    "poison_batch",
 ]

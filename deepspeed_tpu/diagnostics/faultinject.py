@@ -1,0 +1,213 @@
+"""Deterministic fault injection: the tool that proves recovery works.
+
+The resilience stack (async snapshots in ``checkpoint/snapshot.py``, the
+rewind supervisor in ``elasticity/resilience.py``) is only as real as the
+faults it has survived. This module injects the three failure classes the
+stack claims to handle, each deterministically (a given seed/step always
+produces the same fault — flaky fault tests are worse than none):
+
+  - **NaN gradients at step K** — a NaN planted in the batch poisons the
+    whole backward (the same propagation path a bad data shard takes in
+    production; the idiom the diagnostics test suite established). The
+    in-step health probes then fire ``nonfinite`` with whatever policy is
+    configured.
+  - **writer killed mid-save** — the snapshot writer thread raises between
+    two shard writes (or before the manifest / the commit rename), leaving a
+    ``*.tmp-*`` directory and an untouched ``latest`` pointer: the
+    crash-mid-save atomicity claim, made testable.
+  - **shard truncated on disk** — post-commit corruption (bit rot, a
+    truncated copy): the manifest checksum must catch it BEFORE any device
+    state is touched and the loader must fall back to the previous tag.
+
+Used by ``tests/unit/checkpoint/test_snapshot.py``,
+``tests/unit/aux/test_resilience.py`` and the nightly smoke stage
+(``tools/fault_smoke.py``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class InjectedWriterCrash(RuntimeError):
+    """Raised inside the snapshot writer thread by :meth:`FaultInjector.kill_writer`."""
+
+
+def poison_batch(batch: Any, value: float = float("nan")) -> Any:
+    """Copy of ``batch`` with ``value`` planted in the first element of every
+    float leaf — one poisoned element is enough to NaN the whole backward."""
+    out = {}
+    poisoned = False
+    for k, v in batch.items():
+        arr = np.array(v, copy=True)
+        if np.issubdtype(arr.dtype, np.floating) and arr.size:
+            arr.flat[0] = value
+            poisoned = True
+        out[k] = arr
+    if not poisoned:
+        raise ValueError(
+            "poison_batch: no float leaf to poison (integer-only batches "
+            "need a model-level injection point)")
+    return out
+
+
+class FaultInjector:
+    """One injector instance per experiment; every injection is logged and
+    counted so a test can assert the fault actually fired."""
+
+    def __init__(self):
+        self.nan_steps_fired: list = []
+        self.writer_kills_fired: int = 0
+
+    # ------------------------------------------------------------- NaN grads
+    def nan_batch_fn(
+        self,
+        batch_fn: Callable[[int], Any],
+        at_steps: Iterable[int],
+        repeat: bool = False,
+    ) -> Callable[[int], Any]:
+        """Wrap a deterministic ``batch_fn(step)`` so the batch for each step
+        in ``at_steps`` comes back NaN-poisoned. ``repeat=False`` (default)
+        injects each step's fault ONCE — a rewind that replays the step gets
+        the clean batch, modeling a transient fault; ``repeat=True`` keeps
+        poisoning on every replay, modeling a deterministic fault (the
+        give-up path)."""
+        pending = set(int(s) for s in at_steps)
+        always = frozenset(pending) if repeat else None
+
+        def wrapped(step: int) -> Any:
+            fire = (step in always) if repeat else (step in pending)
+            if not fire:
+                return batch_fn(step)
+            if not repeat:
+                pending.discard(step)
+            self.nan_steps_fired.append(step)
+            logger.warning(f"faultinject: NaN planted in the batch for step {step}")
+            return poison_batch(batch_fn(step))
+
+        return wrapped
+
+    def poison_engine_params(self, engine, value: float = float("nan")) -> int:
+        """Plant ``value`` in the first element of EVERY float param leaf ON
+        DEVICE — the model-level injection point for integer-batch models (a
+        causal LM's ``input_ids`` carries no float to poison). Every-leaf
+        coverage is deliberate: a single poisoned element can sit outside the
+        compute path (an embedding row no token id gathers propagates NOTHING
+        — its grad is a zero scatter, not NaN), but a NaN in every dense
+        kernel/norm reaches the loss on any input. A snapshot restore
+        replaces params wholesale, so the fault is transient across a rewind
+        by construction. Returns the number of leaves poisoned."""
+        import jax
+
+        from deepspeed_tpu.utils.compat import device_put_unaliased
+
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(engine.state.params)
+        new_leaves, n = [], 0
+        for _path, leaf in leaves:
+            arr = np.asarray(jax.device_get(leaf))
+            if np.issubdtype(arr.dtype, np.floating) and arr.size:
+                arr = np.array(arr, copy=True)
+                arr.flat[0] = value
+                leaf = device_put_unaliased(arr, leaf.sharding)
+                n += 1
+            new_leaves.append(leaf)
+        if not n:
+            raise ValueError("poison_engine_params: no float param leaf to poison")
+        engine.state = engine.state._replace(
+            params=jax.tree_util.tree_unflatten(treedef, new_leaves))
+        logger.warning(f"faultinject: NaN planted in {n} param leaves")
+        return n
+
+    def nan_params_fn(
+        self,
+        engine,
+        batch_fn: Callable[[int], Any],
+        at_steps: Iterable[int],
+    ) -> Callable[[int], Any]:
+        """Wrap a deterministic ``batch_fn(step)`` so the ENGINE PARAMS are
+        NaN-poisoned just before each step in ``at_steps`` — the injection
+        path for models whose batches carry no float leaf. Each step fires
+        once; the rewind's restore replaces the poisoned params, so replays
+        run clean (transient-fault semantics, like ``nan_batch_fn``'s
+        default)."""
+        pending = set(int(s) for s in at_steps)
+
+        def wrapped(step: int) -> Any:
+            if step in pending:
+                pending.discard(step)
+                self.nan_steps_fired.append(step)
+                self.poison_engine_params(engine)
+            return batch_fn(step)
+
+        return wrapped
+
+    # ------------------------------------------------------- writer crashes
+    def kill_writer(self, manager, after_shards: int = 1, times: int = 1,
+                    at: str = "shard") -> None:
+        """Arm ``manager`` (a SnapshotManager) so its writer thread crashes
+        mid-save: at the ``after_shards``-th shard write (``at='shard'``),
+        before the manifest (``at='manifest'``) or just before the commit
+        rename (``at='commit'``). Fires ``times`` saves, then disarms —
+        subsequent snapshots succeed (transient disk fault semantics)."""
+        if at not in ("shard", "manifest", "commit"):
+            raise ValueError(f"kill_writer at={at!r}: shard|manifest|commit")
+        state = {"remaining": int(times)}
+
+        def hook(event: str, index: int) -> None:
+            if state["remaining"] <= 0:
+                return
+            if event == at and (event != "shard" or index >= after_shards):
+                state["remaining"] -= 1
+                self.writer_kills_fired += 1
+                logger.warning(
+                    f"faultinject: killing snapshot writer at {event}[{index}]")
+                raise InjectedWriterCrash(
+                    f"injected writer crash at {event}[{index}]")
+
+        manager.fault_hook = hook
+
+    # --------------------------------------------------- on-disk corruption
+    @staticmethod
+    def truncate_shard(base_dir: str, tag: Optional[str] = None,
+                       shard_index: int = 0, keep_bytes: int = 16) -> str:
+        """Truncate one committed shard file to ``keep_bytes`` — the checksum
+        in the manifest no longer matches. Returns the file truncated."""
+        from deepspeed_tpu.checkpoint import snapshot as snap
+
+        tag = tag or snap.latest_tag(base_dir)
+        if tag is None:
+            raise FileNotFoundError(f"no snapshots under {base_dir}")
+        manifest = snap.read_manifest(base_dir, tag)
+        shard = manifest["shards"][shard_index]
+        path = os.path.join(snap.snapshot_root(base_dir), tag, shard["file"])
+        with open(path, "r+b") as f:
+            f.truncate(keep_bytes)
+        logger.warning(f"faultinject: truncated {path} to {keep_bytes} bytes")
+        return path
+
+    @staticmethod
+    def corrupt_manifest(base_dir: str, tag: Optional[str] = None) -> str:
+        """Overwrite a committed manifest with junk (an interrupted rewrite /
+        filesystem fault). Returns the path corrupted."""
+        from deepspeed_tpu.checkpoint import snapshot as snap
+
+        tag = tag or snap.latest_tag(base_dir)
+        if tag is None:
+            raise FileNotFoundError(f"no snapshots under {base_dir}")
+        path = os.path.join(snap.snapshot_root(base_dir), tag, snap.MANIFEST_FILE)
+        with open(path, "w") as f:
+            f.write("{not json")
+        logger.warning(f"faultinject: corrupted {path}")
+        return path
+
+    # ------------------------------------------------------------- reporting
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "nan_steps_fired": list(self.nan_steps_fired),
+            "writer_kills_fired": self.writer_kills_fired,
+        }

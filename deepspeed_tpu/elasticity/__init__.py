@@ -15,3 +15,7 @@ from deepspeed_tpu.elasticity.elasticity import (
     compute_elastic_config,
     elastic_batch_candidates,
 )
+from deepspeed_tpu.elasticity.resilience import (
+    RecoveryReport,
+    run_resilient,
+)

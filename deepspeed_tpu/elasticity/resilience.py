@@ -1,0 +1,220 @@
+"""Auto-recovery supervisor: diagnostics-driven rewind-to-last-good-snapshot.
+
+Closes the loop ROADMAP open item 5 names: the PR-2 diagnostics stack can
+*detect* a poisoned run (``TrainingHealthError`` from the in-step health
+probes) but until now detection just killed the job. ``run_resilient`` is the
+in-process supervisor between the elastic agent (process-level restarts,
+``elastic_agent.py``) and the step loop:
+
+  - drives ``engine.train_batch`` over a deterministic per-step batch stream
+    while the engine's :class:`~deepspeed_tpu.checkpoint.snapshot.SnapshotManager`
+    takes cadenced async snapshots off the step clock;
+  - on ``TrainingHealthError`` (the abort policy fired — the flight recorder
+    has already dumped) or a corrupt/unloadable snapshot at restore time:
+    rewinds to the last-good snapshot (checksums validated, fresh committed
+    buffers, any mesh), re-arms the health monitor (fresh EMA baselines), and
+    resumes after an exponential backoff;
+  - gives up — re-raising the ORIGINAL error, with the flight-record path and
+    a :class:`RecoveryReport` attached — once ``max_rewinds_per_snapshot``
+    rewinds land on the SAME snapshot (a fault that reproduces from identical
+    state is deterministic, not transient) or ``max_total_rewinds`` is spent.
+
+A failed snapshot *write* (disk full, writer crash — surfaced by the
+manager's durability barrier) is logged and training continues: the manager's
+``latest`` pointer still names the previous durable snapshot, so a save
+failure must never trigger a rewind of healthy training state.
+
+Reference analog: the DeepSpeed elasticity + universal-checkpoint pair plus
+what its users script around it (watchdog → load latest → resume); here the
+loop is a library feature, exercised by the fault-injection harness
+(``diagnostics/faultinject.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.checkpoint.snapshot import (
+    SnapshotCorruptionError,
+    SnapshotError,
+    SnapshotManager,
+    read_manifest,
+)
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+@dataclasses.dataclass
+class RecoveryReport:
+    """What the supervisor did — attached to the give-up re-raise as
+    ``exc.recovery_report`` and returned on success."""
+
+    steps_completed: int = 0
+    snapshots_taken: int = 0
+    rewinds: int = 0
+    # one entry per rewind: {"step", "tag", "reason"}
+    rewind_log: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    save_failures: int = 0
+    gave_up: bool = False
+    flight_record: Optional[str] = None
+
+
+def _policy(engine, policy):
+    if policy is not None:
+        return policy
+    return engine.config.model.recovery
+
+
+def _dump_flight_record(engine, reason: str) -> Optional[str]:
+    diag = getattr(engine, "diagnostics", None)
+    if diag is None or diag.flight_recorder is None:
+        return None
+    try:
+        return diag.dump(reason=reason)
+    except Exception as e:  # noqa: BLE001 — post-mortem best effort
+        logger.warning(f"run_resilient: flight-record dump failed: {e}")
+        return None
+
+
+def run_resilient(
+    engine,
+    batch_fn: Callable[[int], Any],
+    num_steps: int,
+    snapshot_dir: Optional[str] = None,
+    policy=None,
+    on_rewind: Optional[Callable[[Dict[str, Any]], None]] = None,
+) -> RecoveryReport:
+    """Train ``engine`` to ``num_steps`` optimizer steps, surviving health
+    aborts and snapshot corruption by rewinding to the last-good snapshot.
+
+    ``batch_fn(step)`` must return the global batch for optimizer step
+    ``step`` (0-based) — a *deterministic* mapping, so a rewind replays the
+    same data stream the uninterrupted run would have seen. ``snapshot_dir``
+    is required unless the engine already has a configured
+    ``snapshot_manager`` (the ``snapshot`` config block); when given, a
+    manager is installed on the engine so the cadence hook drives saves.
+    ``policy`` defaults to the engine's ``recovery`` config block;
+    ``on_rewind`` (if given) is called with each rewind-log entry — the test
+    seam, and the place to page a human.
+    """
+    pol = _policy(engine, policy)
+    mgr: Optional[SnapshotManager] = getattr(engine, "snapshot_manager", None)
+    if mgr is None:
+        if snapshot_dir is None:
+            raise ValueError(
+                "run_resilient needs snapshots to rewind to: enable the "
+                "'snapshot' config block or pass snapshot_dir=")
+        mgr = SnapshotManager(engine, engine.config.model.snapshot,
+                              base_dir=snapshot_dir)
+        engine.snapshot_manager = mgr  # engine's after_step hook drives cadence
+
+    report = RecoveryReport()
+    rewinds_by_tag: Dict[str, int] = {}
+    consecutive_rewinds = 0
+    sf0 = mgr.save_failures  # cadenced-save failures the manager swallows
+    explicit_failures = [0]
+
+    def _sync_save_failures():
+        report.save_failures = mgr.save_failures - sf0 + explicit_failures[0]
+
+    if mgr.last_good_tag is None:
+        # step-0 anchor: there must always be something to rewind to
+        mgr.snapshot(blocking=True)
+        report.snapshots_taken += 1
+
+    def give_up(exc: BaseException, reason: str):
+        _sync_save_failures()
+        report.gave_up = True
+        report.flight_record = (getattr(exc, "dump_path", None)
+                                or _dump_flight_record(engine, f"giveup:{reason}")
+                                or report.flight_record)
+        exc.recovery_report = report
+        logger.error(
+            f"run_resilient: giving up after {report.rewinds} rewind(s) — "
+            f"{reason}"
+            + (f"; flight record: {report.flight_record}"
+               if report.flight_record else ""))
+        raise exc
+
+    step = int(engine.global_steps)
+    report.steps_completed = step
+    while step < num_steps:
+        last_tag_before = mgr.last_good_tag
+        try:
+            engine.train_batch(batch_fn(step))
+        except SnapshotCorruptionError as e:
+            # raised by a restore path, not training — nothing to rewind to
+            give_up(e, "snapshot store corrupt")
+        except SnapshotError as e:
+            # Defense in depth: cadenced saves swallow write failures inside
+            # after_step, so nothing raises SnapshotError out of train_batch
+            # today. If one ever escapes, it comes from the POST-update
+            # boundary hook — the optimizer step applied, training state is
+            # healthy, 'latest' still names the previous durable snapshot —
+            # so count the step and keep going.
+            explicit_failures[0] += 1
+            logger.warning(f"run_resilient: snapshot save failed ({e}); "
+                           "training continues on the previous good snapshot")
+            step += 1
+            report.steps_completed = step
+            continue
+        except Exception as e:
+            from deepspeed_tpu.diagnostics import TrainingHealthError
+
+            if not isinstance(e, TrainingHealthError):
+                raise  # not a health verdict: the supervisor has no opinion
+            report.flight_record = e.dump_path or report.flight_record
+            report.rewinds += 1
+            consecutive_rewinds += 1
+            if report.rewinds > pol.max_total_rewinds:
+                give_up(e, f"max_total_rewinds={pol.max_total_rewinds} exhausted")
+            try:
+                tag = mgr.restore()  # validates checksums; falls back past
+                # corrupt tags; fresh committed buffers on THIS mesh
+            except (SnapshotError, SnapshotCorruptionError) as re_err:
+                re_err.__cause__ = e
+                give_up(re_err, "no loadable snapshot to rewind to")
+            rewinds_by_tag[tag] = rewinds_by_tag.get(tag, 0) + 1
+            if rewinds_by_tag[tag] > pol.max_rewinds_per_snapshot:
+                give_up(e, f"{rewinds_by_tag[tag]} rewinds landed on snapshot "
+                           f"{tag!r} (deterministic fault)")
+            engine.reset_health()  # fresh EMA baselines for the resumed run
+            step = int(engine.global_steps)
+            entry = {"step": step, "tag": tag, "reason": str(e)}
+            report.rewind_log.append(entry)
+            report.steps_completed = step
+            backoff = min(pol.backoff_base_s * (2.0 ** (consecutive_rewinds - 1)),
+                          pol.backoff_max_s)
+            log_dist(
+                f"run_resilient: rewound to snapshot {tag!r} (step {step}) "
+                f"after: {e}; backing off {backoff:.1f}s "
+                f"(rewind {report.rewinds}, {rewinds_by_tag[tag]} on this tag)",
+                ranks=[0])
+            if on_rewind is not None:
+                on_rewind(entry)
+            if backoff > 0:
+                time.sleep(backoff)
+            continue
+        # healthy step
+        step += 1
+        report.steps_completed = step
+        if mgr.last_good_tag != last_tag_before:
+            report.snapshots_taken += 1
+        consecutive_rewinds = 0
+
+    try:
+        mgr.wait()  # final durability barrier
+    except SnapshotError as e:
+        # same stance as mid-run: a save failure never outranks completed
+        # healthy training — record it, the previous snapshot stays 'latest'
+        explicit_failures[0] += 1
+        logger.warning(f"run_resilient: final snapshot barrier reported: {e}")
+    _sync_save_failures()
+    report.steps_completed = int(engine.global_steps)
+    return report
+
+
+def snapshot_step(base_dir: str, tag: str) -> int:
+    """The optimizer step a committed snapshot holds (manifest 'step')."""
+    return int(read_manifest(base_dir, tag)["step"])

@@ -207,6 +207,14 @@ class DeepSpeedTPUEngine:
         # into the step and the recompile detector wraps the jitted fns) ----
         self._setup_diagnostics()
 
+        # ---- elastic snapshots (checkpoint/snapshot.py): cadenced async
+        # sharded saves off the step clock; restore works onto any mesh ----
+        self.snapshot_manager = None
+        if self.config.model.snapshot.enabled:
+            from deepspeed_tpu.checkpoint.snapshot import SnapshotManager
+
+            self.snapshot_manager = SnapshotManager(self, self.config.model.snapshot)
+
         # ---- data --------------------------------------------------------
         self.training_dataloader = None
         if training_data is not None:
@@ -538,12 +546,7 @@ class DeepSpeedTPUEngine:
             self.diagnostics.health = None
         self._health = self.diagnostics.health
         if self._health is not None:
-            if self.offload_mode in ("host-jit", "nvme"):
-                from jax.sharding import SingleDeviceSharding
-
-                sh = SingleDeviceSharding(self._host_device)
-            else:
-                sh = NamedSharding(self.mesh, PartitionSpec())
+            sh = self._health_sharding()
             hstate = jax.device_put(self._health.init_state(), sh)
             self.state = self.state._replace(health=hstate)
             self.state_sharding = self.state_sharding._replace(
@@ -565,6 +568,27 @@ class DeepSpeedTPUEngine:
             + f" step_time={dcfg.step_time.enabled}"
             + f" flight_recorder={dcfg.flight_recorder.enabled}",
             ranks=[0])
+
+    def _health_sharding(self):
+        """Placement of the health-probe EMA state (host-committed on the
+        split offload paths, replicated on the mesh otherwise)."""
+        if self.offload_mode in ("host-jit", "nvme"):
+            from jax.sharding import SingleDeviceSharding
+
+            return SingleDeviceSharding(self._host_device)
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def reset_health(self) -> None:
+        """Re-arm the health monitor: fresh EMA baselines in ``state.health``.
+
+        Called by the auto-recovery loop after a rewind — the restored run
+        re-warms its spike statistics instead of being judged against the
+        baselines that led up to the abort. No-op when health probes are off.
+        """
+        if self._health is None or self.state.health is None:
+            return
+        self.state = self.state._replace(
+            health=jax.device_put(self._health.init_state(), self._health_sharding()))
 
     def _wrap_jit(self, name: str, fn: Callable, arg_names=None) -> Callable:
         """Recompile-detector wrap for a jitted callable (identity when
@@ -773,7 +797,12 @@ class DeepSpeedTPUEngine:
                 self.param_sharding = jax.tree_util.tree_map(lambda _: host_sh, param_shapes)
 
         if master_f32 is not None:
-            params = jax.device_put(master_f32, self.param_sharding)
+            # unaliased: user-supplied initial params are often host numpy;
+            # zero-copy device_put + the donated step is the PR-1 landmine
+            from deepspeed_tpu.utils.compat import device_put_unaliased
+
+            params = jax.tree_util.tree_map(
+                device_put_unaliased, master_f32, self.param_sharding)
         elif self.offload_mode in ("host-jit", "nvme"):
             # host-resident masters: eager init lands on host anyway
             params = jax.device_put(
@@ -1878,6 +1907,10 @@ class DeepSpeedTPUEngine:
             # anomaly observe + the abort-policy check (which may raise)
             self.diagnostics.after_step(
                 step, metrics, step_time_s=time.perf_counter() - diag_t0)
+        if self.snapshot_manager is not None:
+            # AFTER the abort check: a step the health policy aborted must
+            # never become the snapshot the recovery loop rewinds to
+            self.snapshot_manager.after_step(step)
         if self.monitor is not None:
             scalars = {
                 "Train/loss": metrics["loss"],
@@ -2208,12 +2241,20 @@ class DeepSpeedTPUEngine:
                         load_optimizer_states: bool = True,
                         load_universal: bool = False) -> Tuple[Optional[str], Dict]:
         """Restore state. ``load_universal=True`` reads the mesh-independent
-        atom format instead (reference ``load_universal_checkpoint`` flag)."""
+        atom format instead (reference ``load_universal_checkpoint`` flag).
+        A directory holding only elastic snapshots (``<dir>/snapshots/``, no
+        orbax ``latest``) routes to the snapshot restore path — manifest
+        checksums validated before any device state is touched, previous tag
+        on corruption."""
         self.materialize_state()
         if load_universal:
             from deepspeed_tpu.checkpoint.universal import load_universal as _loadu
 
-            out = _loadu(self, load_dir, tag=tag), {}
+            out = _loadu(self, load_dir, tag=tag,
+                         placement=self.config.model.checkpoint.get("restore", "fresh")), {}
+        elif (not os.path.exists(os.path.join(load_dir, "latest"))
+              and os.path.isdir(os.path.join(load_dir, "snapshots"))):
+            out = self.restore_snapshot(load_dir, tag=tag), {}
         else:
             from deepspeed_tpu.checkpoint.checkpointing import load_checkpoint as _load
 
@@ -2221,6 +2262,24 @@ class DeepSpeedTPUEngine:
         if self.offload_mode in ("host-jit", "nvme"):
             self._compute_dev = None  # params changed: bf16 view re-materializes
         return out
+
+    def restore_snapshot(self, base_dir: Optional[str] = None,
+                         tag: Optional[str] = None, fallback: bool = True) -> str:
+        """Restore an elastic snapshot (``checkpoint/snapshot.py``) into this
+        engine — any mesh, fresh committed buffers, checksum-validated with
+        previous-tag fallback. Returns the tag restored."""
+        self.materialize_state()
+        if self.snapshot_manager is not None and (
+                base_dir is None
+                or os.path.abspath(base_dir)
+                == os.path.abspath(self.snapshot_manager.base_dir)):
+            return self.snapshot_manager.restore(tag=tag, fallback=fallback)
+        if base_dir is None:
+            raise ValueError("restore_snapshot needs a base_dir (no snapshot "
+                             "manager configured on this engine)")
+        from deepspeed_tpu.checkpoint.snapshot import restore_snapshot as _restore
+
+        return _restore(self, base_dir, tag=tag, fallback=fallback)
 
     def save_universal_checkpoint(self, save_dir: str, tag: Optional[str] = None) -> str:
         """Write the mesh-independent atom checkpoint (reference

@@ -116,3 +116,34 @@ def shard_map(
         if auto:
             kwargs["auto"] = auto
     return _EXPERIMENTAL(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
+def device_put_unaliased(arr, sharding):
+    """``jax.device_put`` of host numpy into buffers XLA owns EXCLUSIVELY.
+
+    On the CPU backend, ``device_put`` of a 64-byte-aligned numpy array is
+    ZERO-COPY: the resulting ``jax.Array`` (or its device-0 shard under a
+    replicated sharding) aliases numpy-owned memory. A checkpoint-restored
+    leaf flows straight into the engine's compiled steps, which DONATE
+    their state buffers — XLA then reuses memory it does not exclusively
+    own, and the glibc heap corrupts ("corrupted double-linked list" aborts
+    / segfaults a few steps after restore, nondeterministic because it
+    hinges on malloc returning a 64-byte-aligned block for that particular
+    array). This is the PR-1 checkpoint landmine, root-caused by the PR-6
+    fault-injection work. Copying through a deliberately misaligned staging
+    buffer breaks the zero-copy precondition, so PJRT always copies into
+    its own allocation. Every restore path places leaves through here.
+    """
+    import numpy as np
+
+    if isinstance(arr, jax.Array):  # already XLA-owned: plain transfer is safe
+        return jax.device_put(arr, sharding)
+    arr = np.asarray(arr)
+    if arr.nbytes:
+        staging = np.empty(arr.nbytes + 64 + arr.itemsize, dtype=np.uint8)
+        base = (-staging.ctypes.data) % 64
+        off = base + arr.itemsize  # itemsize-aligned for the view, never 64-aligned
+        view = staging[off:off + arr.nbytes].view(arr.dtype).reshape(arr.shape)
+        np.copyto(view, arr)
+        arr = view
+    return jax.device_put(arr, sharding)

@@ -1,0 +1,163 @@
+"""Auto-recovery supervisor suite (``elasticity.run_resilient``).
+
+The fault-injection proofs ISSUE 6 demands: NaN at step K → training
+completes via rewind with the step counter showing it; persistent NaN →
+bounded-retry give-up naming the flight record; corrupt latest snapshot →
+rewind lands on the previous good tag; writer crash mid-run → training
+continues (a save failure never rewinds healthy state).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint import snapshot as snap
+from deepspeed_tpu.diagnostics import FaultInjector, TrainingHealthError
+from deepspeed_tpu.elasticity import run_resilient
+from tests.unit.simple_model import random_batch, simple_model_spec
+
+
+def _engine(tmp_path, seed=3, every=2, recovery=None):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 1000,
+        "diagnostics": {
+            "enabled": True,
+            "health": {"nonfinite_policy": "abort"},
+            "flight_recorder": {"dump_dir": str(tmp_path / "fr"),
+                                "install_signal_handlers": False,
+                                "dump_on_exception": False},
+        },
+        "snapshot": {"enabled": True, "dir": str(tmp_path),
+                     "every_n_steps": every, "fsync": False, "blocking": True},
+        "recovery": {"backoff_base_s": 0.0, **(recovery or {})},
+    }
+    e, *_ = deepspeed_tpu.initialize(model=simple_model_spec(), config=cfg, seed=seed)
+    return e
+
+
+def _batch_fn(engine):
+    return lambda step: random_batch(engine.train_batch_size, seed=step)
+
+
+def test_rewind_completes_training_and_matches_clean_run(devices, tmp_path):
+    """Transient NaN at step 3: the run aborts, rewinds to the last-good
+    snapshot (step counter visibly rewound), replays, and finishes at the
+    target step with the SAME final state as a never-faulted run."""
+    e = _engine(tmp_path)
+    fi = FaultInjector()
+    rewound_steps = []
+    report = run_resilient(
+        e, fi.nan_batch_fn(_batch_fn(e), at_steps=[3]), num_steps=6,
+        on_rewind=lambda entry: rewound_steps.append(entry["step"]))
+    assert report.steps_completed == 6 and e.global_steps == 6
+    assert report.rewinds == 1 and fi.nan_steps_fired == [3]
+    # the rewind landed BEFORE the faulted step: the counter went backwards
+    assert rewound_steps == [2]
+    assert report.rewind_log[0]["tag"] == "step000002"
+    assert report.flight_record and os.path.exists(report.flight_record)
+    # cadence stays keyed on OPTIMIZER steps across the rewind (the restore
+    # rewinds the host batch counter with the state): the final committed
+    # snapshot is the step-6 boundary, not an offset batch count
+    assert snap.latest_tag(str(tmp_path)) == "step000006"
+
+    # clean reference run: same seeds, no fault, no supervisor interference
+    ref = _engine(tmp_path / "ref", seed=3)
+    for s in range(6):
+        ref.train_batch(random_batch(ref.train_batch_size, seed=s))
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(ref.state.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(e.state.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bounded_retry_gives_up_with_flight_record(devices, tmp_path):
+    """Deterministic fault (NaN on every replay of step 3): after
+    max_rewinds_per_snapshot rewinds land on the same snapshot, the ORIGINAL
+    TrainingHealthError is re-raised carrying the recovery report + flight
+    record path."""
+    e = _engine(tmp_path, recovery={"max_rewinds_per_snapshot": 2})
+    fi = FaultInjector()
+    with pytest.raises(TrainingHealthError) as ei:
+        run_resilient(e, fi.nan_batch_fn(_batch_fn(e), at_steps=[3], repeat=True),
+                      num_steps=6)
+    rep = ei.value.recovery_report
+    assert rep.gave_up
+    assert rep.rewinds == 3  # 2 allowed on the tag + the one that tripped
+    assert rep.flight_record and os.path.exists(rep.flight_record)
+    assert len(fi.nan_steps_fired) == 3
+
+
+def test_rewind_skips_corrupted_snapshot(devices, tmp_path):
+    """The abort fires AND the latest snapshot is corrupt on disk: the rewind
+    validates checksums first and lands on the previous good tag."""
+    e = _engine(tmp_path, every=100)
+    bf = _batch_fn(e)
+    for s in range(2):
+        e.train_batch(bf(s))
+    e.snapshot_manager.snapshot(blocking=True)  # good anchor at step 2
+    for s in range(2, 4):
+        e.train_batch(bf(s))
+    e.snapshot_manager.snapshot(blocking=True)  # will be corrupted (step 4)
+    FaultInjector.truncate_shard(str(tmp_path), shard_index=0)
+
+    fi = FaultInjector()
+    report = run_resilient(e, fi.nan_batch_fn(bf, at_steps=[5]), num_steps=7)
+    assert report.steps_completed == 7
+    assert report.rewinds == 1
+    assert report.rewind_log[0]["tag"] == "step000002"  # fell back past step 4
+
+
+def test_save_failure_does_not_rewind(devices, tmp_path):
+    """A writer crash during a cadenced save is swallowed and counted by the
+    manager (never raised out of train_batch): training keeps going forward
+    (no rewind), the report carries the failure count, and `latest` still
+    names the pre-crash snapshot."""
+    e = _engine(tmp_path, every=2)
+    fi = FaultInjector()
+    mgr = e.snapshot_manager
+    report = run_resilient(e, _batch_fn(e), num_steps=2)  # anchor at step 2
+    fi.kill_writer(mgr, after_shards=1)
+    report = run_resilient(e, _batch_fn(e), num_steps=6)
+    assert report.steps_completed == 6 and e.global_steps == 6
+    assert report.rewinds == 0
+    assert report.save_failures >= 1
+    assert fi.writer_kills_fired == 1
+    assert snap.latest_tag(str(tmp_path)) is not None
+
+
+def test_run_resilient_requires_snapshots(devices, tmp_path):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 1000,
+    }
+    e, *_ = deepspeed_tpu.initialize(model=simple_model_spec(), config=cfg, seed=0)
+    with pytest.raises(ValueError, match="snapshot"):
+        run_resilient(e, _batch_fn(e), num_steps=1)
+    # snapshot_dir= installs a manager on the fly
+    report = run_resilient(e, _batch_fn(e), num_steps=2,
+                           snapshot_dir=str(tmp_path))
+    assert report.steps_completed == 2
+    assert e.snapshot_manager is not None
+
+
+def test_health_monitor_rearmed_after_rewind(devices, tmp_path):
+    """The rewound run re-warms its EMA baselines: state.health is reset to
+    the init state right after the rewind (count == 0)."""
+    e = _engine(tmp_path)
+    fi = FaultInjector()
+    seen = []
+
+    def on_rewind(entry):
+        seen.append(int(jax.device_get(e.state.health.count)))
+
+    run_resilient(e, fi.nan_batch_fn(_batch_fn(e), at_steps=[3]), num_steps=5,
+                  on_rewind=on_rewind)
+    assert seen == [0]  # fresh EMAs at the rewind point
+    assert int(jax.device_get(e.state.health.count)) > 0  # re-warmed since

@@ -1,0 +1,396 @@
+"""Elastic snapshot suite: atomic commit under writer crashes, checksum
+fallback, mesh-reshape restore, cadence/pruning, ckpt telemetry.
+
+Coverage model: the reference's universal-checkpoint reshape tests plus the
+durability semantics its Nebula tier promises (publish only after persist) —
+here proven by FAULT INJECTION (``diagnostics/faultinject.py``) rather than
+asserted in prose: the writer is killed between shard writes, shards are
+truncated on disk, and `latest` must keep loading something good.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint import snapshot as snap
+from deepspeed_tpu.diagnostics import FaultInjector
+from tests.unit.simple_model import random_batch, simple_model_spec
+
+
+def _config(stage=1, mesh=None, snapshot=None, micro=2, extra=None):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "steps_per_print": 1000,
+        **(extra or {}),
+    }
+    if mesh:
+        cfg["mesh"] = mesh
+    if snapshot:
+        cfg["snapshot"] = snapshot
+    return cfg
+
+
+def _engine(tmp_path, seed=3, stage=1, mesh=None, every=100, extra=None, **snap_kw):
+    e, *_ = deepspeed_tpu.initialize(
+        model=simple_model_spec(),
+        config=_config(stage=stage, mesh=mesh, extra=extra,
+                       snapshot={"enabled": True, "dir": str(tmp_path),
+                                 "every_n_steps": every, "fsync": False,
+                                 **snap_kw}),
+        seed=seed)
+    return e
+
+
+def _train(engine, steps, seed0=0):
+    for i in range(steps):
+        engine.train_batch(random_batch(engine.train_batch_size, seed=seed0 + i))
+
+
+def _state_leaves(engine):
+    tree = {"params": engine.state.params,
+            "opt": engine.canonical_opt_state(engine.state.opt_state)}
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(jax.device_get(tree))]
+
+
+def _assert_state_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+# ------------------------------------------------------------------ roundtrip
+def test_snapshot_roundtrip_bit_identical_and_restored_engine_keeps_stepping(
+        devices, tmp_path):
+    """Save → drift → restore is bit-identical, and the restored fused
+    (donating) engine keeps stepping for MANY steps — the regression test
+    that replaces the PR-1 'step each restored engine at most once' fence."""
+    e = _engine(tmp_path)
+    _train(e, 3)
+    e.snapshot_manager.snapshot(blocking=True)
+    saved = _state_leaves(e)
+    tag = snap.latest_tag(str(tmp_path))
+    assert tag == "step000003"
+
+    _train(e, 2, seed0=50)  # drift
+    assert e.restore_snapshot(str(tmp_path)) == tag
+    assert e.global_steps == 3
+    _assert_state_equal(saved, _state_leaves(e))
+
+    # the landmine regression: restored state lives in fresh committed
+    # buffers, so continued stepping of the donating engine is safe
+    _train(e, 5, seed0=100)
+    assert e.global_steps == 8
+
+
+def test_async_snapshot_off_the_step_clock(devices, tmp_path):
+    """The cadenced save returns before durability; wait() is the barrier
+    and the committed snapshot holds the state of ITS boundary, not a later
+    one (the host copy happened at the boundary)."""
+    e = _engine(tmp_path, every=2)
+    _train(e, 2)
+    expected = _state_leaves(e)  # state at the step-2 boundary
+    _train(e, 1, seed0=77)  # overlaps the background write
+    e.snapshot_manager.wait()
+    assert snap.latest_tag(str(tmp_path)) == "step000002"
+    e.restore_snapshot(str(tmp_path))
+    _assert_state_equal(expected, _state_leaves(e))
+
+
+# ------------------------------------------------------- crash-mid-save/atomic
+@pytest.mark.parametrize("at", ["shard", "manifest", "commit"])
+def test_crash_mid_save_keeps_latest_loadable(devices, tmp_path, at):
+    """Writer killed between shard writes / before the manifest / before the
+    commit rename: `latest` still names the previous durable snapshot and
+    restoring it works; the crashed write leaves only a tmp dir."""
+    e = _engine(tmp_path)
+    _train(e, 2)
+    e.snapshot_manager.snapshot(blocking=True)
+    good = snap.latest_tag(str(tmp_path))
+    good_state = _state_leaves(e)
+
+    fi = FaultInjector()
+    fi.kill_writer(e.snapshot_manager, after_shards=1, at=at)
+    _train(e, 2, seed0=10)
+    e.snapshot_manager.snapshot()  # dies in the writer thread
+    with pytest.raises(snap.SnapshotError):
+        e.snapshot_manager.wait()
+    assert fi.writer_kills_fired == 1
+    assert snap.latest_tag(str(tmp_path)) == good
+    assert snap.list_snapshots(str(tmp_path)) == [good]
+
+    _train(e, 1, seed0=20)  # drift past the crash
+    assert e.restore_snapshot(str(tmp_path)) == good
+    _assert_state_equal(good_state, _state_leaves(e))
+
+    # the injected fault was transient (times=1): the next snapshot commits
+    e.snapshot_manager.snapshot(blocking=True)
+    assert snap.latest_tag(str(tmp_path)) == "step000002"  # same step after rewind
+
+
+def test_truncated_shard_falls_back_to_previous_tag(devices, tmp_path, caplog):
+    """Checksum mismatch on the latest snapshot: load_checkpoint-level
+    restore validates BEFORE touching device state and falls back to the
+    previous tag with a loud warning instead of crashing."""
+    e = _engine(tmp_path)
+    _train(e, 2)
+    e.snapshot_manager.snapshot(blocking=True)
+    older_state = _state_leaves(e)
+    older = snap.latest_tag(str(tmp_path))
+    _train(e, 2, seed0=30)
+    e.snapshot_manager.snapshot(blocking=True)
+    newest = snap.latest_tag(str(tmp_path))
+    assert newest != older
+
+    FaultInjector.truncate_shard(str(tmp_path), tag=newest, shard_index=1)
+    import logging
+
+    lg = logging.getLogger("deepspeed_tpu")
+    lg.propagate = True  # the repo logger defaults propagate=False; caplog
+    try:
+        with caplog.at_level(logging.WARNING, logger="deepspeed_tpu"):
+            # via the engine's load_checkpoint: a snapshot-only dir routes here
+            tag, _client = e.load_checkpoint(str(tmp_path))
+    finally:
+        lg.propagate = False
+    assert tag == older
+    assert any("checksum mismatch" in r.message for r in caplog.records)
+    assert any("falling back" in r.message for r in caplog.records)
+    _assert_state_equal(older_state, _state_leaves(e))
+
+
+def test_corrupt_manifest_and_no_fallback_raises(devices, tmp_path):
+    e = _engine(tmp_path)
+    _train(e, 1)
+    e.snapshot_manager.snapshot(blocking=True)
+    only = snap.latest_tag(str(tmp_path))
+    FaultInjector.corrupt_manifest(str(tmp_path), tag=only)
+    with pytest.raises(snap.SnapshotCorruptionError):
+        e.restore_snapshot(str(tmp_path))
+
+
+# ------------------------------------------------------------- mesh reshape
+def test_mesh_reshape_restore_8_to_4_and_1(devices, tmp_path):
+    """The reshape matrix: a snapshot from an 8-way dp mesh restores onto
+    4-way and 1-way meshes BIT-IDENTICALLY (state compared leaf-for-leaf
+    against the saving engine), and the resumed trajectory matches the
+    uninterrupted 8-way run."""
+    from deepspeed_tpu.topology.mesh import MESH_AXES
+    from jax.sharding import Mesh
+
+    e8 = _engine(tmp_path, seed=3)
+    _train(e8, 3)
+    e8.snapshot_manager.snapshot(blocking=True)
+    saved = _state_leaves(e8)
+    tag = snap.latest_tag(str(tmp_path))
+
+    _train(e8, 2, seed0=100)  # uninterrupted continuation -> baseline
+    baseline = jax.device_get(e8.state.params)
+
+    def submesh(n):
+        shape = [1] * len(MESH_AXES)
+        shape[MESH_AXES.index("dp")] = n
+        return Mesh(np.asarray(jax.devices()[:n]).reshape(shape), MESH_AXES)
+
+    for world in (4, 1):
+        eN, *_ = deepspeed_tpu.initialize(
+            model=simple_model_spec(),
+            config=_config(extra={"train_batch_size": e8.train_batch_size}),
+            seed=99,  # different init — must be overwritten by the restore
+            mesh=submesh(world))
+        assert eN.restore_snapshot(str(tmp_path), tag=tag) == tag
+        assert eN.global_steps == 3
+        _assert_state_equal(saved, _state_leaves(eN))  # bit-identical restore
+
+        # resume with the SAME global batches the 8-way run consumed
+        _train(eN, 2, seed0=100)
+        for a, b in zip(jax.tree_util.tree_leaves(baseline),
+                        jax.tree_util.tree_leaves(jax.device_get(eN.state.params))):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-6, atol=1e-7)
+
+
+def test_reshape_across_zero_stages(devices, tmp_path):
+    """ZeRO re-partitioning on restore: stage-1 dp=8 snapshot restores into a
+    stage-3 dp=2 x fsdp=4 engine (sharded params) with identical logical
+    state, and the restored engine trains."""
+    e1 = _engine(tmp_path, stage=1)
+    _train(e1, 2)
+    e1.snapshot_manager.snapshot(blocking=True)
+    saved = _state_leaves(e1)
+
+    e3, *_ = deepspeed_tpu.initialize(
+        model=simple_model_spec(),
+        config=_config(stage=3, mesh={"dp": 2, "fsdp": 4},
+                       extra={"train_batch_size": e1.train_batch_size}),
+        seed=99)
+    e3.restore_snapshot(str(tmp_path))
+    _assert_state_equal(saved, _state_leaves(e3))
+    _train(e3, 2, seed0=7)
+    assert e3.global_steps == 4
+
+
+# ------------------------------------------------------------ format details
+def test_manifest_schema_shards_and_pruning(devices, tmp_path):
+    """Manifest carries the partition/provenance metadata the restore matrix
+    needs; large atoms split into bounded shard files; pruning keeps the
+    newest `keep` snapshots and drops stale tmp dirs."""
+    e = _engine(tmp_path, keep=2, shard_megabytes=1)
+    _train(e, 1)
+    mgr = e.snapshot_manager
+    mgr.snapshot(blocking=True)
+    tag = snap.latest_tag(str(tmp_path))
+    man = snap.read_manifest(str(tmp_path), tag)
+    assert man["format_version"] == snap.FORMAT_VERSION
+    assert man["step"] == 1
+    assert man["source_mesh"]["dp"] == 8
+    assert man["zero_stage"] == 1
+    assert man["payload_bytes"] == sum(s["bytes"] for s in man["shards"])
+    for s in man["shards"]:
+        assert set(s) >= {"file", "atom", "dtype", "shape", "slice", "sha256"}
+        assert len(s["sha256"]) == 64
+    atom_keys = {s["atom"] for s in man["shards"]}
+    assert any(k.startswith("['params']") for k in atom_keys)
+    assert any(k.startswith("['opt_state']") for k in atom_keys)
+
+    # tiny shard cap -> a multi-row atom splits into multiple slices
+    atoms = {"['x']": np.arange(64, dtype=np.float32).reshape(8, 8)}
+    snap.write_snapshot(atoms, {"step": 0}, str(tmp_path / "direct"),
+                        "step000000", shard_bytes=64, fsync=False)
+    man2 = snap.read_manifest(str(tmp_path / "direct"), "step000000")
+    slices = [s for s in man2["shards"] if s["atom"] == "['x']"]
+    assert len(slices) > 1 and slices[0]["slice"] == [0, slices[0]["shape"][0]]
+    loaded, _ = snap.load_snapshot_atoms(str(tmp_path / "direct"), "step000000")
+    np.testing.assert_array_equal(loaded["['x']"], atoms["['x']"])
+
+    # pruning: 3 snapshots with keep=2 -> oldest removed; STALE tmp dirs from
+    # other pids removed, recent ones kept (a live writer sharing the dir
+    # must not lose its in-flight write)
+    for i in range(2):
+        _train(e, 1, seed0=10 * (i + 1))
+        mgr.snapshot(blocking=True)
+    stale = os.path.join(snap.snapshot_root(str(tmp_path)), "stepX.tmp-1")
+    fresh = os.path.join(snap.snapshot_root(str(tmp_path)), "stepY.tmp-2")
+    os.makedirs(stale)
+    os.makedirs(fresh)
+    os.utime(stale, (0, 0))  # crashed long ago
+    snap.prune_snapshots(str(tmp_path), keep=2)
+    tags = snap.list_snapshots(str(tmp_path))
+    assert tags == ["step000002", "step000003"]
+    assert not os.path.exists(stale)
+    assert os.path.exists(fresh)  # age-gated: could be a live writer's
+
+
+def test_snapshot_telemetry_gauges_and_spans(devices, tmp_path):
+    """ckpt/save_ms|bytes|inflight gauges land in the shared registry and the
+    ckpt:snapshot / ckpt:commit spans appear in the trace (scrapeable via the
+    PR-5 /metrics exposition)."""
+    from deepspeed_tpu import telemetry
+
+    tr = telemetry.get_tracer()
+    tr.configure(enabled=True)
+    tr.reset()
+    try:
+        e = _engine(tmp_path, extra={"telemetry": {"enabled": True}})
+        _train(e, 1)
+        e.snapshot_manager.snapshot(blocking=True)
+        gauges = tr.registry.gauges()
+        assert gauges.get("ckpt/save_ms", 0) > 0
+        assert gauges.get("ckpt/bytes", 0) > 0
+        assert gauges.get("ckpt/inflight") == 0
+        names = {ev.get("name") for ev in tr.events()}
+        assert "ckpt:snapshot" in names and "ckpt:commit" in names
+        prom = telemetry.render_prometheus(tr.registry)
+        assert "dstpu_ckpt_save_ms" in prom and "dstpu_ckpt_bytes" in prom
+    finally:
+        tr.configure(enabled=False)
+        tr.reset()
+
+
+def test_nvme_offload_snapshot_carries_and_rewinds_optimizer_moments(tmp_path):
+    """An NVMe-offload engine holds ``opt_state=None`` between steps (the
+    moments live on disk). The snapshot paths must materialize them — a
+    snapshot missing every optimizer atom committed silently, and a rewind
+    left ``_opt_on_nvme`` pointing at the aborted timeline's stale moments."""
+    snapdir = tmp_path / "snaps"
+
+    def nvme_engine(swap):
+        cfg = _config(snapshot={"enabled": True, "dir": str(snapdir),
+                                "every_n_steps": 100, "fsync": False})
+        cfg["zero_optimization"]["offload_optimizer"] = {
+            "device": "nvme", "nvme_path": str(tmp_path / swap)}
+        e, *_ = deepspeed_tpu.initialize(
+            model=simple_model_spec(), config=cfg, seed=3)
+        return e
+
+    e = nvme_engine("swap_a")
+    _train(e, 2)
+    assert e.state.opt_state is None  # precondition: moments are on NVMe
+    e.snapshot_manager.snapshot(blocking=True)
+    atoms, _manifest = snap.load_latest_atoms(str(snapdir))
+    assert any("opt_state" in k for k in atoms), sorted(atoms)[:5]
+
+    e.materialize_state()
+    saved = _state_leaves(e)
+    _train(e, 2, seed0=10)  # divergent timeline writes new moments to NVMe
+    tag = e.restore_snapshot(str(snapdir))
+    assert tag is not None
+    e.materialize_state()
+    _assert_state_equal(saved, _state_leaves(e))
+
+    # continued stepping must consume the RESTORED moments, not swap the
+    # divergent timeline's back in: match an uninterrupted run bit-for-bit
+    _train(e, 1, seed0=2)
+    base = nvme_engine("swap_b")
+    _train(base, 3)
+    e.materialize_state()
+    base.materialize_state()
+    _assert_state_equal(_state_leaves(base), _state_leaves(e))
+
+
+def test_failed_async_save_does_not_consume_next_boundary(devices, tmp_path):
+    """A transient async write failure is reported at the next cadenced
+    boundary — but reporting it must not eat that boundary's save (regression:
+    snapshot()'s raise-pending-first consumed the enqueue, silently doubling
+    the rewind window)."""
+    e = _engine(tmp_path, every=1)
+    mgr = e.snapshot_manager
+    _train(e, 1)  # boundary 1: clean save
+    mgr.wait()
+    fi = FaultInjector()
+    fi.kill_writer(mgr, after_shards=1, times=1)
+    _train(e, 1)  # boundary 2: save enqueued, writer crashes mid-write
+    th = mgr._inflight
+    if th is not None:
+        th.join()  # writer dead, error stashed — deliberately not drained
+    assert fi.writer_kills_fired == 1
+    _train(e, 1)  # boundary 3: must report the stale failure AND still save
+    mgr.wait()
+    assert mgr.save_failures == 1
+    assert snap.latest_tag(str(tmp_path)) == "step000003"
+
+
+def test_sole_snapshot_overwrite_crash_window_recovers(devices, tmp_path):
+    """Same-tag overwrite of the SOLE committed snapshot: a crash between the
+    slide-aside and the swap-in leaves 'latest' empty and the only durable
+    copy under '<tag>.old.tmp-<pid>'. load_latest_atoms must re-commit it
+    instead of reporting 'no snapshots'."""
+    e = _engine(tmp_path, every=100)
+    _train(e, 1)
+    e.snapshot_manager.snapshot(blocking=True)
+    root = snap.snapshot_root(str(tmp_path))
+    os.replace(os.path.join(root, "step000001"),
+               os.path.join(root, "step000001.old.tmp-99999"))
+    with open(os.path.join(root, "latest"), "w") as f:
+        f.write("")
+    assert snap.list_snapshots(str(tmp_path)) == []
+    atoms, manifest = snap.load_latest_atoms(str(tmp_path))
+    assert manifest["tag"] == "step000001" and atoms
+    assert snap.latest_tag(str(tmp_path)) == "step000001"
+    assert snap.list_snapshots(str(tmp_path)) == ["step000001"]

@@ -121,6 +121,10 @@ def test_regular_checkpoint_roundtrip_and_latest(devices, tmp_path):
     assert e.global_steps == 3
     _params_close(saved, e.state.params, rtol=0, atol=0)
     assert open(os.path.join(d, "latest")).read().strip() == "global_step3"
+    # restored state lives in fresh committed buffers ('fresh' placement):
+    # the donating fused engine keeps stepping — the old landmine shape
+    _train(e, 3, seed=200)
+    assert e.global_steps == 6
 
 
 def test_async_checkpoint_engine(devices, tmp_path):

@@ -139,12 +139,10 @@ def test_twin_flow_checkpoint_restores_across_partitionings(tmp_path):
     partitions merge to ONE param-shaped moment tree on save, re-partition on
     load — ADVICE round 5): a checkpoint saved under ratio=0.5 restores into
     a non-Twin-Flow engine AND into a different-ratio (0.75) engine, with
-    identical next-step trajectories.
-
-    NOTE each restored engine takes exactly ONE post-restore step, matching
-    the other restore tests: this jax/orbax stack nondeterministically
-    corrupts the heap when a restored fused (donating) engine keeps stepping
-    — reproducible at the seed commit, independent of this feature."""
+    identical multi-step trajectories. (Restored engines step freely now:
+    load_checkpoint's 'fresh' placement restores into newly allocated
+    committed buffers, so the seed-era orbax heap-corruption landmine no
+    longer applies.)"""
     twin, *_ = deepspeed_tpu.initialize(
         model=_model(),
         config=_cfg({"offload_optimizer": {"device": "cpu", "ratio": 0.5}}))
@@ -173,10 +171,11 @@ def test_twin_flow_checkpoint_restores_across_partitionings(tmp_path):
     assert path2 is not None
     assert int(jax.device_get(twin2.state.step)) == int(jax.device_get(twin.state.step))
 
-    # one post-restore step each: all three trajectories coincide
-    l_twin = _run_steps(twin, 1)
-    l_plain = _run_steps(plain, 1)
-    l_twin2 = _run_steps(twin2, 1)
+    # multi-step post-restore trajectories coincide (and exercise the fresh-
+    # buffer restore path under continued stepping — the old landmine shape)
+    l_twin = _run_steps(twin, 3)
+    l_plain = _run_steps(plain, 3)
+    l_twin2 = _run_steps(twin2, 3)
     np.testing.assert_allclose(l_twin, l_plain, rtol=1e-5)
     np.testing.assert_allclose(l_twin, l_twin2, rtol=1e-5)
 
@@ -185,8 +184,8 @@ def test_twin_flow_universal_checkpoint_canonical(tmp_path):
     """The universal (mesh-independent) format canonicalizes Twin-Flow
     opt_state the same way: atoms from a ratio=0.5 engine restore into a
     non-twin engine (canonical paths), and a twin self-reload exercises the
-    load-side re-partitioning. One post-restore step each (see the note on
-    test_twin_flow_checkpoint_restores_across_partitionings)."""
+    load-side re-partitioning. Restored engines keep stepping (fresh-buffer
+    restore placement; the seed-era one-step fence is gone)."""
     twin, *_ = deepspeed_tpu.initialize(
         model=_model(),
         config=_cfg({"offload_optimizer": {"device": "cpu", "ratio": 0.5}}))
@@ -203,8 +202,8 @@ def test_twin_flow_universal_checkpoint_canonical(tmp_path):
         np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32))
 
     load_universal(twin, str(tmp_path))  # self-reload: departition path
-    l_twin = _run_steps(twin, 1)
-    l_plain = _run_steps(plain, 1)
+    l_twin = _run_steps(twin, 3)
+    l_plain = _run_steps(plain, 3)
     np.testing.assert_allclose(l_twin, l_plain, rtol=1e-5)
 
 

@@ -61,8 +61,9 @@ def test_universal_checkpoint_streams_atoms(tmp_path, devices):
         model=causal_lm_spec(TC, example_seq_len=16), config=_cfg())
     batch = {"input_ids": np.random.default_rng(0).integers(0, 128, (8, 16), dtype=np.int32)}
     l1 = [float(e1.train_batch(batch)["loss"]) for _ in range(2)]
-    path = save_universal(e1, str(tmp_path))
+    path = save_universal(e1, str(tmp_path), sidecar=False)
     assert not os.path.exists(os.path.join(path, "atoms.npz"))
+    assert not os.path.exists(os.path.join(path, "atoms_host.npz"))
     assert os.path.isdir(os.path.join(path, "atoms"))
 
     # reload into a DIFFERENT layout (stage-1, dp-only mesh) and continue

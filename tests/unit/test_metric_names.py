@@ -23,6 +23,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__fi
 # one place to extend when a PR adds a legitimate new subsystem
 ALLOWED_SUBSYSTEMS = {
     "anomaly",
+    "ckpt",
     "coll",
     "comm",
     "data",

@@ -28,8 +28,18 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q -m nightly \
   --continue-on-collection-errors -rA --tb=line 2>&1 | tee -a "${OUT}"
 rc=${PIPESTATUS[0]}
 
+# Fault-injection smoke (ISSUE 6): NaN at step K + writer killed mid-save on
+# the CPU bench model must complete to the target step via auto-rewind, with
+# 'latest' still loadable. One JSON line of evidence into the committed log.
 {
-  echo "# exit code: ${rc}"
+  echo "# fault-injection smoke: tools/fault_smoke.py"
+} >> "${OUT}"
+JAX_PLATFORMS=cpu python tools/fault_smoke.py 2>/dev/null | tee -a "${OUT}"
+smoke_rc=${PIPESTATUS[0]}
+[ "${smoke_rc}" -ne 0 ] && rc=1
+
+{
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
 echo "wrote ${OUT}"
