@@ -243,6 +243,13 @@ class TelemetryConfig(DeepSpeedConfigModel):
     # GET /metrics (Prometheus text) + /metrics.json (snapshot). 0 binds a
     # free port; None (default) starts no server.
     http_port: Optional[int] = None
+    # Compiled-program registry (telemetry/programs.py): capture cost/memory/
+    # collective analysis of every jitted program at the recompile-detector
+    # wrap point, published as program/* + compile/* metrics and feeding the
+    # hbm/estimate_ratio calibration. Follows `enabled`; set false to keep
+    # spans/metrics without program capture (skips the one-time per-compile
+    # AOT analysis pass).
+    programs: bool = True
 
 
 class HealthConfig(DeepSpeedConfigModel):
@@ -300,6 +307,26 @@ class FlightRecorderConfig(DeepSpeedConfigModel):
     dump_on_exception: bool = True  # sys.excepthook chain -> dump
 
 
+class ProfilerCaptureConfig(DeepSpeedConfigModel):
+    """Anomaly-triggered device-trace capture (``profiling/capture.py``).
+
+    When the step-time anomaly detector flags a straggler or sustained
+    regression (or on SIGUSR2, or an explicit
+    ``engine.diagnostics.profiler_capture.arm()``), ``jax.profiler`` traces
+    the next ``steps`` steps and drops the trace directory next to the
+    flight record — so the post-mortem of a slow step holds the device
+    timeline that explains it, not just the host-side flag. Opt-in:
+    ``jax.profiler`` is heavyweight, so nothing starts unless this block is
+    enabled AND a trigger fires; ``cooldown_steps`` bounds how often."""
+
+    enabled: bool = False
+    steps: int = 3  # steps traced per capture window
+    on_anomaly: bool = True  # straggler/regression flags arm a capture
+    signal: bool = True  # SIGUSR2 arms a capture (process-wide, once)
+    cooldown_steps: int = 200  # min steps between capture windows
+    dir: Optional[str] = None  # default: the flight recorder's dump dir
+
+
 class DiagnosticsConfig(DeepSpeedConfigModel):
     """diagnostics section — the watching half of observability
     (``deepspeed_tpu/diagnostics``), built on the telemetry core. Disabled
@@ -311,6 +338,7 @@ class DiagnosticsConfig(DeepSpeedConfigModel):
     recompile: RecompileDetectConfig = Field(default_factory=RecompileDetectConfig)
     step_time: StepTimeConfig = Field(default_factory=StepTimeConfig)
     flight_recorder: FlightRecorderConfig = Field(default_factory=FlightRecorderConfig)
+    profiler_capture: ProfilerCaptureConfig = Field(default_factory=ProfilerCaptureConfig)
 
 
 class SnapshotConfig(DeepSpeedConfigModel):

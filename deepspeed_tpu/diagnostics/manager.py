@@ -75,6 +75,28 @@ class DiagnosticsManager:
                 excepthook=config.flight_recorder.dump_on_exception,
             )
 
+        # Anomaly-triggered jax.profiler capture (profiling/capture.py):
+        # straggler/regression flags — or SIGUSR2 — trace the next N steps
+        # and drop the device trace next to the flight record.
+        self.profiler_capture = None
+        pcfg = getattr(config, "profiler_capture", None)
+        if pcfg is not None and pcfg.enabled:
+            from deepspeed_tpu.profiling.capture import (
+                ProfilerCapture,
+                install_sigusr2,
+            )
+
+            self.profiler_capture = ProfilerCapture(
+                steps=pcfg.steps,
+                out_dir=pcfg.dir or (self.flight_recorder.dump_dir
+                                     if self.flight_recorder is not None else None),
+                cooldown_steps=pcfg.cooldown_steps,
+                tracer=tracer,
+                recorder=self.flight_recorder,
+            )
+            if pcfg.signal:
+                install_sigusr2()
+
         self._abort_armed = bool(self.health and self.health.abort_signals)
         self._skips_seen = 0
 
@@ -82,9 +104,21 @@ class DiagnosticsManager:
     def wrap_jit(self, name: str, fn: Callable,
                  arg_names: Optional[Sequence[str]] = None) -> Callable:
         """Wrap a jitted callable with a recompile detector (identity when
-        recompile checking is off)."""
+        recompile checking is off).
+
+        With recompile checking off but the compiled-program registry live,
+        the registry still gets its wrap point (same fallback the engine
+        uses when diagnostics are absent entirely) — program capture must
+        not silently vanish because only the detector was disabled."""
         if not self.config.recompile.enabled or fn is None:
-            return fn
+            if fn is None:
+                return fn
+            from deepspeed_tpu.telemetry.programs import get_program_registry
+
+            # wrap unconditionally: enablement is checked per call (the
+            # tracer may not be configured yet at step-build time), and a
+            # disabled watcher is a single flag check falling through
+            return get_program_registry().wrap(fn, name, hbm_scope="train")
         det = self._detectors.get(name)
         if det is None:
             det = self._detectors[name] = RecompileDetector(
@@ -93,6 +127,9 @@ class DiagnosticsManager:
                 storm_threshold=self.config.recompile.storm_threshold,
                 storm_window_s=self.config.recompile.storm_window_s,
                 tracer=self._tracer,
+                # engine step programs calibrate against the train-scope
+                # pre-flight HBM estimate (telemetry/programs.py)
+                hbm_scope="train",
             )
         return det.wrap(fn)
 
@@ -100,6 +137,12 @@ class DiagnosticsManager:
         return self._detectors.get(name)
 
     # -------------------------------------------------------------- per step
+    def before_step(self, step: int) -> None:
+        """Pre-dispatch hook: starts an armed profiler-capture window so the
+        trace brackets whole steps. One attribute check when idle."""
+        if self.profiler_capture is not None:
+            self.profiler_capture.on_step_start(step)
+
     def after_step(self, step: int, metrics: Dict[str, Any],
                    step_time_s: Optional[float] = None) -> None:
         """Host-side per-step hook: ring append + step-time observe + abort.
@@ -113,7 +156,14 @@ class DiagnosticsManager:
                 extra["step_time_ms"] = round(step_time_s * 1e3, 3)
             self.flight_recorder.record(step, metrics, **extra)
         if self.step_time is not None and step_time_s is not None:
-            self.step_time.observe(step_time_s, step=step)
+            flags = self.step_time.observe(step_time_s, step=step)
+            if (self.profiler_capture is not None
+                    and self.config.profiler_capture.on_anomaly
+                    and (flags["straggler"] or flags["regression"])):
+                kind = "straggler" if flags["straggler"] else "regression"
+                self.profiler_capture.arm(reason=f"anomaly:{kind}@step{step}")
+        if self.profiler_capture is not None:
+            self.profiler_capture.on_step_end(step)
         if self._abort_armed and "health/abort" in metrics:
             import jax
 

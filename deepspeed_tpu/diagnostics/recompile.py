@@ -79,9 +79,19 @@ class _WrappedJit:
         self._label = label
         self._last_sig: Optional[Dict[str, Any]] = None
         self._compiles_seen = 0
+        # freshest ProgramRecord captured for THIS wrapper's program (the
+        # flops profiler reads it instead of AOT-compiling a second copy)
+        self._program_record = None
 
     def __call__(self, *args, **kwargs):
         det = self._detector
+        # program capture shares this wrap point (telemetry/programs.py):
+        # when the registry is live, the call is timed so a detected compile
+        # carries its wall cost — one perf_counter read per call, nothing
+        # when the registry is disabled
+        programs = det.programs
+        capture = programs is not None and programs.enabled
+        t0 = time.perf_counter() if capture else 0.0
         before = self._cache_size()
         out = self._fn(*args, **kwargs)
         after = self._cache_size()
@@ -91,9 +101,19 @@ class _WrappedJit:
             # else every call would fire a spurious recompile warning
             return out
         if after > before:
+            program = prev_program = None
+            if capture:
+                prev_program = programs.latest(self._label)
+                program = programs.on_compile(
+                    self._label, self._fn, args, kwargs,
+                    wall_s=time.perf_counter() - t0,
+                    hbm_scope=det.hbm_scope)
+                if program is not None:
+                    self._program_record = program
             sig = _tree_sig(args, kwargs, det.arg_names)
             det._on_compile(self._label, self._last_sig, sig,
-                            first=(self._compiles_seen == 0), cache_size=after)
+                            first=(self._compiles_seen == 0), cache_size=after,
+                            program=program, prev_program=prev_program)
             self._last_sig = sig
             self._compiles_seen += 1
         return out
@@ -129,6 +149,7 @@ class RecompileDetector:
         storm_threshold: int = 3,
         storm_window_s: float = 60.0,
         tracer=None,
+        hbm_scope: Optional[str] = None,
     ):
         self.name = name
         self.arg_names = tuple(arg_names) if arg_names else None
@@ -144,17 +165,27 @@ class RecompileDetector:
 
             tracer = get_tracer()
         self._tracer = tracer
+        # Compiled-program capture rides this wrap point; ``hbm_scope`` tags
+        # captures for estimate-vs-actual calibration (see utils/hbm.py).
+        from deepspeed_tpu.telemetry.programs import get_program_registry
+
+        self.programs = get_program_registry()
+        self.hbm_scope = hbm_scope
 
     def wrap(self, fn: Callable, label: Optional[str] = None) -> Callable:
         return _WrappedJit(fn, self, label or self.name)
 
     # ------------------------------------------------------------------ hooks
     def _on_compile(self, label: str, old_sig, new_sig, first: bool,
-                    cache_size: Optional[int]) -> None:
+                    cache_size: Optional[int],
+                    program=None, prev_program=None) -> None:
         now = time.monotonic()
         self.compiles += 1
         self._tracer.count(f"recompile/{self.name}")
         ev: Dict[str, Any] = {"label": label, "t": now, "cache_size": cache_size}
+        if program is not None:
+            ev["hlo"] = {"fingerprint": program.fingerprint,
+                         "instructions": program.instruction_count}
         if first:
             # the initial compile of a program is expected, not a defect
             ev.update(kind="initial", diff=[])
@@ -168,6 +199,19 @@ class RecompileDetector:
         detail = "; ".join(diff[:6]) if diff else (
             "no argument shape/dtype change — weak types, donation, or "
             "non-hashable static state are the usual suspects")
+        if program is not None and program.fingerprint:
+            # say what GREW, not just which argument drifted: the captured
+            # HLO identity of the program that was running vs the new one
+            if prev_program is not None and prev_program.fingerprint:
+                delta = program.instruction_count - prev_program.instruction_count
+                detail += (
+                    f"; HLO {prev_program.fingerprint}"
+                    f" ({prev_program.instruction_count} instr)"
+                    f" -> {program.fingerprint}"
+                    f" ({program.instruction_count} instr, {delta:+d})")
+            else:
+                detail += (f"; HLO {program.fingerprint}"
+                           f" ({program.instruction_count} instr)")
         msg = (f"[{self.name}] RECOMPILE #{self.recompiles} of {label}"
                + (f" (jit cache size {cache_size})" if cache_size else "")
                + f": {detail}")

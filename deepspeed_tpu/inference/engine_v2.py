@@ -144,7 +144,14 @@ class InferenceEngineV2:
         dtype = config.jax_dtype
         n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
         kv_on_tp = model_config.kv_heads % mesh.shape["tp"] == 0
-        if config.hbm_check != "off":
+        # Compiled-program registry (telemetry/programs.py): the v2 step
+        # programs are wrapped at build time when capture is live, and the
+        # pre-flight byte estimate below doubles as the serving-scope
+        # calibration baseline for hbm/estimate_ratio.
+        from deepspeed_tpu.telemetry.programs import get_program_registry
+
+        self._programs = get_program_registry()
+        if config.hbm_check != "off" or self._programs.enabled:
             # Refuse/warn BEFORE any device materialization: PER-DEVICE bytes
             # — params shard over tp (autotp partition rules), the KV pool
             # shards over tp only when kv_heads divides — plus a
@@ -159,8 +166,10 @@ class InferenceEngineV2:
             need = (n_params * dtype_b // tp
                     + kv_elems * dtype_b // (tp if kv_on_tp else 1)
                     + config.row_bucket * model_config.vocab_size * 4)
-            check_hbm_fit(need, what="InferenceEngineV2 init (params + KV pool)",
-                          mode=config.hbm_check)
+            if config.hbm_check != "off":
+                check_hbm_fit(need, what="InferenceEngineV2 init (params + KV pool)",
+                              mode=config.hbm_check)
+            self._programs.set_hbm_estimate(need, scope="serving")
         self.params = place_parameters(params, mesh, causal_lm_partition_rules, dtype)
         # KV pool: kv-head dim over tp, slots replicated over dp
         pool = init_pool(model_config, config.num_kv_blocks, config.kv_block_size, dtype)
@@ -223,6 +232,27 @@ class InferenceEngineV2:
         self.state.flush(uid)
 
     # ---------------------------------------------------------------- programs
+    def _watch(self, fn, kind: str, *parts):
+        """Program-registry watcher around a jitted step (identity when
+        capture is off at build time — the dispatch path stays untouched;
+        ``jit_cache_size`` counts ``_step_cache`` entries either way).
+        The label carries every component of the step-cache key so distinct
+        compiled programs never collide under one registry label."""
+        if not self._programs.enabled:
+            return fn
+        label = f"v2:{kind}:" + "".join(str(p) for p in parts)
+        return self._programs.wrap(fn, label, hbm_scope="serving")
+
+    @staticmethod
+    def _kw_tag(sample_kw: Tuple, eos_id=None) -> str:
+        """Deterministic short tag for the sampling-config part of a step
+        key ('' for the common default config)."""
+        if not sample_kw and eos_id is None:
+            return ""
+        import zlib
+
+        return f"s{zlib.crc32(repr((tuple(sample_kw), eos_id)).encode()) & 0xffff:04x}"
+
     def _step_fn(self, rows: int, chunk: int):
         """Mixed prefill/decode step -> last-token logits (the v2 ``put``)."""
         key = ("logits", rows, chunk)
@@ -234,7 +264,7 @@ class InferenceEngineV2:
             def step(params, pool, tokens, positions, new_lens, block_tables):
                 return ragged_forward(params, cfg, pool, tokens, positions, new_lens, block_tables, bs)
 
-            self._step_cache[key] = step
+            self._step_cache[key] = self._watch(step, "step", f"r{rows}", f"c{chunk}")
         return self._step_cache[key]
 
     def _sample_step_fn(self, rows: int, chunk: int, sample_kw: Tuple):
@@ -257,7 +287,8 @@ class InferenceEngineV2:
                 toks = sample_logits(logits, sub, **kw)
                 return toks, rng, pool
 
-            self._step_cache[key] = step
+            self._step_cache[key] = self._watch(
+                step, "prefill", f"r{rows}", f"c{chunk}", self._kw_tag(sample_kw))
         return self._step_cache[key]
 
     def _chain_fn(self, rows: int, k: int, eos_id: Optional[int], sample_kw: Tuple):
@@ -274,7 +305,9 @@ class InferenceEngineV2:
                     params, cfg, pool, tokens, start_pos, block_tables, bs,
                     active, budgets, rng, k, eos_id, **kw)
 
-            self._step_cache[key] = chain
+            self._step_cache[key] = self._watch(
+                chain, "decode_chain", f"r{rows}", f"k{k}",
+                self._kw_tag(sample_kw, eos_id))
         return self._step_cache[key]
 
     def jit_cache_size(self, kind: Optional[str] = None) -> int:

@@ -125,6 +125,12 @@ class TransformerConfig:
     # layer); requires scan_layers=False (heterogeneous layers cannot scan).
     moe_use_residual: bool = False
     moe_layer_experts: Optional[Tuple[int, ...]] = None
+    # Emit device-computed MoE dispatch gauges (moe/capacity_factor,
+    # moe/token_drop_rate, moe/expert_load_balance — parallel/moe.py
+    # MOE_STAT_KEYS) from the training forward: the loss_fn returns
+    # (loss, logits, stats_dict) instead of (loss, logits). The engine flips
+    # this on via rebuild when telemetry is enabled (no-op for dense models).
+    moe_metrics: bool = False
 
     def __post_init__(self):
         if self.moe_layer_experts is not None and len(self.moe_layer_experts) != self.num_layers:
@@ -165,6 +171,10 @@ class TransformerConfig:
         return self.num_experts > 0 or bool(
             self.moe_layer_experts and any(e > 0 for e in self.moe_layer_experts)
         )
+
+    @property
+    def num_moe_layers(self) -> int:
+        return sum(1 for i in range(self.num_layers) if self.experts_for_layer(i) > 0)
 
     @property
     def kv_heads(self) -> int:
@@ -468,6 +478,10 @@ class Block(nn.Module):
             )
             h = _norm(cfg, "mlp_norm")(x)
         n_exp = cfg.experts_for_layer(self.layer_idx)
+        # moe_metrics rides the aux carry as (scalar, stats-dict) — the
+        # structure is decided once by CausalLM (dense layers pass it through
+        # untouched, so the scan carry stays consistent across the stack)
+        collect = cfg.moe_metrics and self.train and cfg.has_moe
         if n_exp > 0:
             from deepspeed_tpu.parallel.moe import MoEConfig, MoELayer
 
@@ -478,15 +492,23 @@ class Block(nn.Module):
                 min_capacity=cfg.moe_min_capacity,
                 drop_tokens=cfg.moe_drop_tokens,
                 aux_loss_weight=cfg.moe_aux_loss_weight,
+                collect_metrics=collect,
             )
-            l_aux, out = MoELayer(
+            moe_out = MoELayer(
                 moe_cfg, cfg.hidden_size, cfg.intermediate_size,
                 activation=cfg.activation, dtype=cfg.dtype, train=self.train,
                 use_residual=cfg.moe_use_residual,
                 name="moe",
             )(h)
+            if collect:
+                l_aux, out, stats = moe_out
+                aux_sum, stats_acc = aux
+                aux = (aux_sum + l_aux,
+                       {k: stats_acc[k] + stats[k] for k in stats_acc})
+            else:
+                l_aux, out = moe_out
+                aux = aux + l_aux
             x = x + out
-            aux = aux + l_aux
         else:
             x = x + MLP(cfg, name="mlp")(h, self.train)
         return (x, mask, positions, aux), None
@@ -536,6 +558,13 @@ class CausalLM(nn.Module):
             x = x + pos_emb[None, :S, :].astype(cfg.dtype)
 
         aux = jnp.zeros((), jnp.float32)
+        collect_moe = cfg.moe_metrics and train and cfg.has_moe
+        if collect_moe:
+            from deepspeed_tpu.parallel.moe import MOE_STAT_KEYS
+
+            # (aux-loss sum, per-layer stat sums) — averaged over MoE layers
+            # below; Block keeps this structure through the whole stack
+            aux = (aux, {k: jnp.zeros((), jnp.float32) for k in MOE_STAT_KEYS})
         block_cls = Block
         if cfg.remat:
             block_cls = nn.remat(Block, prevent_cse=False)
@@ -557,6 +586,12 @@ class CausalLM(nn.Module):
             for i in range(cfg.num_layers):
                 (x, _, _, aux), _ = block_cls(cfg, train, layer_idx=i, name=f"layer_{i}")(
                     (x, pad_mask, positions, aux), None)
+
+        moe_stats = None
+        if collect_moe:
+            aux, stat_sums = aux
+            n_moe = max(cfg.num_moe_layers, 1)
+            moe_stats = {k: v / n_moe for k, v in stat_sums.items()}
 
         x = _norm(cfg, "final_norm")(x)
         labels = batch.get("labels")
@@ -587,6 +622,10 @@ class CausalLM(nn.Module):
         if cfg.has_moe:
             # aux is pre-weighted by MoELayer; average over layers
             loss = loss + aux / cfg.num_layers
+        if moe_stats is not None:
+            # engine contract (_loss_and_aux): a trailing dict of scalars is
+            # the device-computed stats side channel (moe/* gauges)
+            return loss, logits, moe_stats
         return loss, logits
 
 
@@ -653,6 +692,12 @@ def pipelined_causal_lm_loss(params, batch, rng, *, config: TransformerConfig,
     cfg = config
     if not cfg.scan_layers:
         raise ValueError("pipelined execution requires scan_layers=True (stacked layer params)")
+    if cfg.moe_metrics and train and cfg.has_moe:
+        raise ValueError(
+            "moe_metrics is not wired through the pipelined loss path (the "
+            "stats dict cannot ride the pp activation ring) — the engine "
+            "skips the rebuild on pp>1 meshes; construct with "
+            "moe_metrics=False for pipelined MoE")
     M = num_microbatches
     ids = batch["input_ids"]
     B, S = ids.shape

@@ -39,6 +39,24 @@ class MoEConfig:
     aux_loss_weight: float = 0.01
     # token distribution follows the reference's expert-data decomposition:
     # experts shard over 'ep'; dp ranks inside an ep group replicate experts.
+    # Emit device-computed dispatch stats (MOE_STAT_KEYS) alongside the aux
+    # loss — the telemetry moe/* gauges. Changes the layer's return arity.
+    collect_metrics: bool = False
+
+
+# Dispatch-health gauges the gating math can compute for free (ROADMAP item
+# 4's instrumentation). All fp32 scalars, device-computed, fetched only at
+# the engine's existing monitor sync points:
+#   moe/capacity_factor     realized capacity demand — the factor that would
+#                           have kept every token (busiest expert's pre-drop
+#                           load x E / (T*k)); above the configured
+#                           capacity_factor means tokens dropped
+#   moe/token_drop_rate     fraction of (token, choice) slots dropped at the
+#                           capacity cutoff
+#   moe/expert_load_balance E * sum_e(share_e^2) of pre-drop routing: 1.0 =
+#                           perfectly uniform, E = total collapse onto one
+MOE_STAT_KEYS = ("moe/capacity_factor", "moe/token_drop_rate",
+                 "moe/expert_load_balance")
 
 
 def _ep_constrain(x: jax.Array, spec: P) -> jax.Array:
@@ -65,12 +83,16 @@ def top_k_gating(
     rng: Optional[jax.Array] = None,
     use_rts: bool = True,
     drop_tokens: bool = True,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    collect_stats: bool = False,
+) -> Tuple[jax.Array, ...]:
     """Generic top-k gating (covers the reference's top1/top2/topk gates).
 
     Returns (l_aux, combine_weights [T, E, C], dispatch_mask [T, E, C], exp_counts [E]).
     Load-balancing aux loss is the standard me*ce formulation
     (``sharded_moe.py`` top1gating): E * sum_e mean_prob_e * frac_tokens_e.
+    With ``collect_stats`` a fifth element is appended: a ``MOE_STAT_KEYS``
+    dict of fp32 scalar dispatch-health gauges (see the key docs above) —
+    a handful of reductions over masks the gate already built.
     """
     T, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -99,6 +121,7 @@ def top_k_gating(
     # cumulative count per expert across (choice, token) slots — second
     # choices queue behind first choices for the same expert (reference top2)
     flat = jnp.concatenate([masks[:, j, :] for j in range(top_k)], axis=0)  # [k*T, E]
+    route_counts = flat.sum(axis=0)  # [E] pre-drop demand per expert
     positions = jnp.cumsum(flat, axis=0) - flat  # [k*T, E]
     pos_in_expert = (positions * flat).sum(axis=-1)  # [k*T]
     keep = pos_in_expert < capacity
@@ -120,7 +143,17 @@ def top_k_gating(
     combine = jnp.einsum("tk,tke,tkc->tec", gate_w, per_k, cap_oh)
     dispatch = (combine > 0).astype(logits.dtype)
     exp_counts = flat.sum(axis=0).astype(jnp.int32)
-    return l_aux.astype(jnp.float32), combine.astype(logits.dtype), dispatch, exp_counts
+    out = (l_aux.astype(jnp.float32), combine.astype(logits.dtype), dispatch, exp_counts)
+    if not collect_stats:
+        return out
+    slots = jnp.float32(T * top_k)  # every (token, choice) routes somewhere
+    share = route_counts / slots  # [E], sums to 1
+    stats = {
+        "moe/capacity_factor": route_counts.max() * E / slots,
+        "moe/token_drop_rate": 1.0 - exp_counts.sum() / slots,
+        "moe/expert_load_balance": E * jnp.sum(share * share),
+    }
+    return out + ({k: v.astype(jnp.float32) for k, v in stats.items()},)
 
 
 class TopKGate(nn.Module):
@@ -152,10 +185,13 @@ class TopKGate(nn.Module):
         factor = cfg.capacity_factor if train else cfg.eval_capacity_factor
         capacity = _capacity(T, cfg.num_experts, factor, cfg.min_capacity, cfg.top_k)
         rng = self.make_rng("dropout") if (train and cfg.use_rts and self.has_rng("dropout")) else None
-        l_aux, combine, dispatch, _counts = top_k_gating(
+        gated = top_k_gating(
             logits, cfg.top_k, capacity, rng=rng, use_rts=cfg.use_rts and train,
-            drop_tokens=cfg.drop_tokens,
+            drop_tokens=cfg.drop_tokens, collect_stats=cfg.collect_metrics,
         )
+        l_aux, combine, dispatch = gated[0], gated[1], gated[2]
+        if cfg.collect_metrics:
+            return l_aux, combine, dispatch, gated[4]
         return l_aux, combine, dispatch
 
 
@@ -211,10 +247,14 @@ class MoELayer(nn.Module):
     use_residual: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, ...]:
         B, S, M = x.shape
         tokens = x.reshape(B * S, M)
-        l_aux, combine, dispatch = TopKGate(self.config, M, name="gate")(tokens, self.train)
+        gated = TopKGate(self.config, M, name="gate")(tokens, self.train)
+        if self.config.collect_metrics:
+            l_aux, combine, dispatch, stats = gated
+        else:
+            (l_aux, combine, dispatch), stats = gated, None
         # dispatch: [T, E, C] x [T, M] -> [E, C, M], then shard E over ep
         expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(self.dtype), tokens)
         expert_in = _ep_constrain(expert_in, P("ep", None, None))  # all-to-all in
@@ -233,7 +273,10 @@ class MoELayer(nn.Module):
             c = jax.nn.softmax(coef, axis=-1).astype(self.dtype)
             out = out * c[:, 0:1] + res * c[:, 1:2]
         # returned aux loss is already weighted — callers add it to their loss
-        return self.config.aux_loss_weight * l_aux, out.reshape(B, S, M)
+        weighted = self.config.aux_loss_weight * l_aux
+        if self.config.collect_metrics:
+            return weighted, out.reshape(B, S, M), stats
+        return weighted, out.reshape(B, S, M)
 
 
 def moe_partition_rules(path: str, shape: tuple) -> Optional[P]:

@@ -106,8 +106,22 @@ def flops_by_op(fn: Callable, *args, **kwargs) -> Dict[str, int]:
 
 # --------------------------------------------------------- compiled costs
 def compiled_cost(fn: Callable, *args, **kwargs) -> Dict[str, float]:
-    """XLA cost analysis of the compiled program: exact flops/bytes."""
-    return _costs_of(jax.jit(fn).lower(*args, **kwargs).compile())
+    """XLA cost analysis of the compiled program: exact flops/bytes.
+
+    Routed through the compiled-program registry (telemetry/programs.py) so
+    the analysis pass is recorded once and shared — repeated calls ride
+    XLA's in-memory lowering/compile caches instead of re-analyzing, and
+    with telemetry enabled the program lands in the ``program/*`` inventory
+    like every engine-built program."""
+    from deepspeed_tpu.telemetry.programs import get_program_registry
+
+    rec = get_program_registry().capture(fn, *args, **kwargs)
+    if rec is None:  # capture failed (non-jittable edge): old direct path
+        return _costs_of(jax.jit(fn).lower(*args, **kwargs).compile())
+    out = {"flops": rec.flops, "bytes accessed": rec.bytes_accessed}
+    if rec.peak_hbm_bytes:
+        out["peak_memory_bytes"] = float(rec.peak_hbm_bytes)
+    return out
 
 
 def _costs_of(compiled) -> Dict[str, float]:
@@ -270,18 +284,33 @@ class FlopsProfiler:
         e = self.engine
         state = e.state
         from deepspeed_tpu.diagnostics.recompile import unwrap_jit
+        from deepspeed_tpu.telemetry.programs import unwrap_program_watch
 
-        step_fn = unwrap_jit(e._train_step)  # AOT path: don't count the trace
-        compiled = step_fn.lower(state, batch).compile()
-        costs = _costs_of(compiled)
-        flops = float(costs.get("flops", 0.0))
+        step_wrapper = e._train_step
+        step_fn = unwrap_program_watch(unwrap_jit(step_wrapper))
 
         import jax.numpy as jnp
 
-        t0 = time.perf_counter()
-        new_state, metrics = compiled(state, batch)
-        np.asarray(jnp.sum(metrics["loss"]))  # scalar-transfer execution barrier
-        latency = time.perf_counter() - t0
+        # The program registry already analyzed THIS wrapper's compiled step
+        # at its dispatch compile — reuse that record and dispatch the normal
+        # wrapped step (a cache hit) instead of lowering+compiling a second
+        # throwaway copy of the program just to read costs.
+        rec = getattr(step_wrapper, "_program_record", None)
+        if rec is not None and (rec.flops or rec.bytes_accessed):
+            costs = {"flops": rec.flops, "bytes accessed": rec.bytes_accessed}
+            t0 = time.perf_counter()
+            new_state, metrics = step_wrapper(state, batch)
+            np.asarray(jnp.sum(metrics["loss"]))  # scalar-transfer execution barrier
+            latency = time.perf_counter() - t0
+        else:
+            # registry off (or capture failed): the original AOT path
+            compiled = step_fn.lower(state, batch).compile()
+            costs = _costs_of(compiled)
+            t0 = time.perf_counter()
+            new_state, metrics = compiled(state, batch)
+            np.asarray(jnp.sum(metrics["loss"]))  # scalar-transfer execution barrier
+            latency = time.perf_counter() - t0
+        flops = float(costs.get("flops", 0.0))
 
         n_dev = max(e.mesh.size, 1)
         try:

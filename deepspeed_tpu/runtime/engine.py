@@ -53,6 +53,11 @@ from deepspeed_tpu.topology.mesh import (
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import ThroughputTimer
 
+# Device-computed MoE dispatch gauges (parallel/moe.py gating stats): keys in
+# the step metrics dict, monitor scalars, and registry gauges alike.
+_MOE_METRIC_KEYS = ("moe/capacity_factor", "moe/token_drop_rate",
+                    "moe/expert_load_balance")
+
 # /metrics HTTP servers, one per configured port for the process lifetime
 # (daemon threads over the process-global registry — engines come and go,
 # the exposition endpoint stays; port 0 always binds a fresh free port).
@@ -172,6 +177,10 @@ class DeepSpeedTPUEngine:
 
         # ---- sparse embedding gradients (must precede step compilation) --
         self._resolve_sparse_gradients()
+
+        # ---- MoE dispatch gauges (must precede step compilation: the stats
+        # are computed inside the jitted step) ------------------------------
+        self._resolve_moe_metrics()
 
         mcfg = getattr(self.model, "transformer_config", None)
         if (getattr(mcfg, "fpdt_offload", False)
@@ -295,6 +304,12 @@ class DeepSpeedTPUEngine:
                 memory_watermarks=tcfg.memory_watermarks,
                 trace_path=tcfg.trace_path, jsonl_path=tcfg.jsonl_path,
                 prometheus_path=tcfg.prometheus_path)
+            # the process-global program registry follows the tracer unless
+            # pinned; honor this engine's knob (last-constructed wins, the
+            # collectives-selector convention)
+            from deepspeed_tpu.telemetry import programs as programs_mod
+
+            programs_mod.configure(enabled=None if tcfg.programs else False)
             if tcfg.http_port is not None:
                 # scrapeable /metrics for the whole registry (training scalars
                 # ride the same exposition the serving SLO metrics use). The
@@ -410,6 +425,50 @@ class DeepSpeedTPUEngine:
             f"(vocab={mcfg.vocab_size}, global batch tokens<={tokens}) — "
             "backward all-gathers (ids, rows) pairs, no dense [V, H] psum",
             ranks=[0])
+
+    def _resolve_moe_metrics(self) -> None:
+        """With telemetry on and an MoE model, rebuild the spec with
+        ``moe_metrics=True`` so the gating math also emits its dispatch
+        stats (capacity occupancy, token drops, expert load balance — ROADMAP
+        item 4's instrumentation). The stats ride the step's metrics dict as
+        ``moe/*`` scalars: device-computed, fetched only at the existing
+        monitor/print sync points. Telemetry off ⇒ untouched spec ⇒
+        byte-identical program."""
+        self._moe_metrics = False
+        if not self.config.model.telemetry.enabled:
+            return
+        mcfg = getattr(self.model, "transformer_config", None)
+        if mcfg is None or not getattr(mcfg, "has_moe", False):
+            return
+        if self._zpp or self._onebit or self.offload_mode in ("host-jit", "nvme"):
+            # those step builders compute their losses inside their own
+            # micro fns — the stats side channel is not threaded through.
+            # A silently-dead gauge is worse than a log line.
+            log_dist(
+                "moe metrics: not wired into the zero++/1-bit/offload step "
+                "builders; moe/* gauges stay absent for this engine", ranks=[0])
+            return
+        if int(self.mesh.shape.get("pp", 1)) > 1:
+            # the pipelined loss threads a scalar aux through the pp ring;
+            # the stats dict can't ride it (pipelined_causal_lm_loss raises)
+            log_dist("moe metrics: skipped on pp>1 meshes (stats side channel "
+                     "not threaded through the pipeline ring)", ranks=[0])
+            return
+        if getattr(mcfg, "moe_metrics", False):
+            self._moe_metrics = True
+            return
+        if self.model.rebuild is None:
+            log_dist(
+                "moe metrics: model spec has no rebuild hook; construct with "
+                "TransformerConfig(moe_metrics=True) to opt in", ranks=[0])
+            return
+        import dataclasses as _dc
+
+        self.model = self.model.rebuild(_dc.replace(mcfg, moe_metrics=True))
+        self._moe_metrics = True
+        log_dist("moe metrics: dispatch gauges ENGAGED "
+                 "(moe/capacity_factor|token_drop_rate|expert_load_balance)",
+                 ranks=[0])
 
     def _configure_offload(self) -> None:
         """Resolve the ZeRO-Offload/Infinity mode from the config.
@@ -592,10 +651,23 @@ class DeepSpeedTPUEngine:
 
     def _wrap_jit(self, name: str, fn: Callable, arg_names=None) -> Callable:
         """Recompile-detector wrap for a jitted callable (identity when
-        diagnostics/recompile checking is off)."""
-        if self.diagnostics is None:
-            return fn
-        return self.diagnostics.wrap_jit(name, fn, arg_names=arg_names)
+        diagnostics/recompile checking is off).
+
+        With diagnostics off but telemetry on, the compiled-program registry
+        still wants the wrap point (telemetry/programs.py) — its watcher does
+        the same two cache-size probes and captures only on compile. With
+        both off the callable is returned untouched (byte-identical
+        dispatch, the zero-overhead contract)."""
+        if self.diagnostics is not None:
+            return self.diagnostics.wrap_jit(name, fn, arg_names=arg_names)
+        tcfg = self.config.model.telemetry
+        if fn is not None and tcfg.programs:
+            from deepspeed_tpu.telemetry.programs import get_program_registry
+
+            registry = get_program_registry()
+            if tcfg.enabled or registry.enabled:
+                return registry.wrap(fn, name, hbm_scope="train")
+        return fn
 
     @staticmethod
     def _build_engine_mesh(config) -> Mesh:
@@ -668,7 +740,13 @@ class DeepSpeedTPUEngine:
         estimate in the error. No-op when the device budget is undiscoverable
         (CPU backends) and no override is configured."""
         gcfg = self.config.model.hbm_guard
-        if not (gcfg.enabled or gcfg.warn):
+        self._hbm_estimate_bytes = None
+        # the estimate is also the calibration baseline the compiled-program
+        # registry reconciles XLA's memory_analysis against (hbm/estimate_
+        # ratio) — compute it when either consumer is live
+        want_calibration = (self.config.model.telemetry.enabled
+                            and self.config.model.telemetry.programs)
+        if not (gcfg.enabled or gcfg.warn or want_calibration):
             return
         from deepspeed_tpu.autotuning.autotuner import estimate_state_memory
         from deepspeed_tpu.utils.hbm import check_hbm_fit
@@ -700,6 +778,12 @@ class DeepSpeedTPUEngine:
             remat=bool(getattr(mcfg, "remat", True)),
             fused_ce=bool(getattr(mcfg, "fused_ce", False)),
         )
+        self._hbm_estimate_bytes = int(need)
+        from deepspeed_tpu.telemetry.programs import get_program_registry
+
+        get_program_registry().set_hbm_estimate(need, scope="train")
+        if not (gcfg.enabled or gcfg.warn):
+            return  # calibration-only probe: the guard itself is off
         override = (int(gcfg.device_memory_gb * (1 << 30))
                     if gcfg.device_memory_gb else None)
         check_hbm_fit(
@@ -1306,9 +1390,15 @@ class DeepSpeedTPUEngine:
             else:
                 compute_params = self._compute_params(state.params)
 
+            moe_stats_on = getattr(self, "_moe_metrics", False)
+
             def scaled_loss(p, micro, r):
                 loss, _aux = self._loss_and_aux(p, micro, r)
-                return (loss.astype(jnp.float32) * scale).astype(self.compute_dtype if self.fp16 else jnp.float32), loss
+                # MoE dispatch stats ride the grad aux (parallel/moe.py;
+                # model contract: the last aux element is a dict of scalars)
+                stats = (_aux[-1] if moe_stats_on and _aux
+                         and isinstance(_aux[-1], dict) else None)
+                return (loss.astype(jnp.float32) * scale).astype(self.compute_dtype if self.fp16 else jnp.float32), (loss, stats)
 
             grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
 
@@ -1318,13 +1408,14 @@ class DeepSpeedTPUEngine:
                     grads, loss = zpp_fn(
                         compute_params, micro_batch, scale, jax.random.key_data(jax.random.fold_in(step_rng, i))
                     )
+                    stats = None
                 else:
-                    (_, loss), grads = grad_fn(compute_params, micro_batch, jax.random.fold_in(step_rng, i))
+                    (_, (loss, stats)), grads = grad_fn(compute_params, micro_batch, jax.random.fold_in(step_rng, i))
                     grads = cast_floating(grads, accum_dtype)
                 acc = jax.tree_util.tree_map(lambda a, g: (a + g).astype(accum_dtype), acc, grads)
                 # shard the accumulator (stage>=2 => reduce-scatter per micro-batch)
                 acc = jax.lax.with_sharding_constraint(acc, grad_pspecs)
-                return (acc, i + 1), loss
+                return (acc, i + 1), (loss, stats)
 
             zero_grads = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, accum_dtype), state.params
@@ -1376,15 +1467,22 @@ class DeepSpeedTPUEngine:
                 return new_state, metrics
 
             if gas == 1:
-                (grads, _), losses = micro_step((zero_grads, 0), jax.tree_util.tree_map(lambda x: x[0], batch))
+                (grads, _), (losses, moe_stats) = micro_step(
+                    (zero_grads, 0), jax.tree_util.tree_map(lambda x: x[0], batch))
                 losses = losses[None]
             else:
-                (grads, _), losses = jax.lax.scan(micro_step, (zero_grads, 0), batch)
+                (grads, _), (losses, moe_stats) = jax.lax.scan(
+                    micro_step, (zero_grads, 0), batch)
 
             loss_mean = jnp.mean(losses.astype(jnp.float32))
             new_state, metrics = self._update_math(
                 state, grads, jax.random.key_data(rng), loss=loss_mean)
             metrics["loss"] = loss_mean
+            if moe_stats is not None:
+                # mean over micro-batches (scan stacked them); scalar per key
+                metrics.update({
+                    k: jnp.mean(jnp.asarray(v).astype(jnp.float32))
+                    for k, v in moe_stats.items()})
             return new_state, metrics
 
         return jax.jit(
@@ -1866,6 +1964,10 @@ class DeepSpeedTPUEngine:
         # step wall-clock for the anomaly detector (same honesty caveat as the
         # spans: dispatch time under async dispatch unless sync_spans drains)
         diag_t0 = time.perf_counter() if self.diagnostics is not None else None
+        if self.diagnostics is not None:
+            # an armed profiler-capture window starts here so the device
+            # trace brackets whole step dispatches
+            self.diagnostics.before_step(self._batch_count + 1)
         if self._train_step is None:  # offload split path
             if (prof.armed or config_fire) and not getattr(self, "_offload_prof_warned", False):
                 logger.warning(
@@ -1923,6 +2025,11 @@ class DeepSpeedTPUEngine:
                     for k in ("health/skip", "health/grad_zscore",
                               "health/nonfinite_total")
                     if k in metrics})
+            # MoE dispatch gauges (device-computed inside the step; ride the
+            # buffered bulk fetch with every other monitor scalar)
+            scalars.update({
+                f"Moe/{k[len('moe/'):]}": metrics[k]
+                for k in _MOE_METRIC_KEYS if k in metrics})
             if self._tracer.enabled:
                 # host-side floats only (counter deltas, memory watermarks,
                 # last phase wall times) — never a device fetch
@@ -1931,6 +2038,13 @@ class DeepSpeedTPUEngine:
         if step % self.config.model.steps_per_print == 0:
             # periodic sync point: one fetch per steps_per_print batches
             fetched = jax.device_get(metrics)
+            if self._tracer.enabled:
+                # moe/* registry gauges refresh at the existing sync cadence
+                # (ROADMAP item 4 instrumentation: capacity/drops/balance in
+                # the same exposition as every other subsystem)
+                for k in _MOE_METRIC_KEYS:
+                    if k in fetched:
+                        self._tracer.registry.gauge(k).set(float(fetched[k]))
             log_dist(
                 f"step={step} loss={float(fetched['loss']):.4f} lr={float(fetched['lr']):.3e} "
                 f"grad_norm={float(fetched['grad_norm']):.3f}",

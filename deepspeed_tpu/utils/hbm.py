@@ -27,6 +27,55 @@ class HBMBudgetError(RuntimeError):
     """Raised (mode='refuse') when an estimate exceeds the device budget."""
 
 
+def record_calibration(
+    estimate_bytes: int,
+    actual_peak_bytes: Optional[int],
+    *,
+    what: str,
+    warn_factor: float = 1.2,
+    registry=None,
+) -> Optional[float]:
+    """Reconcile a pre-flight estimate with XLA's own ``memory_analysis()``.
+
+    The guard's whole value is refusing BEFORE a wedge — which it can only do
+    if its byte math tracks reality. Every captured program's XLA peak
+    (argument + output − alias + temp) is compared against the estimate the
+    engine registered; the ratio lands as ``hbm/estimate_ratio`` (labelled
+    per program, plus an unlabelled last-program gauge), and an
+    under-estimate beyond ``warn_factor`` (default: actual >20% over the
+    estimate) warns loudly — that is the guard flying blind. Ratios well
+    below 1 are normal: the estimate covers the whole engine state while a
+    single program's peak covers only its live set.
+
+    Returns the ratio, or None when either side is unusable.
+    """
+    if not estimate_bytes or estimate_bytes <= 0 or not actual_peak_bytes:
+        return None
+    ratio = float(actual_peak_bytes) / float(estimate_bytes)
+    if registry is None:
+        from deepspeed_tpu.telemetry import get_tracer
+
+        tracer = get_tracer()
+        registry = tracer.registry if tracer.enabled else None
+    if registry is not None:
+        registry.gauge("hbm/estimate_ratio", program=what).set(ratio)
+        registry.gauge("hbm/estimate_ratio").set(ratio)
+    if ratio > warn_factor:
+
+        def fmt(b: float) -> str:
+            return (f"{b / (1 << 30):.2f} GiB" if b >= (1 << 28)
+                    else f"{b / (1 << 20):.2f} MiB")
+
+        logger.warning(
+            f"HBM calibration: program {what!r} peaks at "
+            f"{fmt(actual_peak_bytes)} per XLA memory_analysis but the "
+            f"pre-flight guard estimated {fmt(estimate_bytes)} "
+            f"({ratio:.2f}x) — the refuse-mode guard is under-estimating "
+            "and may admit a run that wedges the device; revisit "
+            "estimate_state_memory terms for this config.")
+    return ratio
+
+
 def device_memory_bytes(device=None) -> Optional[int]:
     """Best-effort per-device memory budget in bytes, or None if unknown.
 

@@ -26,10 +26,14 @@ ALLOWED_SUBSYSTEMS = {
     "ckpt",
     "coll",
     "comm",
+    "compile",
     "data",
     "flops",
+    "hbm",
     "health",
     "mem",
+    "moe",
+    "program",
     "recompile",
     "serving",
     "span",

@@ -1,0 +1,446 @@
+"""Compiled-program registry tests (ISSUE 7 tentpole contract).
+
+What every device-side observability claim leans on:
+  - the registry captures the engine's train-step and the v2 decode-chain
+    programs at their dispatch compile, with real cost/memory analysis
+  - collective ops are extracted from compiled HLO text (kind, bytes,
+    replica groups)
+  - the ``utils/hbm.py`` pre-flight estimate is reconciled against XLA's
+    peak (``hbm/estimate_ratio`` in the Prometheus exposition, loud warning
+    on under-estimates)
+  - recompile warnings carry the old/new HLO fingerprint
+  - anomaly/manual/SIGUSR2 triggers produce a ``jax.profiler`` trace
+  - disabled: no records, and engine dispatch is the raw jitted callable
+"""
+
+import contextlib
+import io
+import logging
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.telemetry import get_tracer
+from deepspeed_tpu.telemetry.programs import (
+    ProgramRegistry,
+    extract_collectives,
+    get_program_registry,
+    hlo_fingerprint,
+    unwrap_program_watch,
+)
+from deepspeed_tpu.utils.compat import shard_map
+from tests.unit.inference.test_inference_v2 import make_model
+
+
+@contextlib.contextmanager
+def _ds_log():
+    """Capture the deepspeed_tpu logger (its handler binds the import-time
+    stdout object, which pytest's capsys/capfd fixtures cannot intercept)."""
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    ds_logger.addHandler(handler)
+    try:
+        yield buf
+    finally:
+        ds_logger.removeHandler(handler)
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    tr = get_tracer()
+    reg = get_program_registry()
+    for _ in range(1):
+        tr.configure(enabled=False)
+        tr.trace_path = None
+        tr.jsonl_path = None
+        tr.reset()
+        reg.configure(enabled=None)
+        reg.reset()
+    yield
+    tr.configure(enabled=False)
+    tr.trace_path = None
+    tr.jsonl_path = None
+    tr.reset()
+    reg.configure(enabled=None)
+    reg.reset()
+
+
+def _tiny_lm():
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    cfg = TransformerConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_layers=2, num_heads=4, max_seq_len=64)
+    return cfg, causal_lm_spec(cfg, example_seq_len=16)
+
+
+def _train_engine(telemetry=True, **extra):
+    cfg, spec = _tiny_lm()
+    eng, *_ = deepspeed_tpu.initialize(
+        model=spec,
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "steps_per_print": 10_000,
+            **({"telemetry": {"enabled": True}} if telemetry else {}),
+            **extra,
+        })
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (eng.train_batch_size, 16), dtype=np.int32)}
+    return eng, batch
+
+
+# --------------------------------------------------------- HLO text analysis
+CANNED_HLO = """\
+HloModule jit_f, input_output_alias={ {0}: (0, {}, may-alias) }, entry_computation_layout={(f32[8,128]{1,0})->f32[8,128]{1,0}}
+
+ENTRY %main (p0: f32[8,128]) -> f32[8,128] {
+  %p0 = f32[8,128]{1,0} parameter(0)
+  %all-reduce = f32[8,128]{1,0} all-reduce(f32[8,128]{1,0} %p0), replica_groups={{0,1,2,3}}, to_apply=%add
+  %ag = f32[16,128]{1,0} all-gather(f32[8,128]{1,0} %all-reduce), replica_groups=[2,2]<=[4], dimensions={0}
+  ROOT %done = f32[8,128]{1,0} slice(f32[16,128]{1,0} %ag), slice={[0:8], [0:128]}
+}
+"""
+
+
+def test_extract_collectives_from_hlo_text():
+    colls = extract_collectives(CANNED_HLO)
+    kinds = [c["kind"] for c in colls]
+    assert kinds == ["all-reduce", "all-gather"]
+    assert colls[0]["bytes"] == 8 * 128 * 4
+    assert colls[0]["replica_groups"] == "{{0,1,2,3}}"
+    assert colls[1]["bytes"] == 16 * 128 * 4
+    assert colls[1]["replica_groups"] == "[2,2]<=[4]"
+
+
+def test_hlo_fingerprint_stable_and_counts():
+    fp1, n1 = hlo_fingerprint(CANNED_HLO)
+    fp2, n2 = hlo_fingerprint(CANNED_HLO)
+    assert fp1 == fp2 and len(fp1) == 12
+    assert n1 == n2 == 4  # p0, all-reduce, ag, done
+    fp3, _ = hlo_fingerprint(CANNED_HLO + "\n")
+    assert fp3 != fp1  # content hash, not structure hash
+
+
+# ------------------------------------------------------------- train capture
+def test_train_step_capture_costs_and_exposition():
+    """The engine's train step lands in the registry with nonzero flops and
+    peak HBM, calibrated against the pre-flight estimate, and rides the
+    Prometheus exposition."""
+    eng, batch = _train_engine(telemetry=True)
+    eng.train_batch(batch)
+    reg = get_program_registry()
+
+    rec = reg.latest("train_step")
+    assert rec is not None
+    assert rec.flops > 0 and rec.bytes_accessed > 0
+    assert rec.peak_hbm_bytes > 0
+    assert rec.fingerprint and rec.instruction_count > 0
+    assert rec.compile_wall_s is not None and rec.compile_wall_s > 0
+    # calibration: the engine registered its utils/hbm.py estimate
+    assert reg.hbm_estimate("train") and reg.hbm_estimate("train") > 0
+    assert rec.hbm_estimate_ratio is not None and rec.hbm_estimate_ratio > 0
+
+    from deepspeed_tpu.telemetry.exposition import render_prometheus
+
+    prom = render_prometheus(get_tracer().registry)
+    assert 'dstpu_program_flops{program="train_step"}' in prom
+    assert 'dstpu_program_peak_hbm_bytes{program="train_step"}' in prom
+    assert "dstpu_hbm_estimate_ratio" in prom
+    assert 'dstpu_compile_count_total{program="train_step"}' in prom
+
+    # a second step of the same shape compiles nothing -> no new capture
+    n = len(reg.records())
+    eng.train_batch(batch)
+    assert len(reg.records()) == n
+
+
+def test_decode_chain_capture_serving_scope():
+    """The v2 decode-chain program is captured with costs and calibrated
+    against the serving-scope estimate."""
+    get_tracer().configure(enabled=True)
+    cfg, _, params = make_model()
+    from deepspeed_tpu.inference import InferenceEngineV2
+
+    eng = InferenceEngineV2(cfg, params, {
+        "dtype": "fp32", "kv_block_size": 4, "num_kv_blocks": 64,
+        "chunk_bucket": 8, "decode_chain": 4, "hbm_check": "off"})
+    prompts = [np.arange(5, dtype=np.int64), np.arange(3, dtype=np.int64)]
+    eng.generate(prompts, max_new_tokens=8)
+
+    reg = get_program_registry()
+    chains = [lbl for lbl in reg.labels() if lbl.startswith("v2:decode_chain")]
+    assert chains, f"no decode-chain capture in {reg.labels()}"
+    rec = reg.latest(chains[0])
+    assert rec.flops > 0 and rec.peak_hbm_bytes > 0
+    # hbm_check "off" still registers the serving estimate while capture is on
+    assert reg.hbm_estimate("serving") and rec.hbm_estimate_ratio is not None
+    # prefill (fused-sampling step) captured too
+    assert any(lbl.startswith("v2:prefill") for lbl in reg.labels())
+
+
+def test_collective_extraction_on_compiled_psum():
+    """A program containing a real psum shows an all-reduce with payload
+    bytes in its registry record (full-manual shard_map on the 8-CPU mesh)."""
+    devs = jax.devices()[:8]
+    mesh = Mesh(np.array(devs), ("dp",))
+    reg = get_program_registry().configure(enabled=True)
+
+    fn = jax.jit(shard_map(
+        lambda v: jax.lax.psum(v, "dp"),
+        mesh=mesh, in_specs=P("dp"), out_specs=P(None)))
+    x = jnp.arange(8 * 32, dtype=jnp.float32).reshape(8, 32)
+    rec = reg.capture(fn, x, label="psum_probe")
+    assert rec is not None
+    ars = [c for c in rec.collectives if c["kind"] == "all-reduce"]
+    assert ars, f"no all-reduce in {rec.collectives}"
+    assert all(c["bytes"] > 0 for c in ars)
+    assert rec.collective_bytes >= ars[0]["bytes"]
+
+
+# ------------------------------------------------------------- disabled mode
+def test_disabled_allocates_nothing_and_leaves_dispatch_untouched():
+    """Telemetry off: no records, no estimates, and the engine's jitted
+    callables are the raw jit objects (no watcher layer), with the jit cache
+    size unchanged by stepping."""
+    eng, batch = _train_engine(telemetry=False)
+    eng.train_batch(batch)
+    eng.train_batch(batch)
+
+    reg = get_program_registry()
+    assert reg.records() == []
+    assert not reg.enabled
+    # dispatch untouched: the train step is the bare jit (not a watcher)...
+    assert unwrap_program_watch(eng._train_step) is eng._train_step
+    assert type(eng._train_step).__name__ not in ("_Watch", "_WrappedJit")
+    # ...and exactly one compiled program in its cache
+    assert eng._train_step._cache_size() == 1
+
+    get_tracer().configure(enabled=False)
+    cfg, _, params = make_model()
+    from deepspeed_tpu.inference import InferenceEngineV2
+
+    v2 = InferenceEngineV2(cfg, params, {
+        "dtype": "fp32", "kv_block_size": 4, "num_kv_blocks": 64,
+        "chunk_bucket": 8, "decode_chain": 4, "hbm_check": "off"})
+    v2.generate([np.arange(5, dtype=np.int64)], max_new_tokens=4)
+    assert reg.records() == []
+    for fn in v2._step_cache.values():
+        assert unwrap_program_watch(fn) is fn
+
+
+def test_explicit_capture_failure_is_safe():
+    reg = ProgramRegistry().configure(enabled=True)
+    rec = reg.capture(lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+                      label="broken")
+    assert rec is None and reg.capture_failures == 1
+
+
+def test_explicit_capture_dedupes_unchanged_program():
+    """Repeated capture() of the same program returns the existing record —
+    a per-step compiled_cost loop must not grow the inventory unboundedly."""
+    reg = ProgramRegistry().configure(enabled=True)
+    f = jax.jit(lambda x: (x * 3.0).sum())
+    x = jnp.ones((8, 8))
+    r1 = reg.capture(f, x, label="loop")
+    r2 = reg.capture(f, x, label="loop")
+    assert r1 is r2 and len(reg.records()) == 1
+    # a different program under the same label is a new record
+    r3 = reg.capture(f, jnp.ones((8, 16)), label="loop")
+    assert r3 is not r1 and len(reg.records()) == 2
+
+
+def test_capture_survives_recompile_detection_disabled():
+    """diagnostics on + recompile checking off must not silently lose
+    program capture — the manager falls back to the registry watcher."""
+    eng, batch = _train_engine(
+        telemetry=True,
+        diagnostics={"enabled": True, "recompile": {"enabled": False},
+                     "health": {"enabled": False}})
+    eng.train_batch(batch)
+    rec = get_program_registry().latest("train_step")
+    assert rec is not None and rec.flops > 0
+
+
+# --------------------------------------------------- recompile fingerprints
+def test_recompile_warning_carries_hlo_fingerprint():
+    """A recompile report names the old and new HLO identity (hash +
+    instruction count) — what GREW, not just which argument drifted."""
+    get_tracer().configure(enabled=True)
+    from deepspeed_tpu.diagnostics import RecompileDetector
+
+    det = RecompileDetector("test")
+    f = det.wrap(jax.jit(lambda x: (x * 2.0).sum()), "toy")
+    f(jnp.ones((4, 8)))
+    with _ds_log() as buf:
+        f(jnp.ones((4, 16)))  # forced recompile
+    evs = [e for e in det.events if e["kind"] == "recompile"]
+    assert evs, "no recompile event"
+    assert evs[0]["hlo"]["fingerprint"] and evs[0]["hlo"]["instructions"] > 0
+    # the initial compile carried its own fingerprint too (the "old" side)
+    initial = [e for e in det.events if e["kind"] == "initial"][0]
+    assert initial["hlo"]["fingerprint"]
+    text = buf.getvalue()
+    assert "RECOMPILE" in text and "HLO" in text and "instr" in text
+
+
+# ------------------------------------------------------------ profiler capture
+def test_profiler_capture_window(tmp_path):
+    """arm() -> the next N step brackets run under jax.profiler and the
+    trace directory is recorded (and referenced from the flight recorder)."""
+    from deepspeed_tpu.diagnostics import FlightRecorder
+    from deepspeed_tpu.profiling.capture import ProfilerCapture
+
+    rec = FlightRecorder(capacity=8, dump_dir=str(tmp_path / "fr"))
+    cap = ProfilerCapture(steps=2, out_dir=str(tmp_path / "prof"),
+                          cooldown_steps=100, recorder=rec)
+    assert not cap.active
+    cap.arm(reason="test")
+    step_fn = jax.jit(lambda x: (x * x).sum())
+    for step in (1, 2, 3):
+        cap.on_step_start(step)
+        np.asarray(step_fn(jnp.ones((32, 32))))
+        cap.on_step_end(step)
+    assert len(cap.captures) == 1
+    window = cap.captures[0]
+    assert window["reason"] == "test"
+    assert window["first_step"] == 1 and window["last_step"] == 2
+    files = [os.path.join(r, f) for r, _, fs in os.walk(window["trace_dir"])
+             for f in fs]
+    assert files, f"no trace files under {window['trace_dir']}"
+    # the crash-dump header names the freshest device trace
+    assert rec._context["profiler_trace"] == window["trace_dir"]
+    # cooldown: a second arm right after is dropped at the next boundary
+    cap.arm(reason="too-soon")
+    cap.on_step_start(4)
+    assert not cap.active
+
+
+def test_anomaly_flags_arm_capture(tmp_path):
+    """A straggler flag from the step-time detector arms the capture; the
+    window starts at the next step boundary."""
+    from deepspeed_tpu.config.config import DiagnosticsConfig
+    from deepspeed_tpu.diagnostics.manager import DiagnosticsManager
+
+    cfg = DiagnosticsConfig(**{
+        "enabled": True,
+        "health": {"enabled": False},
+        "flight_recorder": {"enabled": False},
+        "step_time": {"enabled": True, "window": 8, "min_samples": 4,
+                      "straggler_factor": 2.0},
+        "profiler_capture": {"enabled": True, "steps": 1,
+                             "dir": str(tmp_path / "prof"),
+                             "cooldown_steps": 0, "signal": False},
+    })
+    mgr = DiagnosticsManager(cfg)
+    assert mgr.profiler_capture is not None
+    for step in range(1, 7):
+        mgr.before_step(step)
+        mgr.after_step(step, {}, step_time_s=0.01)
+    # straggler: 10x the rolling median
+    mgr.before_step(7)
+    mgr.after_step(7, {}, step_time_s=0.1)
+    assert mgr.profiler_capture._armed_reason is not None
+    assert "straggler" in mgr.profiler_capture._armed_reason
+    step_fn = jax.jit(lambda x: x + 1)
+    mgr.before_step(8)  # window opens at the next boundary
+    np.asarray(step_fn(jnp.ones((8,))))
+    mgr.after_step(8, {}, step_time_s=0.01)
+    assert len(mgr.profiler_capture.captures) == 1
+    assert "straggler" in mgr.profiler_capture.captures[0]["reason"]
+
+
+def test_sigusr2_arms_capture(tmp_path):
+    from deepspeed_tpu.profiling import capture as cap_mod
+
+    cap = cap_mod.ProfilerCapture(steps=1, out_dir=str(tmp_path))
+    cap_mod.install_sigusr2()
+    try:
+        os.kill(os.getpid(), signal.SIGUSR2)
+        assert cap._armed_reason == "signal:SIGUSR2"
+    finally:
+        cap._armed_reason = None
+
+
+# ------------------------------------------------------------- hbm calibration
+def test_record_calibration_warns_on_underestimate():
+    from deepspeed_tpu.utils.hbm import record_calibration
+
+    tr = get_tracer().configure(enabled=True)
+    with _ds_log() as buf:
+        ratio = record_calibration(100, 90, what="close")  # within 1.2x: quiet
+    assert ratio == pytest.approx(0.9)
+    assert "HBM calibration" not in buf.getvalue()
+    with _ds_log() as buf:
+        ratio = record_calibration(100, 150, what="blown")
+    assert ratio == pytest.approx(1.5)
+    assert "HBM calibration" in buf.getvalue()
+    assert tr.registry.gauge("hbm/estimate_ratio").value == pytest.approx(1.5)
+    # unusable inputs -> None, never a crash
+    assert record_calibration(0, 100, what="x") is None
+    assert record_calibration(100, None, what="x") is None
+
+
+# ---------------------------------------------------------- moe gauge plumbing
+def test_moe_dispatch_stats_ride_step_metrics():
+    """MoE engines with telemetry on emit device-computed moe/* scalars in
+    the step metrics and refresh registry gauges at the print cadence."""
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    cfg = TransformerConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, max_seq_len=64, num_experts=4, moe_top_k=2,
+        moe_capacity_factor=1.25)
+    eng, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=16),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "steps_per_print": 1,
+            "telemetry": {"enabled": True},
+        })
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (eng.train_batch_size, 16), dtype=np.int32)}
+    metrics = eng.train_batch(batch)
+    vals = jax.device_get({k: metrics[k] for k in (
+        "moe/capacity_factor", "moe/token_drop_rate", "moe/expert_load_balance")})
+    assert float(vals["moe/capacity_factor"]) > 0
+    assert 0.0 <= float(vals["moe/token_drop_rate"]) <= 1.0
+    assert float(vals["moe/expert_load_balance"]) >= 1.0 - 1e-6
+    # steps_per_print=1 -> the sync point refreshed the registry gauges
+    reg = get_tracer().registry
+    assert reg.gauge("moe/capacity_factor").value > 0
+    from deepspeed_tpu.telemetry.exposition import render_prometheus
+
+    assert "dstpu_moe_expert_load_balance" in render_prometheus(reg)
+
+
+def test_moe_stats_off_without_telemetry():
+    """Telemetry off: the model spec is untouched and no moe/* keys appear
+    (byte-identical step program contract)."""
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    cfg = TransformerConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, max_seq_len=64, num_experts=4, moe_top_k=2)
+    eng, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=16),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "steps_per_print": 10_000,
+        })
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (eng.train_batch_size, 16), dtype=np.int32)}
+    metrics = eng.train_batch(batch)
+    assert not [k for k in metrics if k.startswith("moe/")]
+    assert eng.model.transformer_config.moe_metrics is False

@@ -38,9 +38,25 @@ JAX_PLATFORMS=cpu python tools/fault_smoke.py 2>/dev/null | tee -a "${OUT}"
 smoke_rc=${PIPESTATUS[0]}
 [ "${smoke_rc}" -ne 0 ] && rc=1
 
+# Compiled-program inventory (ISSUE 7): the registry must capture a real
+# train-step and v2 decode-chain program with nonzero flops/peak-HBM and a
+# computed hbm/estimate_ratio. Committed alongside this log as its own
+# artifact so the device-side inventory is auditable per round.
+PROG_OUT="PROGRAMS_${ROUND}.log"
 {
-  echo "# exit code: ${rc} (fault smoke: ${smoke_rc})"
+  echo "# program inventory — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  echo "# HEAD: ${HEAD_SHA}"
+  echo "# uncommitted-diff sha256: ${DIFF_SHA}"
+  echo "# cmd: python tools/program_report.py"
+} > "${PROG_OUT}"
+JAX_PLATFORMS=cpu python tools/program_report.py 2>/dev/null | tee -a "${PROG_OUT}"
+prog_rc=${PIPESTATUS[0]}
+[ "${prog_rc}" -ne 0 ] && rc=1
+echo "# program inventory: ${PROG_OUT} (exit ${prog_rc})" >> "${OUT}"
+
+{
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, program report: ${prog_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
-echo "wrote ${OUT}"
+echo "wrote ${OUT} ${PROG_OUT}"
 exit "${rc}"
