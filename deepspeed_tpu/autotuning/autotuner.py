@@ -32,8 +32,10 @@ def estimate_state_memory(n_params: int, zero_stage: int, dp_world: int,
                           hidden_size: int = 0,
                           num_layers: int = 0,
                           vocab_size: int = 0,
+                          num_heads: int = 0,
                           remat: bool = True,
-                          fused_ce: bool = False) -> int:
+                          fused_ce: bool = False,
+                          flash_attention: bool = False) -> int:
     """Bytes/device for params+grads+optimizer state under a ZeRO stage
     (reference ``tuner/model_based_tuner.py`` memory model; Adam opt_factor=2
     fp32 moments), plus — when the model/batch geometry is given — the
@@ -50,9 +52,26 @@ def estimate_state_memory(n_params: int, zero_stage: int, dp_world: int,
     - logits + CE softmax grad: [micro, seq, vocab] in fp32 ×2 — the single
       biggest transient for big-vocab models; fused (chunked) CE reduces it
       to ~1/8
+    - XLA temp/fusion workspace (the blind spot PR-7's calibration surfaced:
+      ``hbm/estimate_ratio`` ~5x on the bf16 stage-1 CPU bench config —
+      ``temp_bytes`` dominated the peak while every term above tracked the
+      persistent state). Three structural contributors, coefficients fitted
+      against ``memory_analysis().temp_size_in_bytes`` over layer/seq/batch
+      sweeps of the bench model (each within ~15%):
+        * non-flash attention backward materializes the score matrix class
+          ~5x in fp32 per layer ([micro, heads, seq, seq]: scores, probs,
+          both grads + a cast copy) — one live layer under remat (scores
+          are recomputed per layer), zero when ``flash_attention`` (the
+          Pallas kernel never materializes scores, that being the point)
+        * CE backward holds ~2 more fp32 logit-class arrays beyond the
+          counted pair (log-softmax + dlogits), same 1/8 fused-CE discount
+        * dense/MLP fusion gradients: ~8 fp32 [micro, seq, hidden] per
+          layer un-remat (~4 with remat: one layer recomputes at a time,
+          but boundary residual grads persist)
 
     The positional-args form is unchanged (grads term == accumulator at
-    ``dtype_bytes``), so existing callers see identical estimates.
+    ``dtype_bytes``), so existing callers see identical estimates — the
+    temp terms engage only when the model/batch geometry is given.
     """
     P = n_params
     params_b = P * dtype_bytes
@@ -72,9 +91,19 @@ def estimate_state_memory(n_params: int, zero_stage: int, dp_world: int,
         act_bytes = compute_dtype_bytes or 2
         per_layer = 2 if remat else 12
         total += tokens * hidden_size * act_bytes * num_layers * per_layer
+        # XLA fusion-gradient workspace (fp32)
+        total += tokens * hidden_size * 4 * num_layers * (4 if remat else 8)
     if tokens and vocab_size:
         logit_b = tokens * vocab_size * 4 * 2  # fp32 logits + softmax grad
+        logit_b += tokens * vocab_size * 4 * 2  # CE bwd transients (temp)
         total += logit_b // 8 if fused_ce else logit_b
+    if tokens and num_heads and seq_len and num_layers and not flash_attention:
+        # materialized-attention backward workspace (fp32 score-matrix
+        # class); under remat one layer's scores are recomputed/live at a
+        # time, so the term must not scale with depth there — a 48-layer
+        # remat'd model would otherwise be rejected by hundreds of GiB
+        live_layers = 1 if remat else num_layers
+        total += micro_batch * num_heads * seq_len * seq_len * 4 * live_layers * 5
     return total
 
 

@@ -27,6 +27,9 @@ from deepspeed_tpu.collectives.algorithms import (
     all_reduce,
     reduce_scatter,
 )
+from deepspeed_tpu.collectives.pallas_backend import (
+    PALLAS_ALGORITHMS,
+)
 from deepspeed_tpu.collectives.selector import (
     Decision,
     configure,

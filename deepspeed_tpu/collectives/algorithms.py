@@ -41,27 +41,38 @@ import jax.numpy as jnp
 
 from deepspeed_tpu import telemetry
 from deepspeed_tpu.collectives.codecs import Codec, get_codec
+from deepspeed_tpu.collectives import pallas_backend
+from deepspeed_tpu.collectives.pallas_backend import PALLAS_ALGORITHMS
 from deepspeed_tpu.utils.compat import axis_size
 
 ALGORITHMS = ("ring", "bidir", "rhd", "ring2d")
 
 
 def _permute_wire(wire, axis, perm):
-    """Permute every leaf of a wire pytree one hop (facade ppermute so each
-    leaf transfer is a traced ``comm:ppermute`` span)."""
+    """Permute every leaf of a wire pytree one hop. On the default backend
+    each leaf is a facade ``ppermute`` (a traced ``comm:ppermute`` span);
+    inside a :func:`pallas_backend.hop_scope` the whole wire moves in ONE
+    remote-DMA kernel (a ``comm:remote_dma`` span)."""
+    if pallas_backend.hops_active() and pallas_backend.remote_dma_supported():
+        return pallas_backend.permute_wire(wire, axis, perm)
     from deepspeed_tpu.comm import comm as dist
 
     return jax.tree_util.tree_map(
         lambda w: w if w.size == 0 else dist.ppermute(w, axis, perm), wire)
 
 
-def _hop_span(name: str, axis, hop: int, codec: Codec):
+def _hop_span(name: str, axis, hop: int, codec: Codec, **tags):
     tracer = telemetry.get_tracer()
     if not tracer.enabled:
         return telemetry.NOOP_SPAN
     axis_str = "+".join(axis) if isinstance(axis, (tuple, list)) else str(axis)
+    if pallas_backend.hops_active():
+        # honest transport label: interpret mode on a multi-axis mesh falls
+        # back to ppermute hops (see pallas_backend.remote_dma_supported)
+        tags.setdefault("backend", "pallas" if pallas_backend.remote_dma_supported()
+                        else "ppermute_fallback")
     return tracer.span(f"coll:{name}", cat="coll", axis=axis_str, hop=hop,
-                       codec=codec.name)
+                       codec=codec.name, **tags)
 
 
 def _ring_perm(n: int, reverse: bool = False):
@@ -189,6 +200,16 @@ def _ring_reduce_scatter_rows(rows: jax.Array, axis, codec: Codec, *,
     ``sub = (n, rank, perm, span_label)`` runs the schedule on a sub-ring
     of the axis (see :func:`_ring_all_gather_flat`).
     """
+    if (err is None and pallas_backend.hops_active()
+            and pallas_backend.fusable(codec, rows.dtype)
+            and pallas_backend.remote_dma_supported()):
+        # EQuARX transport: the whole encode -> hop -> decode-accumulate
+        # chain runs inside one Pallas kernel per hop (same schedule, fused
+        # execution); exact wires and integer payloads fall through to the
+        # generic loop below, whose hops remote-DMA the wire instead
+        out = pallas_backend.fused_ring_reduce_scatter_rows(
+            rows, axis, codec, reverse=reverse, sub=sub)
+        return out, None
     if sub is not None:
         n, i, perm, label = sub
         step = 1
@@ -429,7 +450,18 @@ def all_reduce(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
         raise ValueError(f"reduce op {op!r} unsupported by algorithmic all_reduce")
     axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
     flat = x.reshape(-1)
-    if len(axes) > 1:
+    if algorithm in PALLAS_ALGORITHMS:
+        # same schedules, remote-DMA hops (fused quantized hops on the
+        # reduce phases — see collectives/pallas_backend.py); axis tuples
+        # run the mesh-axis-factored hierarchy like every other algorithm
+        with pallas_backend.hop_scope():
+            if len(axes) > 1:
+                out = _hier_all_reduce_axes(x, axes, c).reshape(-1)
+            elif algorithm == "pallas_ring":
+                out = _flat_all_reduce_ring(flat, axes[0], c)
+            else:  # pallas_ring2d: the SAME a x b factorization
+                out = _flat_all_reduce_ring2d(flat, axes[0], c)
+    elif len(axes) > 1:
         out = _hier_all_reduce_axes(x, axes, c).reshape(-1)
     elif algorithm == "ring":
         out = _flat_all_reduce_ring(flat, axes[0], c)
@@ -440,7 +472,8 @@ def all_reduce(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
     elif algorithm == "ring2d":
         out = _flat_all_reduce_ring2d(flat, axes[0], c)
     else:
-        raise ValueError(f"unknown algorithm {algorithm!r} (one of {ALGORITHMS})")
+        raise ValueError(
+            f"unknown algorithm {algorithm!r} (one of {ALGORITHMS + PALLAS_ALGORITHMS})")
     out = out.reshape(x.shape)
     if op in ("mean", "avg"):
         total = 1
@@ -457,6 +490,11 @@ def all_gather(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
             raise ValueError(f"algorithmic all_gather takes one axis, got {axis}")
         axis = axis[0]
     c = get_codec(codec, block_size)
+    if algorithm in PALLAS_ALGORITHMS:
+        # gathers have no reduction to fuse: encode-once relay over
+        # remote-DMA hops (ring2d degrades to ring, same as below)
+        with pallas_backend.hop_scope():
+            return ring_all_gather(x, axis, c, concat_axis=concat_axis)
     if algorithm == "ring":
         return ring_all_gather(x, axis, c, concat_axis=concat_axis)
     if algorithm == "bidir":
@@ -467,7 +505,8 @@ def all_gather(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
         # the hierarchy only exists for reductions: a non-reducing ring2d is
         # a plain ring (exactly what the cost model charges it as)
         return ring_all_gather(x, axis, c, concat_axis=concat_axis)
-    raise ValueError(f"unknown algorithm {algorithm!r} (one of {ALGORITHMS})")
+    raise ValueError(
+        f"unknown algorithm {algorithm!r} (one of {ALGORITHMS + PALLAS_ALGORITHMS})")
 
 
 def reduce_scatter(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
@@ -482,6 +521,11 @@ def reduce_scatter(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
     if err is not None and algorithm != "ring":
         raise ValueError(
             f"error feedback is implemented for algorithm='ring' only, got {algorithm!r}")
+    if algorithm in PALLAS_ALGORITHMS:
+        # remote-DMA hops; a fusable codec runs the EQuARX fused hop kernel
+        # (ring2d degrades to ring for a lone reduce-scatter, same as below)
+        with pallas_backend.hop_scope():
+            return ring_reduce_scatter(x, axis, c, scatter_axis=scatter_axis, op=op)
     if algorithm == "ring":
         return ring_reduce_scatter(x, axis, c, scatter_axis=scatter_axis, op=op, err=err)
     if algorithm == "bidir":
@@ -494,4 +538,5 @@ def reduce_scatter(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
         # the hierarchy only exists for reductions over BOTH tiers at once:
         # a lone reduce-scatter rides the plain ring (the model's costing)
         return ring_reduce_scatter(x, axis, c, scatter_axis=scatter_axis, op=op)
-    raise ValueError(f"unknown algorithm {algorithm!r} (one of {ALGORITHMS})")
+    raise ValueError(
+        f"unknown algorithm {algorithm!r} (one of {ALGORITHMS + PALLAS_ALGORITHMS})")

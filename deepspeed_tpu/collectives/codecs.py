@@ -30,7 +30,6 @@ import jax
 import jax.numpy as jnp
 
 DEFAULT_BLOCK = 2048
-_FP8_MAX = 448.0  # float8_e4m3fn max normal
 
 
 class Wire(NamedTuple):
@@ -179,20 +178,21 @@ class Fp8Codec(_BlockQuantCodec):
     name = "fp8"
 
     def encode_rows(self, x: jax.Array) -> Wire:
+        from deepspeed_tpu.ops.quant import fp8_block_math
+
         R, _ = x.shape
         block = min(self.block_size, x.shape[1])
         xp, Lp = _pad_rows(x.astype(jnp.float32), block)
-        b = xp.reshape(R, Lp // block, block)
-        absmax = jnp.max(jnp.abs(b), axis=-1, keepdims=True)
-        scale = jnp.where(absmax == 0.0, 1.0, absmax / _FP8_MAX)
-        q = (b / scale).astype(jnp.float8_e4m3fn)
+        q, scale = fp8_block_math(xp.reshape(R * (Lp // block), block))
         return Wire(q=q.reshape(R, Lp), s=scale.reshape(R, Lp // block))
 
     def decode_rows(self, wire: Wire, length: int, dtype) -> jax.Array:
+        from deepspeed_tpu.ops.quant import fp8_block_dequant
+
         R, Lp = wire.q.shape
         block = Lp // wire.s.shape[1]
-        b = wire.q.reshape(R, Lp // block, block).astype(jnp.float32)
-        out = b * wire.s[..., None]
+        out = fp8_block_dequant(wire.q.reshape(-1, block),
+                                wire.s.reshape(-1, 1))
         return out.reshape(R, Lp)[:, :length].astype(dtype)
 
 

@@ -20,6 +20,12 @@ Two shapes:
 Adopted by the zeropp qwZ gather path (``parallel/zeropp.py``): the int8
 weight all-gather splits its wire into chunks so dequantize of chunk k
 overlaps the gather of chunk k+1.
+
+The Pallas collective backend moves this same pattern INSIDE a kernel:
+``pallas_backend._fused_hop_kernel`` double-buffers wire chunks across its
+grid so the remote DMA of chunk k+1 hides behind the dequant-accumulate of
+chunk k — per hop, with no XLA scheduler in the loop. These helpers remain
+the program-level shape for overlap XLA can schedule.
 """
 
 from __future__ import annotations

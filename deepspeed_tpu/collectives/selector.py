@@ -32,6 +32,8 @@ from typing import Dict, List, Optional, Tuple
 from deepspeed_tpu import telemetry
 from deepspeed_tpu.collectives.algorithms import ALGORITHMS, _factor_near_square
 from deepspeed_tpu.collectives.codecs import get_codec
+from deepspeed_tpu.collectives import pallas_backend
+from deepspeed_tpu.collectives.pallas_backend import PALLAS_ALGORITHMS
 from deepspeed_tpu.utils.logging import logger
 
 OPS = ("all_reduce", "all_gather", "reduce_scatter")
@@ -69,6 +71,11 @@ class SelectorConfig:
     # collective at any alpha; the "lax" verdict is the model's analog of
     # measured mode's don't-bother rows
     min_algorithmic_bytes: int = 1 << 12
+    # Alpha discount for the pallas remote-DMA hop primitive: a fused hop is
+    # one kernel where the ppermute path dispatches encode + permute +
+    # decode programs, so its per-hop launch overhead is lower. Candidates
+    # only enter the model when pallas_backend.available() (a real TPU).
+    pallas_alpha_scale: float = 0.5
     # Facade defaults (the `collectives` config block's algorithm/codec):
     # applied by comm.all_reduce/all_gather/reduce_scatter when the call
     # passes no explicit algorithm/codec. None = plain jax.lax lowering.
@@ -78,7 +85,7 @@ class SelectorConfig:
 
 _lock = threading.Lock()
 _config = SelectorConfig()
-_cache: Dict[Tuple[str, int, int, Optional[str], int], Decision] = {}
+_cache: Dict[Tuple[str, int, int, Optional[str], int, str], Decision] = {}
 _measured: List[dict] = []
 _stats = {"hits": 0, "misses": 0}
 
@@ -126,6 +133,10 @@ def _hops_and_volume(op: str, algorithm: str, nbytes: int, n: int) -> Tuple[int,
     volume ``2(n-1)/n * S`` / ``(n-1)/n * S``); for all_gather it is the
     SHARD, of which every link relays n-1 peers' worth: ``(n-1) * s``.
     """
+    # pallas algorithms run the SAME schedules as their base (identical hop
+    # counts and link volumes) — only the hop primitive and the per-hop
+    # alpha differ (applied in estimate_us)
+    algorithm = pallas_backend.base_algorithm(algorithm)
     ring_steps = n - 1
     log_steps = max(int(math.ceil(math.log2(n))), 1) if n > 1 else 0
     frac = (n - 1) / n if n > 1 else 0.0
@@ -179,7 +190,9 @@ def estimate_us(op: str, algorithm: str, codec: str, nbytes: int, n: int,
     hops, vol = _hops_and_volume(op, algorithm, nbytes, n)
     c = get_codec(codec, cfg.block_size)
     wire = c.wire_bytes(max(int(vol // itemsize), 1), itemsize)
-    return hops * cfg.alpha_us + (wire / 1e6) * cfg.beta_us_per_mb
+    alpha = cfg.alpha_us * (cfg.pallas_alpha_scale
+                            if pallas_backend.is_pallas(algorithm) else 1.0)
+    return hops * alpha + (wire / 1e6) * cfg.beta_us_per_mb
 
 
 def _model_pick(op: str, nbytes: int, n: int, codec: Optional[str],
@@ -204,7 +217,8 @@ def _model_pick(op: str, nbytes: int, n: int, codec: Optional[str],
         best = Decision(op, "lax", "none",
                         estimate_us(op, "lax", "none", nbytes, n, cfg, itemsize),
                         "model")
-    for alg in ALGORITHMS:
+    candidates = ALGORITHMS + (PALLAS_ALGORITHMS if pallas_backend.available() else ())
+    for alg in candidates:
         if alg == "rhd" and not pow2:
             continue
         for cd in codecs:
@@ -228,7 +242,7 @@ def _measured_pick(op: str, nbytes: int, n: int, codec: Optional[str],
             allowed = {"none"}
     rows = [r for r in _measured
             if r.get("op") == op and int(r.get("world", 0)) == n
-            and r.get("codec", "none") in allowed]
+            and r.get("codec", "none") in allowed and _row_backend_ok(r)]
     if not rows:
         return None
     size_mb = nbytes / 1e6
@@ -243,6 +257,24 @@ def _measured_pick(op: str, nbytes: int, n: int, codec: Optional[str],
                     float(win["latency_ms"]) * 1e3, "measured")
 
 
+def _row_backend_ok(r: dict) -> bool:
+    """A decision-table row may only route algorithms of the hop backend it
+    was MEASURED with (``--sweep`` stamps ``backend``): ppermute timings say
+    nothing about remote-DMA hop counts and vice versa. Un-stamped legacy
+    rows are ppermute-era sweeps; a pallas algorithm in one is a schema
+    mismatch and never routes. ``lax`` rows (stamped ``xla``) are
+    backend-neutral don't-bother verdicts. Pallas rows additionally need
+    the backend to be usable in THIS process."""
+    alg = str(r.get("algorithm", ""))
+    stamp = r.get("backend", "ppermute")
+    if alg == "lax":
+        return True
+    implied = "pallas" if pallas_backend.is_pallas(alg) else "ppermute"
+    if stamp != implied:
+        return False
+    return implied != "pallas" or pallas_backend.available()
+
+
 def pick_codec(op: str, nbytes: int, axis_size: int, algorithm: str,
                itemsize: int = 4) -> str:
     """Best wire codec from the configured candidates for a FORCED
@@ -251,7 +283,9 @@ def pick_codec(op: str, nbytes: int, axis_size: int, algorithm: str,
     cfg = _config
     if nbytes < cfg.min_quant_bytes:
         return "none"
-    alg = algorithm if algorithm in ALGORITHMS else "ring"
+    if algorithm not in ALGORITHMS + PALLAS_ALGORITHMS:
+        algorithm = "ring"
+    alg = algorithm
     candidates = tuple(cfg.codecs) or ("none",)
     return min(candidates,
                key=lambda cd: estimate_us(op, alg, cd, nbytes, axis_size, cfg, itemsize))
@@ -269,7 +303,11 @@ def select(op: str, nbytes: int, axis_size: int, codec: Optional[str] = None,
     (op, bytes-bucket, axis-size, payload itemsize[, forced codec])."""
     if op not in OPS:
         raise ValueError(f"unknown op {op!r} (one of {OPS})")
-    key = (op, _bytes_bucket(nbytes), int(axis_size), codec, int(itemsize))
+    # the hop backend is part of the decision's identity: a cache warmed
+    # while pallas hops were unavailable must not answer for a process (or
+    # restored table) where they are, and vice versa
+    key = (op, _bytes_bucket(nbytes), int(axis_size), codec, int(itemsize),
+           pallas_backend.backend_token())
     with _lock:
         hit = _cache.get(key)
         if hit is not None:

@@ -134,15 +134,36 @@ def run_sweep(
 ) -> List[Dict]:
     """Measure every (op, size, algorithm, codec) combination and return the
     decision-table rows ``selector.configure(decision_table=...)`` consumes
-    (one JSON row per measurement: op/world/size_mb/algorithm/codec/
+    (one JSON row per measurement: op/world/size_mb/algorithm/codec/backend/
     latency_ms/busbw_gbps; ``size_mb`` is the PER-DEVICE payload, matching
-    the local-shard bytes the selector is queried with). The lax baseline
+    the local-shard bytes the selector is queried with; ``backend`` is the
+    hop backend the row was measured with — measured mode never applies a
+    ppermute row to a pallas algorithm or vice versa). The lax baseline
     rides along as ``algorithm="lax"`` so measured mode can conclude
     "don't bother"."""
+    from deepspeed_tpu.collectives import pallas_backend
     from deepspeed_tpu.collectives.algorithms import ALGORITHMS
+    from deepspeed_tpu.collectives.pallas_backend import PALLAS_ALGORITHMS
+    from deepspeed_tpu.utils.logging import logger
 
     sizes_mb = sizes_mb if sizes_mb is not None else [0.25, 1.0, 4.0]
-    algorithms = algorithms if algorithms is not None else ["lax"] + list(ALGORITHMS)
+    if algorithms is None:
+        # the pallas remote-DMA algorithms sweep themselves in on TPU only
+        algorithms = ["lax"] + list(ALGORITHMS)
+        if pallas_backend.available():
+            algorithms += list(PALLAS_ALGORITHMS)
+    pallas_req = [a for a in algorithms if pallas_backend.is_pallas(a)]
+    if pallas_req and not pallas_backend.available():
+        # an off-TPU sweep must not crash (CI boxes) — and must not emit
+        # interpret-mode timings either: the interpreter's latencies say
+        # nothing about remote-DMA hops, and a table holding them would
+        # poison measured-mode routing on a real TPU
+        logger.warning(
+            f"collectives sweep: skipping {pallas_req} — the pallas "
+            f"remote-DMA backend needs a TPU (backend is "
+            f"{jax.default_backend()!r}; interpret-mode timings would "
+            "poison the decision table)")
+        algorithms = [a for a in algorithms if not pallas_backend.is_pallas(a)]
     codecs = codecs if codecs is not None else ["none"]
     mesh = mesh if mesh is not None else build_mesh(axis_sizes={axis: -1})
     n = mesh.shape[axis]
@@ -179,6 +200,13 @@ def run_sweep(
                     rows.append({
                         "op": op, "world": n, "size_mb": round(payload / n / 1e6, 4),
                         "algorithm": alg, "codec": codec,
+                        # the hop backend these timings were measured with:
+                        # selector measured mode only applies a row to
+                        # algorithms of the same backend (a ppermute table
+                        # must never route pallas hop counts, nor vice versa)
+                        "backend": ("xla" if alg == "lax"
+                                    else "pallas" if pallas_backend.is_pallas(alg)
+                                    else "ppermute"),
                         "latency_ms": round(dt * 1e3, 4),
                         "busbw_gbps": round(busbw / 1e9, 3),
                     })
@@ -198,6 +226,11 @@ def main(argv=None) -> int:  # pragma: no cover - CLI body exercised via run_col
                    help="sweep algorithms x codecs and emit a selector decision table")
     p.add_argument("--codecs", default="none",
                    help="comma-separated wire codecs for --sweep (none,bf16,int8,fp8)")
+    p.add_argument("--algorithms", default=None,
+                   help="comma-separated algorithms for --sweep (default: lax + "
+                        "the ppermute set, + pallas_ring/pallas_ring2d on TPU; "
+                        "pallas algorithms are skipped with a logged reason "
+                        "off-TPU rather than measured under the interpreter)")
     p.add_argument("--output", default=None,
                    help="write the --sweep decision table JSON here (default stdout)")
     a = p.parse_args(argv)
@@ -209,6 +242,8 @@ def main(argv=None) -> int:  # pragma: no cover - CLI body exercised via run_col
             p.error(f"--sweep supports {_SWEEP_OPS}, not {bad} "
                     f"(the algorithmic library has no all_to_all)")
         rows = run_sweep(ops=ops, sizes_mb=sizes, axis=a.axis, iters=a.iters,
+                         algorithms=([s for s in a.algorithms.split(",") if s]
+                                     if a.algorithms else None),
                          codecs=[c for c in a.codecs.split(",") if c])
         payload = json.dumps(rows, indent=1)
         if a.output:

@@ -192,8 +192,10 @@ def _record(op_name: str, axis, x, **tags):
 # hop-composed algorithmic library): algorithm None keeps the plain jax.lax
 # lowering (XLA picks the implementation), "auto" asks collectives.selector
 # for the best (algorithm, codec) per (op, bytes, axis size), and a concrete
-# name ("ring" / "bidir" / "rhd" / "ring2d") forces it. The algorithmic path
-# must run inside FULL-MANUAL shard_map (see utils/compat.py).
+# name ("ring" / "bidir" / "rhd" / "ring2d", or "pallas_ring" /
+# "pallas_ring2d" for remote-DMA hop kernels with in-kernel fused int8/fp8
+# reduction — collectives/pallas_backend.py) forces it. The algorithmic
+# path must run inside FULL-MANUAL shard_map (see utils/compat.py).
 
 
 def _algorithmic(op_name: str, x, axis, algorithm, codec, reduce_op: str = "sum"):
@@ -210,6 +212,11 @@ def _algorithmic(op_name: str, x, axis, algorithm, codec, reduce_op: str = "sum"
     the library's own errors instead."""
     from deepspeed_tpu.collectives import selector
 
+    if isinstance(axis, (tuple, list)) and len(axis) == 0:
+        # an empty axis tuple is the native no-op reduction (lax.pmean(x, ())
+        # == x — e.g. grad means on a mesh with no >1 data axis): nothing
+        # crosses a wire, so there is nothing to route or quantize
+        return None, None
     explicit = algorithm is not None or codec is not None
     from_config = False
     if not explicit:

@@ -390,7 +390,9 @@ class CollectivesConfig(DeepSpeedConfigModel):
     # arguments ("auto" consults the selector; a concrete name forces one
     # algorithm). Installed process-wide by the engine when enabled, so ALL
     # facade collectives — including the zeropp gathers — route through it.
-    algorithm: str = "auto"  # auto | ring | bidir | rhd | ring2d | lax
+    # The pallas_* names run the same schedules over remote-DMA hop kernels
+    # (TPU; interpret mode elsewhere — see docs/collectives.md).
+    algorithm: str = "auto"  # auto | ring | bidir | rhd | ring2d | pallas_ring | pallas_ring2d | lax
     # "auto" lets the selector pick among `codecs`; any concrete name —
     # including "none" — FORCES that wire for every default-routed collective.
     codec: str = "auto"  # auto | none | fp32 | bf16 | int8 | fp8
@@ -407,6 +409,10 @@ class CollectivesConfig(DeepSpeedConfigModel):
     # Payloads below this stay on the native lax lowering in model mode
     # (tiny collectives are latency-bound; serial hops lose to XLA's own).
     min_algorithmic_bytes: int = 4096
+    # Cost-model alpha discount for pallas remote-DMA hops (one fused kernel
+    # per hop vs encode+permute+decode programs); candidates enter the model
+    # only when the backend is actually available (a real TPU).
+    pallas_alpha_scale: float = 0.5
     # T3-style double buffering of the zeropp qwZ gather wire: chunk count
     # (1 = off). Chunk k's dequantize overlaps chunk k+1's gather.
     overlap_chunks: int = 1

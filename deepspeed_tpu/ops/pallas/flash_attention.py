@@ -39,6 +39,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deepspeed_tpu.utils.compat import tpu_compiler_params
+
 from deepspeed_tpu.ops.registry import register
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
@@ -60,13 +62,9 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _vma(*arrays):
-    """Union of varying-manual-axes of the inputs: propagated to out_shape so
-    the kernels compose inside shard_map (jax>=0.9 check_vma)."""
-    vma = frozenset()
-    for a in arrays:
-        vma = vma | getattr(jax.typeof(a), "vma", frozenset())
-    return vma
+# out_shape structs carry the inputs' varying-manual-axes where this jax
+# tracks them (jax>=0.9 check_vma); plain structs on 0.4.x
+from deepspeed_tpu.utils.compat import shape_dtype_struct as _sds
 
 
 def _cdiv(a: int, b: int) -> int:
@@ -310,8 +308,8 @@ def _flash_fwd(q, k, v, mask, slopes, block_q: int, block_k: int, causal: bool,
     squashed = _squash_ok(nq, nk, block_q, block_k, causal)
 
     out_shape = [
-        jax.ShapeDtypeStruct((B, H, S, D), q.dtype, vma=_vma(q, k, v, mask)),
-        jax.ShapeDtypeStruct((B, H, S, _LANES), jnp.float32, vma=_vma(q, k, v, mask)),
+        _sds((B, H, S, D), q.dtype, q, k, v, mask),
+        _sds((B, H, S, _LANES), jnp.float32, q, k, v, mask),
     ]
     scratch_shapes = [
         pltpu.VMEM((block_q, D), jnp.float32),
@@ -339,7 +337,7 @@ def _flash_fwd(q, k, v, mask, slopes, block_q: int, block_k: int, causal: bool,
                 scratch_shapes=scratch_shapes,
             ),
             out_shape=out_shape,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=_interpret(),
         )(qm, km, mask, *extra, q, k, v)
@@ -352,7 +350,7 @@ def _flash_fwd(q, k, v, mask, slopes, block_q: int, block_k: int, causal: bool,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=scratch_shapes,
-        compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL_SEMANTICS),
+        compiler_params=tpu_compiler_params(dimension_semantics=_PARALLEL_SEMANTICS),
         interpret=_interpret(),
     )(mask, *extra, q, k, v)
     return out, lse
@@ -516,7 +514,6 @@ def _flash_bwd(q, k, v, mask, slopes, out, lse, do, block_q: int, block_k: int,
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,S]
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, _LANES))
 
-    grad_vma = _vma(q, k, v, mask, do)
     dq_kernel = functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
                                   causal=causal, masked=masked, squashed=squashed,
                                   alibi=alibi, k_splits=k_splits)
@@ -527,7 +524,7 @@ def _flash_bwd(q, k, v, mask, slopes, out, lse, do, block_q: int, block_k: int,
     dq_scratch = [pltpu.VMEM((block_q, D), jnp.float32)]
     dkv_scratch = [pltpu.VMEM((block_k, D), jnp.float32),
                    pltpu.VMEM((block_k, D), jnp.float32)]
-    dq_shape = jax.ShapeDtypeStruct((B, H, S, D), jnp.float32, vma=grad_vma)
+    dq_shape = _sds((B, H, S, D), jnp.float32, q, k, v, mask, do)
     dkv_shape = [dq_shape, dq_shape]
 
     def bwd_in_specs(dec):
@@ -536,7 +533,7 @@ def _flash_bwd(q, k, v, mask, slopes, out, lse, do, block_q: int, block_k: int,
                 + [qrow["qD"], qrow["qL"], qrow["qL"]])
 
     if squashed:
-        arb = pltpu.CompilerParams(dimension_semantics=("parallel", "parallel", "arbitrary"))
+        arb = tpu_compiler_params(dimension_semantics=("parallel", "parallel", "arbitrary"))
         qm, km = _tri_maps(nq)
         dq = pl.pallas_call(
             dq_kernel,
@@ -575,7 +572,7 @@ def _flash_bwd(q, k, v, mask, slopes, out, lse, do, block_q: int, block_k: int,
             out_specs=_qrow_specs(_DEC_DENSE, block_q, D)["qD"],
             out_shape=dq_shape,
             scratch_shapes=dq_scratch,
-            compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL_SEMANTICS),
+            compiler_params=tpu_compiler_params(dimension_semantics=_PARALLEL_SEMANTICS),
             interpret=_interpret(),
         )(mask, *extra, q, k, v, do, lse, delta)
 
@@ -589,7 +586,7 @@ def _flash_bwd(q, k, v, mask, slopes, out, lse, do, block_q: int, block_k: int,
             out_specs=[_kcol_spec(_DEC_DENSE_KQ, block_k, D)] * 2,
             out_shape=dkv_shape,
             scratch_shapes=dkv_scratch,
-            compiler_params=pltpu.CompilerParams(dimension_semantics=_PARALLEL_SEMANTICS),
+            compiler_params=tpu_compiler_params(dimension_semantics=_PARALLEL_SEMANTICS),
             interpret=_interpret(),
         )(mask, *extra, q, k, v, do, lse, delta)
 

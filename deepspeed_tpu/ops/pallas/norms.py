@@ -26,11 +26,7 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _vma(*arrays):
-    vma = frozenset()
-    for a in arrays:
-        vma = vma | getattr(jax.typeof(a), "vma", frozenset())
-    return vma
+from deepspeed_tpu.utils.compat import shape_dtype_struct as _sds
 
 
 def _rms_fwd_kernel(x_ref, scale_ref, o_ref, *, eps):
@@ -63,7 +59,7 @@ def _rms_fwd(x2, scale, eps):
             pl.BlockSpec((Dm,), lambda i: (0,)),
         ],
         out_specs=pl.BlockSpec((br, Dm), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((R, Dm), x2.dtype, vma=_vma(x2, scale)),
+        out_shape=_sds((R, Dm), x2.dtype, x2, scale),
         interpret=_interpret(),
     )(x2, scale)
 
@@ -114,7 +110,7 @@ def _ln_fwd(x2, scale, bias, eps):
             pl.BlockSpec((Dm,), lambda i: (0,)),
         ],
         out_specs=pl.BlockSpec((br, Dm), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((R, Dm), x2.dtype, vma=_vma(x2, scale, bias)),
+        out_shape=_sds((R, Dm), x2.dtype, x2, scale, bias),
         interpret=_interpret(),
     )(x2, scale, bias)
 

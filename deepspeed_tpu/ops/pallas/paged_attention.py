@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deepspeed_tpu.utils.compat import tpu_compiler_params
+
 from deepspeed_tpu.ops.registry import register
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
@@ -199,7 +201,7 @@ def flash_decode_paged(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((N, kvH, Cgp, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),

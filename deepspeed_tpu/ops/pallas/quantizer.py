@@ -32,19 +32,14 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _vma(*arrays):
-    vma = frozenset()
-    for a in arrays:
-        vma = vma | getattr(jax.typeof(a), "vma", frozenset())
-    return vma
+from deepspeed_tpu.utils.compat import shape_dtype_struct as _sds
 
 
 def _quant_kernel(x_ref, vals_ref, scales_ref):
-    x = x_ref[:].astype(jnp.float32)  # [rows, block]
-    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
-    scale = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
-    q = jnp.clip(jnp.round(x / scale), -127, 127)
-    vals_ref[:] = q.astype(jnp.int8)
+    from deepspeed_tpu.ops.quant import int8_block_math
+
+    q, scale = int8_block_math(x_ref[:].astype(jnp.float32))  # [rows, block]
+    vals_ref[:] = q
     scales_ref[:] = scale.astype(jnp.float32)
 
 
@@ -92,8 +87,8 @@ def pallas_quantize_int8(x: jax.Array, block_size: int = DEFAULT_BLOCK, stochast
                 pl.BlockSpec((rows, 1), lambda i: (i, 0)),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((nb, block), jnp.int8, vma=_vma(x2)),
-                jax.ShapeDtypeStruct((nb, 1), jnp.float32, vma=_vma(x2)),
+                _sds((nb, block), jnp.int8, x2),
+                _sds((nb, 1), jnp.float32, x2),
             ],
         )(seed_arr, x2)
     else:
@@ -106,8 +101,8 @@ def pallas_quantize_int8(x: jax.Array, block_size: int = DEFAULT_BLOCK, stochast
                 pl.BlockSpec((rows, 1), lambda i: (i, 0)),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((nb, block), jnp.int8, vma=_vma(x2)),
-                jax.ShapeDtypeStruct((nb, 1), jnp.float32, vma=_vma(x2)),
+                _sds((nb, block), jnp.int8, x2),
+                _sds((nb, 1), jnp.float32, x2),
             ],
             interpret=_interpret(),
         )(x2)
@@ -132,7 +127,7 @@ def pallas_dequantize_int8(values: jax.Array, scales: jax.Array, shape, dtype=jn
             pl.BlockSpec((rows, 1), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((rows, block), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((nb, block), dtype, vma=_vma(v2, scales)),
+        out_shape=_sds((nb, block), dtype, v2, scales),
         interpret=_interpret(),
     )(v2, scales.reshape(nb, 1))
     return out.reshape(-1)[:n].reshape(shape)

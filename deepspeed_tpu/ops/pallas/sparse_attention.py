@@ -34,6 +34,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deepspeed_tpu.utils.compat import tpu_compiler_params
+
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 _LANES = 8
 
@@ -158,7 +160,7 @@ def _sparse_fwd(q, k, v, cols, ncols, block, causal):
             jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
             jax.ShapeDtypeStruct((B, H, S, _LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),
@@ -274,7 +276,7 @@ def _sparse_bwd(q, k, v, do, out, lse, cols, ncols, rows, nrows, block, causal):
             scratch_shapes=[pltpu.VMEM((block, D), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),
@@ -301,7 +303,7 @@ def _sparse_bwd(q, k, v, do, out, lse, cols, ncols, rows, nrows, block, causal):
                             pltpu.VMEM((block, D), jnp.float32)],
         ),
         out_shape=[jax.ShapeDtypeStruct((B, H, S, D), jnp.float32)] * 2,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),

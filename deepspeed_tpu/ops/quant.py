@@ -14,6 +14,42 @@ import jax.numpy as jnp
 from deepspeed_tpu.ops.registry import dispatch, register
 
 DEFAULT_BLOCK = 2048
+FP8_MAX = 448.0  # float8_e4m3fn max normal
+
+
+# -- shared block math -------------------------------------------------------
+# THE symmetric block-quant formulas, written on [nb, block] fp32 tiles so
+# the same code runs as the XLA fallback, inside the Pallas quantizer kernel
+# (ops/pallas/quantizer.py), in the wire codecs (collectives/codecs.py), and
+# in the fused collective hop kernel's VMEM body
+# (collectives/pallas_backend.py). One wire format everywhere.
+
+
+def int8_block_math(x2: jax.Array):
+    """``[nb, block] fp32 -> (int8 values [nb, block], fp32 scales [nb, 1])``
+    — symmetric per-block absmax, nearest rounding."""
+    absmax = jnp.max(jnp.abs(x2), axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(x2 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def int8_block_dequant(q2: jax.Array, scale: jax.Array) -> jax.Array:
+    """Inverse of :func:`int8_block_math` (fp32 out; caller casts)."""
+    return q2.astype(jnp.float32) * scale
+
+
+def fp8_block_math(x2: jax.Array):
+    """``[nb, block] fp32 -> (e4m3 values, fp32 scales [nb, 1])`` — absmax
+    mapped onto the fp8 dynamic range (emulated via ml_dtypes off-TPU)."""
+    absmax = jnp.max(jnp.abs(x2), axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / FP8_MAX)
+    q = (x2 / scale).astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+def fp8_block_dequant(q2: jax.Array, scale: jax.Array) -> jax.Array:
+    return q2.astype(jnp.float32) * scale
 
 
 @register("quantize_int8", "xla")
@@ -25,10 +61,7 @@ def _xla_quantize_int8(x: jax.Array, block_size: int = DEFAULT_BLOCK, stochastic
     nb = -(-n // block)
     if nb * block != n:
         flat = jnp.pad(flat, (0, nb * block - n))
-    x2 = flat.reshape(nb, block)
-    absmax = jnp.max(jnp.abs(x2), axis=-1, keepdims=True)
-    scale = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
-    q = jnp.clip(jnp.round(x2 / scale), -127, 127).astype(jnp.int8)
+    q, scale = int8_block_math(flat.reshape(nb, block))
     return q.reshape(-1)[:n], scale.reshape(-1)
 
 

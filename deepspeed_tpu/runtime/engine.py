@@ -82,6 +82,18 @@ def _get_metrics_server(port: int):
     return srv
 
 
+def _facade_grad_mean(g, live):
+    """Mean-reduce an unsharded gradient leaf over the data axes through the
+    comm facade: byte-identical ``lax.pmean`` lowering by default, but the
+    ``collectives`` config block's routing (algorithmic/quantized/pallas
+    remote-DMA backends) now reaches the shard_map grad paths (zeropp, LoCo,
+    1-bit) — the GSPMD main step has no explicit collective to route. The
+    loss pmean stays native: a scalar control value is never worth hops."""
+    from deepspeed_tpu.comm import comm as comm_mod
+
+    return comm_mod.all_reduce(g, live, op="mean")
+
+
 class TrainState(NamedTuple):
     """Entire training state — one pytree, placed once on the mesh."""
 
@@ -368,6 +380,7 @@ class DeepSpeedTPUEngine:
                 decision_table=ccfg.decision_table,
                 min_quant_bytes=ccfg.min_quant_bytes,
                 min_algorithmic_bytes=ccfg.min_algorithmic_bytes,
+                pallas_alpha_scale=ccfg.pallas_alpha_scale,
                 facade_algorithm=facade_alg,
                 # "auto" = no forced codec: the selector picks among `codecs`;
                 # a concrete name (incl. "none") pins that wire
@@ -775,8 +788,18 @@ class DeepSpeedTPUEngine:
             hidden_size=getattr(mcfg, "hidden_size", 0) or 0,
             num_layers=getattr(mcfg, "num_layers", 0) or 0,
             vocab_size=getattr(mcfg, "vocab_size", 0) or 0,
+            num_heads=getattr(mcfg, "num_heads", 0) or 0,
             remat=bool(getattr(mcfg, "remat", True)),
             fused_ce=bool(getattr(mcfg, "fused_ce", False)),
+            # flash attention never materializes the score matrix, so the
+            # attention temp-workspace term vanishes. Derive from the
+            # model's attn_impl: 'auto' resolves like the ops registry
+            # (pallas on TPU), 'flash' forces it, anything else ('xla',
+            # 'sparse', 'fpdt') materializes score-class workspace
+            flash_attention=(
+                getattr(mcfg, "attn_impl", "auto") == "flash"
+                or (getattr(mcfg, "attn_impl", "auto") == "auto"
+                    and jax.default_backend() == "tpu")),
         )
         self._hbm_estimate_bytes = int(need)
         from deepspeed_tpu.telemetry.programs import get_program_registry
@@ -1182,7 +1205,8 @@ class DeepSpeedTPUEngine:
                     scaled_loss, has_aux=True)((param_shards, errs), micro, r)
                 grads = cast_floating(grads, jnp.float32)
                 grads = jax.tree_util.tree_map(
-                    lambda g, p: g if p.sharded else jax.lax.pmean(g, live), grads, plans
+                    lambda g, p: g if p.sharded else _facade_grad_mean(g, live),
+                    grads, plans
                 )
                 new_errs = jax.tree_util.tree_map(lambda e: e[None].astype(jnp.float32),
                                                   new_errs)
@@ -1213,9 +1237,8 @@ class DeepSpeedTPUEngine:
 
             (_, loss), grads = jax.value_and_grad(scaled_loss, has_aux=True)(param_shards, micro, r)
             grads = cast_floating(grads, jnp.float32)
-            # leaves replicated over the data axes: exact mean (tiny tensors)
             grads = jax.tree_util.tree_map(
-                lambda g, p: g if p.sharded else jax.lax.pmean(g, live), grads, plans
+                lambda g, p: g if p.sharded else _facade_grad_mean(g, live), grads, plans
             )
             return grads, jax.lax.pmean(loss, live)
 

@@ -116,6 +116,28 @@ def extract_collectives(hlo_text: str) -> List[Dict[str, Any]]:
     return out
 
 
+# custom-call targets that are HAND-WRITTEN kernels (vs partitioning /
+# placement annotations GSPMD sprinkles through every sharded program)
+_KERNEL_TARGETS = ("tpu_custom_call", "mosaic", "triton")
+
+
+def extract_custom_kernels(hlo_text: str) -> List[Dict[str, Any]]:
+    """Custom-call targets in compiled HLO text: ``[{target, count,
+    kernel}]`` where ``kernel`` marks hand-written kernels (Pallas/Mosaic/
+    Triton) as opposed to GSPMD/placement annotations. This is how a FUSED
+    collective hop reads in a program inventory — e.g. one
+    ``tpu_custom_call`` per hop where the ppermute path showed separate
+    quantize custom calls (or fused HLO) plus a ``collective-permute``;
+    see docs/telemetry.md."""
+    counts: Dict[str, int] = {}
+    for m in re.finditer(r'custom_call_target="([^"]+)"', hlo_text):
+        target = m.group(1)
+        counts[target] = counts.get(target, 0) + 1
+    return [{"target": t, "count": c,
+             "kernel": any(k in t.lower() for k in _KERNEL_TARGETS)}
+            for t, c in sorted(counts.items())]
+
+
 def hlo_fingerprint(hlo_text: str) -> Tuple[str, int]:
     """(content hash, instruction count) of an HLO module's text — the
     identity a recompile report diffs to say what grew."""
@@ -144,12 +166,19 @@ class ProgramRecord:
     generated_code_bytes: int = 0
     peak_hbm_bytes: int = 0                  # argument + output − alias + temp
     collectives: List[Dict[str, Any]] = field(default_factory=list)
+    custom_kernels: List[Dict[str, Any]] = field(default_factory=list)
     hbm_estimate_bytes: Optional[int] = None
     hbm_estimate_ratio: Optional[float] = None
 
     @property
     def collective_bytes(self) -> int:
         return sum(c["bytes"] for c in self.collectives)
+
+    @property
+    def custom_kernel_count(self) -> int:
+        # hand-written kernels only — GSPMD/annotation custom calls are in
+        # the list (kernel=False) but must not inflate the kernel census
+        return sum(k["count"] for k in self.custom_kernels if k.get("kernel"))
 
     def as_dict(self) -> Dict[str, Any]:
         return {
@@ -171,6 +200,8 @@ class ProgramRecord:
             "collective_count": len(self.collectives),
             "collective_bytes": self.collective_bytes,
             "collectives": list(self.collectives),
+            "custom_kernel_count": self.custom_kernel_count,
+            "custom_kernels": list(self.custom_kernels),
             "hbm_estimate_bytes": self.hbm_estimate_bytes,
             "hbm_estimate_ratio": self.hbm_estimate_ratio,
         }
@@ -390,11 +421,12 @@ class ProgramRegistry:
             code_b = int(getattr(mem, "generated_code_size_in_bytes", 0))
         peak = max(arg_b + out_b - alias_b + temp_b, 0)
 
-        fingerprint, n_instr, colls, alias_pairs = "", 0, [], 0
+        fingerprint, n_instr, colls, kernels, alias_pairs = "", 0, [], [], 0
         try:
             text = compiled.as_text()
             fingerprint, n_instr = hlo_fingerprint(text)
             colls = extract_collectives(text)
+            kernels = extract_custom_kernels(text)
             header = text.split("\n", 1)[0]
             if "input_output_alias=" in header:
                 alias_pairs = header.count(": (")
@@ -417,7 +449,7 @@ class ProgramRegistry:
             argument_bytes=arg_b, output_bytes=out_b, temp_bytes=temp_b,
             alias_bytes=alias_b, alias_pairs=alias_pairs,
             generated_code_bytes=code_b, peak_hbm_bytes=peak,
-            collectives=colls,
+            collectives=colls, custom_kernels=kernels,
         )
 
         estimate = self.hbm_estimate(hbm_scope) if hbm_scope else None
@@ -451,6 +483,7 @@ class ProgramRegistry:
             ("program/instruction_count", r.instruction_count),
             ("program/collective_count", len(r.collectives)),
             ("program/collective_bytes", r.collective_bytes),
+            ("program/custom_kernel_count", r.custom_kernel_count),
         ):
             reg.gauge(name, program=r.label).set(float(value))
         reg.counter("compile/count", program=r.label).add(1.0)

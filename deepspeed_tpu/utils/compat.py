@@ -65,6 +65,38 @@ def axis_size(axis, default: Optional[int] = None) -> int:
         raise
 
 
+def shape_dtype_struct(shape, dtype, *like):
+    """``jax.ShapeDtypeStruct`` for a Pallas ``out_shape``, stamped with the
+    union of the varying-manual-axes of ``like`` where this jax tracks them
+    (``jax.typeof(x).vma`` + the ``vma=`` kwarg, new-jax ``check_vma``);
+    0.4.x has neither, and shard_map composition is governed by
+    ``check_rep``/``check_vma=False`` at the shard_map call instead."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return jax.ShapeDtypeStruct(shape, dtype)
+    vma = frozenset()
+    for a in like:
+        vma = vma | getattr(typeof(a), "vma", frozenset())
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # typeof exists but ShapeDtypeStruct predates vma=
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def tpu_compiler_params(**kwargs):
+    """Pallas TPU compiler params across the class rename
+    (``pltpu.CompilerParams`` on new jax, ``pltpu.TPUCompilerParams`` on
+    0.4.x); kwargs the installed class does not know are dropped rather
+    than raising, so call sites can write the full new-API surface."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    fields = getattr(cls, "__dataclass_fields__", None)
+    if fields is not None:
+        kwargs = {k: v for k, v in kwargs.items() if k in fields}
+    return cls(**kwargs)
+
+
 def memory_space(space: str):
     """A ``jax.device_put`` target selecting host vs device memory.
 

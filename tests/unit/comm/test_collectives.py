@@ -549,6 +549,284 @@ def test_ring2d_int8_train_step_with_hop_spans(mesh8, tmp_path):
         telemetry.configure(enabled=False)
 
 
+def _count_primitives(jaxpr, counts=None):
+    """Recursive primitive census of a (closed) jaxpr — the structural
+    evidence for 'one fused program per hop'."""
+    counts = counts if counts is not None else {}
+    for eqn in jaxpr.eqns:
+        counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+        for v in eqn.params.values():
+            for j in jax.tree_util.tree_leaves(
+                    v, is_leaf=lambda x: isinstance(
+                        x, (jax.core.Jaxpr, jax.core.ClosedJaxpr))):
+                if isinstance(j, jax.core.ClosedJaxpr):
+                    _count_primitives(j.jaxpr, counts)
+                elif isinstance(j, jax.core.Jaxpr):
+                    _count_primitives(j, counts)
+    return counts
+
+
+# ------------------------------------------------- pallas remote-DMA backend
+
+
+@pytest.mark.parametrize("alg", ("pallas_ring", "pallas_ring2d"))
+def test_pallas_all_reduce_bit_identical_vs_ring(mesh8, alg):
+    """Interpret-mode equivalence: exact-wire pallas all-reduce over remote
+    DMA hops is BIT-identical to the ppermute ring (and to the true sum —
+    integer payloads make every summation order exact). 103 columns is the
+    non-divisible chunk-padding path."""
+    x = _int_payload((8, 103), seed=21)
+
+    def f(alg):
+        return lambda v: collectives.all_reduce(v[0], "dp", algorithm=alg)[None]
+
+    got = np.asarray(_run(mesh8, f(alg), x)).reshape(8, -1)
+    ref = np.asarray(_run(mesh8, f("ring"), x)).reshape(8, -1)
+    np.testing.assert_array_equal(got, ref)
+    np.testing.assert_array_equal(
+        got, np.tile(np.asarray(x).sum(0, keepdims=True), (8, 1)))
+
+
+def test_pallas_all_gather_and_reduce_scatter_match_ring(mesh8):
+    """pallas_ring selectable through the comm FACADE for gather/scatter
+    too (acceptance), bit-identical to the ppermute ring."""
+    x = _int_payload((8, 37), seed=22)
+    g = np.asarray(_run(
+        mesh8, lambda v: dist.all_gather(v[0], "dp", algorithm="pallas_ring")[None], x))
+    gr = np.asarray(_run(
+        mesh8, lambda v: collectives.all_gather(v[0], "dp", algorithm="ring")[None], x))
+    np.testing.assert_array_equal(g, gr)
+    xs = _int_payload((8, 96), seed=23)
+    rs = np.asarray(_run(
+        mesh8, lambda v: dist.reduce_scatter(v[0], "dp", algorithm="pallas_ring")[None],
+        xs)).reshape(8, 12)
+    np.testing.assert_array_equal(rs, np.asarray(xs).sum(0).reshape(8, 12))
+
+
+@pytest.mark.parametrize("alg", ("pallas_ring", "pallas_ring2d"))
+@pytest.mark.parametrize("codec", ("int8", "fp8"))
+def test_pallas_fused_quant_all_reduce_bounded_error(mesh8, alg, codec):
+    """The fused dequant-accumulate-requant hop must track the UNFUSED wire
+    codec path (same block math via ops.quant, same fp32 accumulation) and
+    stay within the quantization tolerance of the exact sum. 103 columns
+    exercises both the chunk padding and the codec block padding."""
+    x = (jax.random.normal(jax.random.PRNGKey(24), (8, 103)) * 3).astype(jnp.float32)
+
+    def f(a, c):
+        return lambda v: collectives.all_reduce(v[0], "dp", algorithm=a,
+                                                codec=c, block_size=32)[None]
+
+    fused = np.asarray(_run(mesh8, f(alg, codec), x)).reshape(8, -1)
+    base_alg = "ring" if alg == "pallas_ring" else "ring2d"
+    unfused = np.asarray(_run(mesh8, f(base_alg, codec), x)).reshape(8, -1)
+    exact = np.tile(np.asarray(x).sum(0, keepdims=True), (8, 1))
+    scale = np.abs(exact).max() + 1e-9
+    assert np.abs(fused - exact).max() / scale < 0.15, (alg, codec)
+    assert np.abs(fused - unfused).max() / scale < 0.05, (alg, codec)
+    # every rank ends with identical bytes (replica-drift guard)
+    for r in range(1, 8):
+        np.testing.assert_array_equal(fused[r], fused[0], err_msg=alg)
+
+
+def test_pallas_fused_hop_is_single_program(mesh8):
+    """Structural acceptance: the fused quantized reduce-scatter runs ONE
+    pallas program per hop — no collective-permutes, no separate quant
+    programs between hops — where the ppermute+int8 path runs 2 ppermutes
+    per hop (wire values + scales) around XLA-side codec math."""
+    from deepspeed_tpu.utils.compat import shard_map as smap
+
+    x = jnp.ones((8, 96), jnp.float32)
+
+    def traced(alg):
+        def body(v):
+            return collectives.reduce_scatter(v[0], "dp", algorithm=alg,
+                                              codec="int8", block_size=32)[None]
+        return jax.make_jaxpr(smap(body, mesh=mesh8, in_specs=P("dp"),
+                                   out_specs=P("dp"), check_vma=False))(x)
+
+    fused = _count_primitives(traced("pallas_ring").jaxpr)
+    assert fused.get("pallas_call", 0) == 7  # n-1 hops, one program each
+    assert fused.get("ppermute", 0) == 0
+    unfused = _count_primitives(traced("ring").jaxpr)
+    assert unfused.get("pallas_call", 0) == 0  # CPU dispatch: xla codec math
+    assert unfused.get("ppermute", 0) == 2 * 7  # q + scales per hop
+
+
+def test_pallas_exact_wire_hops_are_remote_dma(mesh8):
+    """Exact codecs don't fuse, but their hops still ride remote DMA: one
+    pallas program per hop (the wire's q leaf; zero-size scale placeholders
+    skip), zero ppermutes."""
+    from deepspeed_tpu.utils.compat import shard_map as smap
+
+    x = jnp.ones((8, 96), jnp.float32)
+
+    def body(v):
+        return collectives.all_gather(v[0], "dp", algorithm="pallas_ring")[None]
+
+    jaxpr = jax.make_jaxpr(smap(body, mesh=mesh8, in_specs=P("dp"),
+                                out_specs=P("dp"), check_vma=False))(x)
+    counts = _count_primitives(jaxpr.jaxpr)
+    assert counts.get("pallas_call", 0) == 7
+    assert counts.get("ppermute", 0) == 0
+
+
+def test_pallas_error_feedback_still_requires_ring():
+    with pytest.raises(ValueError, match="ring"):
+        collectives.reduce_scatter(jnp.ones((8, 8)), "dp",
+                                   algorithm="pallas_ring",
+                                   err=jnp.zeros((8, 8)))
+
+
+def test_pallas_train_step_smoke_with_hop_spans(mesh8, tmp_path):
+    """Acceptance: comm.all_reduce(algorithm='pallas_ring', codec='int8')
+    inside a jitted train step — fused hop spans (tagged backend=pallas,
+    fused) in the exported trace, comm:remote_dma transfers instead of
+    comm:ppermute."""
+    tracer = telemetry.configure(enabled=True, trace_path=str(tmp_path / "t.json"))
+    tracer.reset()
+    try:
+        w0 = jnp.zeros((64,), jnp.float32)
+        x = _int_payload((8, 64), seed=25)
+
+        def local_step(w, batch):
+            g = jax.grad(lambda wv: jnp.sum((batch[0] - wv) ** 2))(w)
+            g = dist.all_reduce(g, "dp", op="mean", algorithm="pallas_ring",
+                                codec="int8", block_size=32)
+            return w - 0.1 * g
+
+        step = jax.jit(shard_map(
+            local_step, mesh=mesh8, in_specs=(P(), P("dp")), out_specs=P(),
+            check_vma=False))
+        assert np.isfinite(np.asarray(step(w0, x))).all()
+        events = tracer.events()
+        names = [e.get("name") for e in events]
+        facade = next(e for e in events if e.get("name") == "comm:all_reduce_mean")
+        assert facade["args"]["algorithm"] == "pallas_ring"
+        assert facade["args"]["codec"] == "int8"
+        # fused RS hops: coll: spans tagged with the backend and the fusion
+        rs_hops = [e for e in events
+                   if e.get("name") == "coll:reduce_scatter:pallas_ring"]
+        assert len(rs_hops) == 7 and all(
+            e["args"]["backend"] == "pallas" and e["args"]["fused"] for e in rs_hops)
+        # AG relay hops keep their schedule label, backend-tagged
+        ag_hops = [e for e in events if e.get("name") == "coll:all_gather:ring"]
+        assert len(ag_hops) == 7 and all(
+            e["args"]["backend"] == "pallas" for e in ag_hops)
+        assert any(n == "comm:remote_dma" for n in names)
+        assert not any(n == "comm:ppermute" for n in names)
+        telemetry.export_chrome_trace(str(tmp_path / "t.json"))
+        trace = json.loads((tmp_path / "t.json").read_text())
+        tnames = {ev.get("name") for ev in trace.get("traceEvents", [])}
+        assert "coll:reduce_scatter:pallas_ring" in tnames
+        assert "comm:remote_dma" in tnames
+    finally:
+        telemetry.configure(enabled=False)
+
+
+def test_pallas_multi_axis_tuple_rides_hierarchy():
+    """pallas_ring over an axis tuple runs the mesh-axis-factored hierarchy.
+    The 0.4.x Pallas INTERPRETER cannot discharge remote DMA on multi-axis
+    shardings, so on this CPU mesh the hops fall back to ppermute with a
+    logged note (compiled TPU runs keep the kernels) — the schedule and
+    numerics are what this test pins."""
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("dp", "fsdp"))
+    x = _int_payload((4, 2, 24), seed=26)
+
+    def f(v):
+        return collectives.all_reduce(v[0, 0], ("fsdp", "dp"),
+                                      algorithm="pallas_ring")[None, None]
+
+    out = np.asarray(_run(mesh, f, x, in_specs=(P("dp", "fsdp"),),
+                          out_specs=P("dp", "fsdp")))
+    expected = np.asarray(x).sum((0, 1))
+    for u in range(4):
+        for v in range(2):
+            np.testing.assert_array_equal(out[u, v], expected)
+
+
+def test_selector_never_picks_pallas_off_tpu():
+    """Model mode must not route remote-DMA algorithms where the backend
+    cannot run them compiled (interpret mode is a test vehicle, not a
+    transport); monkeypatched availability admits them — and the cache key
+    carries the backend so the two regimes never share decisions."""
+    from deepspeed_tpu.collectives import pallas_backend
+
+    selector.configure(codecs=("none", "int8"))
+    d = selector.select("all_reduce", 1 << 24, 8, codec="int8")
+    assert not d.algorithm.startswith("pallas_")
+
+
+def test_selector_pallas_available_changes_model_and_cache(monkeypatch):
+    from deepspeed_tpu.collectives import pallas_backend
+
+    selector.configure(codecs=("none", "int8"), alpha_us=50.0,
+                       beta_us_per_mb=10.0)
+    before = selector.select("all_reduce", 1 << 24, 8, codec="int8")
+    monkeypatch.setattr(pallas_backend, "available", lambda: True)
+    after = selector.select("all_reduce", 1 << 24, 8, codec="int8")
+    # same query, different backend token => a FRESH cache entry, and with
+    # the alpha discount the pallas carrier wins at this hop-heavy regime
+    assert selector.cache_info()["entries"] == 2
+    assert after.algorithm.startswith("pallas_"), after
+    assert not before.algorithm.startswith("pallas_")
+
+
+def test_measured_table_backend_stamps(monkeypatch, tmp_path):
+    """A ppermute-era table (no backend stamp) must never route a pallas
+    algorithm even when the backend is available; correctly stamped pallas
+    rows route only when it is."""
+    from deepspeed_tpu.collectives import pallas_backend
+
+    table = [
+        {"op": "all_reduce", "world": 8, "size_mb": 1.0, "algorithm": "pallas_ring",
+         "codec": "none", "latency_ms": 0.1},  # mis-stamped: no backend field
+        {"op": "all_reduce", "world": 8, "size_mb": 1.0, "algorithm": "ring",
+         "codec": "none", "latency_ms": 2.0, "backend": "ppermute"},
+    ]
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(table))
+    monkeypatch.setattr(pallas_backend, "available", lambda: True)
+    selector.configure(decision_table=str(path))
+    d = selector.select("all_reduce", 1_000_000, 8)
+    assert d.source == "measured" and d.algorithm == "ring"
+    # properly stamped pallas rows win when available...
+    table[0]["backend"] = "pallas"
+    path.write_text(json.dumps(table))
+    selector.configure(decision_table=str(path))
+    assert selector.select("all_reduce", 1_000_000, 8).algorithm == "pallas_ring"
+    # ...and are invisible when the backend is not usable in this process
+    monkeypatch.setattr(pallas_backend, "available", lambda: False)
+    selector.configure(decision_table=str(path))
+    assert selector.select("all_reduce", 1_000_000, 8).algorithm == "ring"
+
+
+def test_sweep_skips_pallas_off_tpu(caplog):
+    """--sweep with pallas algorithms on a CPU box: logged skip, no crash,
+    no interpret-mode rows in the table; surviving rows carry backend
+    stamps."""
+    import logging
+
+    from deepspeed_tpu.comm.benchmark import run_sweep
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    lg = logging.getLogger("deepspeed_tpu")
+    prev = lg.propagate
+    lg.propagate = True  # the repo logger defaults propagate=False; caplog
+    try:
+        with caplog.at_level(logging.WARNING, logger="deepspeed_tpu"):
+            rows = run_sweep(ops=("all_reduce",), sizes_mb=[0.01], mesh=mesh,
+                             algorithms=["lax", "ring", "pallas_ring"],
+                             codecs=["none"], iters=1, warmup=1)
+    finally:
+        lg.propagate = prev
+    assert any("skipping" in r.message and "pallas_ring" in r.message
+               for r in caplog.records)
+    algs = {r["algorithm"] for r in rows}
+    assert algs == {"lax", "ring"}
+    assert {r["backend"] for r in rows} == {"xla", "ppermute"}
+
+
 def test_selector_decision_emits_telemetry_instant():
     tracer = telemetry.configure(enabled=True)
     tracer.reset()

@@ -122,6 +122,30 @@ def test_extract_collectives_from_hlo_text():
     assert colls[1]["replica_groups"] == "[2,2]<=[4]"
 
 
+def test_extract_custom_kernels_from_hlo_text():
+    """Pallas/Mosaic kernels surface as custom-call targets — how a FUSED
+    collective hop reads in a program inventory: one tpu_custom_call per
+    hop where the unfused path showed quantize calls + collective-permute."""
+    from deepspeed_tpu.telemetry.programs import extract_custom_kernels
+
+    hlo = CANNED_HLO + """
+  %hop0 = (s8[2048]{0}, f32[1]{0}) custom-call(s8[2048]{0} %w0), custom_call_target="tpu_custom_call"
+  %hop1 = (s8[2048]{0}, f32[1]{0}) custom-call(s8[2048]{0} %w1), custom_call_target="tpu_custom_call"
+  %host = f32[4]{0} custom-call(f32[4]{0} %x), custom_call_target="annotate_device_placement"
+"""
+    kernels = extract_custom_kernels(hlo)
+    by_target = {k["target"]: (k["count"], k["kernel"]) for k in kernels}
+    assert by_target["tpu_custom_call"] == (2, True)
+    # GSPMD/placement annotations are listed but NOT kernels — they must
+    # not inflate program/custom_kernel_count
+    assert by_target["annotate_device_placement"] == (1, False)
+    assert extract_custom_kernels(CANNED_HLO) == []
+    from deepspeed_tpu.telemetry.programs import ProgramRecord
+
+    rec = ProgramRecord(label="x", index=0, custom_kernels=kernels)
+    assert rec.custom_kernel_count == 2
+
+
 def test_hlo_fingerprint_stable_and_counts():
     fp1, n1 = hlo_fingerprint(CANNED_HLO)
     fp2, n2 = hlo_fingerprint(CANNED_HLO)

@@ -38,6 +38,21 @@ JAX_PLATFORMS=cpu python tools/fault_smoke.py 2>/dev/null | tee -a "${OUT}"
 smoke_rc=${PIPESTATUS[0]}
 [ "${smoke_rc}" -ne 0 ] && rc=1
 
+# Pallas-collectives interpret smoke (ISSUE 8): the remote-DMA hop kernels
+# and the fused quantized all-reduce must keep their interpret-mode
+# equivalence vs the ppermute algorithms — the census line lands in the
+# committed log so a kernel regression is auditable per round.
+{
+  echo "# pallas-collectives interpret smoke: pytest tests/unit/comm/test_collectives.py -k pallas"
+} >> "${OUT}"
+# prefixed so the smoke's own pytest summary can never win the footer's
+# nightly-tier census grep (^[0-9]+ (passed|failed))
+JAX_PLATFORMS=cpu python -m pytest tests/unit/comm/test_collectives.py -q \
+  -k "pallas" -p no:cacheprovider -p no:xdist -p no:randomly \
+  --tb=line 2>&1 | tail -5 | sed 's/^/pallas-smoke: /' | tee -a "${OUT}"
+pallas_rc=${PIPESTATUS[0]}
+[ "${pallas_rc}" -ne 0 ] && rc=1
+
 # Compiled-program inventory (ISSUE 7): the registry must capture a real
 # train-step and v2 decode-chain program with nonzero flops/peak-HBM and a
 # computed hbm/estimate_ratio. Committed alongside this log as its own
@@ -55,7 +70,7 @@ prog_rc=${PIPESTATUS[0]}
 echo "# program inventory: ${PROG_OUT} (exit ${prog_rc})" >> "${OUT}"
 
 {
-  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, program report: ${prog_rc})"
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, program report: ${prog_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
 echo "wrote ${OUT} ${PROG_OUT}"
