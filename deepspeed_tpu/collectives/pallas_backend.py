@@ -155,10 +155,9 @@ def remote_dma_supported() -> bool:
 def _mesh_axes() -> Tuple[List[str], List[int]]:
     """(names, sizes) of every bound mesh axis, in mesh order, from the
     trace-time axis env (full-manual shard_map binds them all)."""
-    from jax._src import core as _core
+    from deepspeed_tpu.utils.compat import axis_env_sizes
 
-    env = _core.get_axis_env()
-    sizes = dict(env.axis_sizes)
+    sizes = axis_env_sizes()
     if not sizes:
         raise RuntimeError(
             "pallas collective hops need bound mesh axis names — call inside "
@@ -371,16 +370,23 @@ def _fused_hop_kernel(idx_ref, send_blk, recv_blk, out_blk,
             pltpu.semaphore_signal(cap_sem, 1, device_id=src,
                                    device_id_type=pltpu.DeviceIdType.LOGICAL)
 
-    if not interpret:
-        # semaphore balance: the downstream receiver signals C credits (one
-        # per chunk it consumes) but the send loop waits only C-2 of them
-        # (the first two sends ride the free slots). Drain the remainder at
-        # the last grid step — cap_sem must be zero at kernel exit, and the
-        # drain doubles as back-pressure: this hop cannot retire until the
-        # downstream rank consumed every chunk (its wire slots are free for
-        # the NEXT hop's kernel, which reuses the same physical semaphores).
-        @pl.when(j == C)
-        def _drain():
+    # semaphore balance: every DMA/credit semaphore must read zero at kernel
+    # exit — consecutive hop kernels reuse the same physical scratch
+    # semaphores, so a leftover send credit would let the NEXT hop's
+    # wait_send pass before its own DMA drained the VMEM slot, corrupting
+    # wire data. The send loop waits slot s only when a LATER send reuses it
+    # (j in [2, C-1]), which leaves the final min(C, 2) sends outstanding;
+    # wait them here. cap_sem (compiled mode only): the downstream receiver
+    # signals C credits but the send loop consumes only C-2 (the first two
+    # sends ride the free slots) — draining the rest doubles as
+    # back-pressure: this hop cannot retire until the downstream rank
+    # consumed every chunk.
+    @pl.when(j == C)
+    def _drain():
+        for s in ([0] if C == 1 else [(C - 2) % 2, (C - 1) % 2]):
+            q_copy(s).wait_send()
+            s_copy(s).wait_send()
+        if not interpret:
             pltpu.semaphore_wait(cap_sem, min(C, 2))
 
 

@@ -64,6 +64,20 @@ def _xla_causal_attention(
     return out.reshape(B, S, H, D)
 
 
+def resolves_to_flash(impl: str = "auto") -> bool:
+    """Whether a model configured with this ``attn_impl`` would actually run
+    the non-materializing Pallas flash kernel — i.e. the SAME resolution
+    ``dispatch`` performs at call time, so memory estimates cannot diverge
+    from what dispatches (e.g. 'flash' silently falls back to the
+    materializing XLA attention when the kernel failed to import). 'sparse'
+    and 'fpdt' branch before this op and materialize score-class workspace,
+    so they are never flash for estimation purposes."""
+    if impl in ("sparse", "fpdt"):
+        return False
+    pallas = available_impls("causal_attention").get("pallas")
+    return pallas is not None and dispatch("causal_attention", impl) is pallas
+
+
 def causal_attention(q, k, v, mask=None, impl: str = "auto",
                      alibi_slopes=None, bias=None, **kernel_kwargs):
     """Grouped-query causal attention with optional ALiBi slopes and additive

@@ -762,6 +762,7 @@ class DeepSpeedTPUEngine:
         if not (gcfg.enabled or gcfg.warn or want_calibration):
             return
         from deepspeed_tpu.autotuning.autotuner import estimate_state_memory
+        from deepspeed_tpu.ops.attention import resolves_to_flash
         from deepspeed_tpu.utils.hbm import check_hbm_fit
 
         try:
@@ -792,14 +793,12 @@ class DeepSpeedTPUEngine:
             remat=bool(getattr(mcfg, "remat", True)),
             fused_ce=bool(getattr(mcfg, "fused_ce", False)),
             # flash attention never materializes the score matrix, so the
-            # attention temp-workspace term vanishes. Derive from the
-            # model's attn_impl: 'auto' resolves like the ops registry
-            # (pallas on TPU), 'flash' forces it, anything else ('xla',
-            # 'sparse', 'fpdt') materializes score-class workspace
-            flash_attention=(
-                getattr(mcfg, "attn_impl", "auto") == "flash"
-                or (getattr(mcfg, "attn_impl", "auto") == "auto"
-                    and jax.default_backend() == "tpu")),
+            # attention temp-workspace term vanishes. Ask the ops registry
+            # which implementation would actually dispatch for this
+            # attn_impl — if the Pallas kernel cannot serve the config the
+            # estimate must keep the score-matrix workspace term
+            flash_attention=resolves_to_flash(
+                getattr(mcfg, "attn_impl", "auto")),
         )
         self._hbm_estimate_bytes = int(need)
         from deepspeed_tpu.telemetry.programs import get_program_registry

@@ -83,6 +83,31 @@ def shape_dtype_struct(shape, dtype, *like):
         return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def axis_env_sizes() -> "dict[str, int]":
+    """(name -> size) of every mesh axis bound in the trace-time axis env,
+    in binding order (full-manual shard_map binds them all). The axis env
+    lives behind private jax internals that have moved across releases —
+    try each known spelling (same pattern as ``tpu_compiler_params`` /
+    ``shape_dtype_struct``) so a rename cannot break every caller at trace
+    time. Returns ``{}`` outside any bound-axis context."""
+    from jax._src import core as _core
+
+    get_env = getattr(_core, "get_axis_env", None)
+    if get_env is not None:  # jax >= 0.4.3x: AxisEnv with .axis_sizes
+        sizes = getattr(get_env(), "axis_sizes", None)
+        if sizes is not None:
+            return {str(k): int(v) for k, v in dict(sizes).items()}
+    # older spelling: thread-local AxisEnvFrame(name, size, ...) records
+    tls = getattr(_core, "thread_local_state", None)
+    frames = getattr(getattr(tls, "trace_state", None), "axis_env", None)
+    if frames is not None:
+        return {str(f.name): int(f.size) for f in frames
+                if f.name is not None}
+    raise RuntimeError(
+        "cannot locate the jax axis env on this version — "
+        "utils/compat.axis_env_sizes needs a new spelling")
+
+
 def tpu_compiler_params(**kwargs):
     """Pallas TPU compiler params across the class rename
     (``pltpu.CompilerParams`` on new jax, ``pltpu.TPUCompilerParams`` on
