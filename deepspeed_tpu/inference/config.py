@@ -38,6 +38,10 @@ class QuantConfig(DeepSpeedConfigModel):
     group_size: int = 128
     qtype: str = "int"  # 'int' (int8/int4 by bits) | 'fp' (fp8)
     min_leaf_size: int = 1 << 16  # kernels smaller than this stay dense
+    # Per-tensor-class selection (woq.TENSOR_CLASSES): which weight families
+    # quantize — 'attn' (wq/wk/wv/wo), 'mlp' (w_up/w_gate/w_down), 'experts',
+    # 'lm_head'. None = every eligible kernel (the legacy behavior).
+    tensor_classes: Optional[list] = None
 
 
 class ZeroInferenceConfig(DeepSpeedConfigModel):
@@ -93,9 +97,11 @@ class InferenceConfig(DeepSpeedConfigModel):
     # Pre-flight HBM-fit check (utils/hbm.py) before param placement:
     # "warn" | "refuse" | "off". An over-budget materialization on this
     # platform wedges the device without raising (PERF.md round 5), so the
-    # bench extras run "refuse"; zero_inference/WOQ shrink the device
-    # footprint and the estimate accounts for neither, so the check uses the
-    # dense placement bytes (a conservative upper bound).
+    # bench extras run "refuse". With WOQ enabled the estimate uses the
+    # quantized byte formula (woq.quantized_bytes_estimate — values + scales
+    # through the same eligibility predicate the real pass applies), so a
+    # model that only fits quantized is admitted; zero_inference keeps the
+    # big weights off-device and skips the check entirely.
     hbm_check: str = "warn"
 
     @property

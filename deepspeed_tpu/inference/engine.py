@@ -61,20 +61,45 @@ class InferenceEngine:
                 "dtype='int8' would truncate weights via astype; int8 weights "
                 "are weight-only quantization — use quant={'enabled': True, 'bits': 8}"
             )
+        nvme_mode = config.zero_inference.enabled and config.zero_inference.offload == "nvme"
+        woq_on = config.quant.enabled and not nvme_mode
+        tp_size = max(mesh.shape["tp"], 1)
+        # WOQ ordering vs placement: on a tp=1 mesh quantization runs BEFORE
+        # placement, so the dense weights never materialize on device and the
+        # guard's quantized byte formula is the true placement peak. On tp>1
+        # the pre-quantized flat layout can't ride the name-based dim rules
+        # (it would place replicated — MORE per-device bytes than a dense tp
+        # shard for tp>2), so those meshes keep the original flow: place the
+        # dense shards, then quantize in place.
+        pre_quant = woq_on and tp_size == 1
         if config.hbm_check != "off" and not config.zero_inference.enabled:
             # refuse/warn BEFORE placement (an over-budget materialization
-            # wedges this platform without raising); dense-bytes upper bound,
-            # skipped when zero_inference keeps the big weights off-device
+            # wedges this platform without raising); skipped when
+            # zero_inference keeps the big weights off-device. With
+            # pre-placement WOQ the estimate is the QUANTIZED byte formula
+            # (values + scales through the same eligibility predicate the
+            # real pass applies) — a model that only fits quantized must be
+            # admitted; tp>1 keeps the dense-shard upper bound (that IS the
+            # placement peak there).
             from deepspeed_tpu.utils.hbm import check_hbm_fit
 
-            n_elems = sum(x.size for x in jax.tree_util.tree_leaves(params))
-            check_hbm_fit(
-                n_elems * jnp.dtype(dtype).itemsize // max(mesh.shape["tp"], 1),
-                what="init_inference param placement", mode=config.hbm_check)
-        self.params = place_parameters(params, mesh, causal_lm_partition_rules, dtype)
+            dtype_b = jnp.dtype(dtype).itemsize
+            if pre_quant:
+                from deepspeed_tpu.inference.woq import (
+                    quantized_bytes_estimate,
+                    woq_format,
+                )
 
-        nvme_mode = config.zero_inference.enabled and config.zero_inference.offload == "nvme"
-        if config.quant.enabled and not nvme_mode:
+                need = quantized_bytes_estimate(
+                    params, woq_format(config.quant),
+                    min_size=config.quant.min_leaf_size,
+                    classes=config.quant.tensor_classes, dense_itemsize=dtype_b)
+            else:
+                n_elems = sum(x.size for x in jax.tree_util.tree_leaves(params))
+                need = n_elems * dtype_b // tp_size
+            check_hbm_fit(need, what="init_inference param placement",
+                          mode=config.hbm_check)
+        if woq_on:
             # WOQ: int8/int4/fp8 bytes in HBM, dequant fused into each matmul
             # (reference inference/quantization + fp_quantizer; see woq.py).
             # In NVMe mode quantization happens per layer slice inside
@@ -82,13 +107,28 @@ class InferenceEngine:
             from deepspeed_tpu.inference.woq import quantize_params, woq_bytes, woq_format
 
             fmt = woq_format(config.quant)
-            dense_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(self.params))
             min_size = config.quant.min_leaf_size
-            self.params = jax.jit(lambda p: quantize_params(p, fmt, min_size=min_size))(self.params)
+            classes = config.quant.tensor_classes
+            dense_bytes = sum(
+                x.size * jnp.dtype(dtype).itemsize
+                for x in jax.tree_util.tree_leaves(params))
+            if pre_quant:
+                params = quantize_params(params, fmt, min_size=min_size,
+                                         classes=classes)
+                q_bytes = woq_bytes(params)
+            self.params = place_parameters(params, mesh, causal_lm_partition_rules, dtype)
+            if not pre_quant:
+                # tp>1: quantize the placed shards (sharding preserved by the
+                # jitted per-leaf math; transient peak = dense + quantized)
+                self.params = jax.jit(lambda p: quantize_params(
+                    p, fmt, min_size=min_size, classes=classes))(self.params)
+                q_bytes = woq_bytes(self.params)
             log_dist(
-                f"WOQ[{fmt}]: weights {dense_bytes/1e6:.0f} MB -> {woq_bytes(self.params)/1e6:.0f} MB",
+                f"WOQ[{fmt}]: weights {dense_bytes/1e6:.0f} MB -> {q_bytes/1e6:.0f} MB",
                 ranks=[0],
             )
+        else:
+            self.params = place_parameters(params, mesh, causal_lm_partition_rules, dtype)
 
         self._streamed = None  # NVMe mode: layer-streamed forward/generate
         if config.zero_inference.enabled:

@@ -39,7 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pydantic import Field
 
 from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
-from deepspeed_tpu.inference.config import ServingSLOConfig
+from deepspeed_tpu.inference.config import QuantConfig, ServingSLOConfig
 from deepspeed_tpu.inference.lifecycle import LifecycleTracker
 from deepspeed_tpu.inference.paged import (
     PagedKVPool,
@@ -69,6 +69,21 @@ class RaggedInferenceConfig(DeepSpeedConfigModel):
     tp_size: int = 1
     kv_block_size: int = 16
     num_kv_blocks: int = 512
+    # Quantized KV-cache storage (ISSUE 10): None = pool in ``dtype``;
+    # "int8" | "fp8" = pool holds 1-byte values + one fp32 scale per
+    # (layer, slot, kv-head) head vector (the shared ops.quant block math),
+    # dequant fused into the paged-attention block loads. ~1.9x the token
+    # slots per HBM byte at head_dim>=64 — the admission-capacity lever.
+    kv_cache_dtype: Optional[str] = None
+    # Byte budget for the paged pool: when set, ``num_kv_blocks`` is DERIVED
+    # as kv_blocks_for_bytes(kv_pool_bytes, ...) with the real (quantized or
+    # dense) block bytes — fixed HBM, variable capacity. None keeps the
+    # explicit num_kv_blocks.
+    kv_pool_bytes: Optional[int] = None
+    # Weight-only quantization for the serving weights (inference/woq.py —
+    # same QuantConfig as v1 init_inference, incl. per-tensor-class
+    # selection): int8/int4/fp8 bytes in HBM, dequant at the matmul boundary.
+    quant: QuantConfig = Field(default_factory=QuantConfig)
     max_seqs: int = 64  # max concurrently tracked sequences
     max_seq_len: Optional[int] = None  # default: model max_seq_len
     row_bucket: int = 8
@@ -95,6 +110,34 @@ class RaggedInferenceConfig(DeepSpeedConfigModel):
         from deepspeed_tpu.inference.config import _DTYPES
 
         return _DTYPES[self.dtype.lower()]
+
+    @property
+    def kv_quant(self) -> Optional[str]:
+        """None | 'int8' | 'fp8' — quantized-storage mode of the KV pool."""
+        name = (self.kv_cache_dtype or "").lower()
+        if name in ("int8", "fp8"):
+            return name
+        from deepspeed_tpu.inference.config import _DTYPES
+
+        if name and name not in _DTYPES:
+            raise ValueError(
+                f"kv_cache_dtype must be a float dtype name or 'int8'|'fp8', "
+                f"got {self.kv_cache_dtype!r}")
+        return None
+
+    @property
+    def kv_jax_dtype(self):
+        """Pool storage dtype when NOT block-quantized (default: compute)."""
+        from deepspeed_tpu.inference.config import _DTYPES
+
+        if self.kv_quant is not None or not self.kv_cache_dtype:
+            return self.jax_dtype
+        return _DTYPES[self.kv_cache_dtype.lower()]
+
+    @property
+    def kv_dtype_name(self) -> str:
+        """The label the serving gauges carry ('int8'/'fp8'/float name)."""
+        return (self.kv_cache_dtype or self.dtype).lower()
 
 
 def build_hf_engine(
@@ -137,11 +180,33 @@ class InferenceEngineV2:
         max_len = config.max_seq_len or model_config.max_seq_len
         self.max_seq_len = max_len
         self.max_pages = -(-max_len // config.kv_block_size)
-        self.state = StateManager(config.num_kv_blocks, config.kv_block_size, config.max_seqs,
+
+        from deepspeed_tpu.utils.hbm import kv_blocks_for_bytes, kv_slot_bytes
+
+        dtype = config.jax_dtype
+        kv_quant = config.kv_quant
+        kv_dtype = config.kv_jax_dtype
+        kv_dtype_b = jnp.dtype(kv_dtype).itemsize
+        # The real (quantized or dense) per-token pool cost — ONE formula
+        # shared with the pre-flight guard and the capacity benchmark.
+        self.kv_bytes_per_token = kv_slot_bytes(
+            model_config.num_layers, model_config.kv_heads,
+            model_config.dims_per_head, kv_dtype_b, kv_quant)
+        if config.kv_pool_bytes is not None:
+            # byte-budget sizing: admission capacity follows the REAL block
+            # bytes, so an int8 pool at the same budget admits ~1.9x the
+            # concurrent requests of a bf16 one
+            num_blocks = kv_blocks_for_bytes(
+                config.kv_pool_bytes, model_config.num_layers,
+                config.kv_block_size, model_config.kv_heads,
+                model_config.dims_per_head, kv_dtype_b, kv_quant)
+        else:
+            num_blocks = config.num_kv_blocks
+        self.num_kv_blocks = num_blocks
+        self.state = StateManager(num_blocks, config.kv_block_size, config.max_seqs,
                                   max_blocks_per_seq=self.max_pages)
         self._staging = BatchStaging(self.max_pages)
 
-        dtype = config.jax_dtype
         n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
         kv_on_tp = model_config.kv_heads % mesh.shape["tp"] == 0
         # Compiled-program registry (telemetry/programs.py): the v2 step
@@ -155,24 +220,73 @@ class InferenceEngineV2:
             # Refuse/warn BEFORE any device materialization: PER-DEVICE bytes
             # — params shard over tp (autotp partition rules), the KV pool
             # shards over tp only when kv_heads divides — plus a
-            # [rows, vocab] logits buffer.
+            # [rows, vocab] logits buffer. Quantized storage enters with its
+            # REAL byte formulas: a pool/model that only fits quantized is
+            # admitted, an over-budget one refused before the wedge.
             from deepspeed_tpu.utils.hbm import check_hbm_fit
 
             tp = max(mesh.shape["tp"], 1)
             dtype_b = jnp.dtype(dtype).itemsize
-            kv_elems = (2 * model_config.num_layers
-                        * (config.num_kv_blocks * config.kv_block_size + 1)
-                        * model_config.kv_heads * model_config.dims_per_head)
-            need = (n_params * dtype_b // tp
-                    + kv_elems * dtype_b // (tp if kv_on_tp else 1)
-                    + config.row_bucket * model_config.vocab_size * 4)
+            if config.quant.enabled and tp == 1:
+                from deepspeed_tpu.inference.woq import (
+                    quantized_bytes_estimate,
+                    woq_format,
+                )
+
+                param_bytes = quantized_bytes_estimate(
+                    params, woq_format(config.quant),
+                    min_size=config.quant.min_leaf_size,
+                    classes=config.quant.tensor_classes, dense_itemsize=dtype_b)
+            else:
+                # tp>1 places dense shards first (WOQ quantizes in place
+                # after — see below), so the dense tp-shard bytes ARE the
+                # placement peak
+                param_bytes = n_params * dtype_b // tp
+            kv_bytes = (num_blocks * config.kv_block_size + 1) * self.kv_bytes_per_token
+            # per-step attention workspace of the gather fallback: one
+            # layer's gathered (dequantized) KV blocks + fp32 score/prob
+            # arrays for a bucketed step (round-10 calibration: without it
+            # the serving estimate under-counted 2-3.5x on configs whose
+            # pool doesn't dominate; the Pallas path needs less — estimates
+            # must cover the worst dispatching path)
+            gathered = self.max_pages * config.kv_block_size
+            workspace = config.row_bucket * gathered * (
+                2 * model_config.kv_heads * model_config.dims_per_head * dtype_b
+                + 2 * model_config.num_heads * config.chunk_bucket * 4)
+            need = (param_bytes
+                    + kv_bytes // (tp if kv_on_tp else 1)
+                    + config.row_bucket * model_config.vocab_size * 4
+                    + workspace)
             if config.hbm_check != "off":
                 check_hbm_fit(need, what="InferenceEngineV2 init (params + KV pool)",
                               mode=config.hbm_check)
             self._programs.set_hbm_estimate(need, scope="serving")
+        woq_pre = config.quant.enabled and max(mesh.shape["tp"], 1) == 1
+        if woq_pre:
+            # WOQ before placement (the dense weights never hit the device):
+            # int8/int4/fp8 values + fp32 scales, dequant at each matmul
+            # boundary with compute-dtype accumulation (inference/woq.py).
+            # tp>1 instead places the dense shards and quantizes after — the
+            # pre-quantized flat layout would place replicated, costing MORE
+            # per device than a dense tp shard for tp>2.
+            from deepspeed_tpu.inference.woq import quantize_params, woq_format
+
+            params = quantize_params(
+                params, woq_format(config.quant),
+                min_size=config.quant.min_leaf_size,
+                classes=config.quant.tensor_classes)
         self.params = place_parameters(params, mesh, causal_lm_partition_rules, dtype)
+        if config.quant.enabled and not woq_pre:
+            from deepspeed_tpu.inference.woq import quantize_params, woq_format
+
+            fmt = woq_format(config.quant)
+            min_size = config.quant.min_leaf_size
+            classes = config.quant.tensor_classes
+            self.params = jax.jit(lambda p: quantize_params(
+                p, fmt, min_size=min_size, classes=classes))(self.params)
         # KV pool: kv-head dim over tp, slots replicated over dp
-        pool = init_pool(model_config, config.num_kv_blocks, config.kv_block_size, dtype)
+        pool = init_pool(model_config, num_blocks, config.kv_block_size, kv_dtype,
+                         kv_quant=kv_quant)
         if not kv_on_tp and mesh.shape["tp"] > 1:
             # correct but a quiet perf/memory cliff: each tp rank holds the
             # FULL pool instead of 1/tp of it (round-3 verdict weak item 8)
@@ -183,10 +297,15 @@ class InferenceEngineV2:
                 ranks=[0],
             )
         kv_spec = NamedSharding(mesh, P(None, None, "tp" if kv_on_tp else None, None))
-        self.pool = PagedKVPool(k=jax.device_put(pool.k, kv_spec), v=jax.device_put(pool.v, kv_spec))
+        self.pool = PagedKVPool(
+            k=jax.device_put(pool.k, kv_spec), v=jax.device_put(pool.v, kv_spec),
+            k_scale=None if pool.k_scale is None else jax.device_put(pool.k_scale, kv_spec),
+            v_scale=None if pool.v_scale is None else jax.device_put(pool.v_scale, kv_spec))
         log_dist(
             f"InferenceEngineV2: {n_params/1e6:.1f}M params, "
-            f"{config.num_kv_blocks}x{config.kv_block_size} KV slots, mesh={dict(mesh.shape)}"
+            f"{num_blocks}x{config.kv_block_size} KV slots "
+            f"[{config.kv_dtype_name}, {self.kv_bytes_per_token} B/token], "
+            f"mesh={dict(mesh.shape)}"
         )
         self._step_cache: Dict[Tuple, Any] = {}
         self._chain_buf: Dict[int, Dict[str, np.ndarray]] = {}
@@ -205,7 +324,7 @@ class InferenceEngineV2:
             self._recorder.set_context(
                 kind="serving", max_seqs=config.max_seqs,
                 decode_chain=config.decode_chain,
-                kv_blocks=config.num_kv_blocks)
+                kv_blocks=self.num_kv_blocks)
             install_process_hooks()
         # Most recent generate()'s per-request tracker (None when telemetry
         # is disabled and no recorder is configured — no records allocated).
@@ -486,7 +605,7 @@ class InferenceEngineV2:
         records are allocated and the loop is unchanged.
         """
         prompts = [np.asarray(p, np.int32) for p in prompts]
-        pool_tokens = self.config.num_kv_blocks * self.config.kv_block_size
+        pool_tokens = self.num_kv_blocks * self.config.kv_block_size
         for i, p in enumerate(prompts):
             if len(p) + max_new_tokens > self.max_seq_len:
                 raise ValueError(
@@ -536,7 +655,14 @@ class InferenceEngineV2:
             g_queue = registry.gauge("serving/queue_depth")
             g_occ = registry.gauge("serving/batch_occupancy")
             g_free = registry.gauge("serving/kv_pool_free_blocks")
-            g_util = registry.gauge("serving/kv_pool_utilization")
+            kv_name = self.config.kv_dtype_name
+            g_util = registry.gauge("serving/kv_pool_utilization", dtype=kv_name)
+            # quantized-serving capacity facts (set once — they are config,
+            # not chain-boundary state): which storage the pool runs and what
+            # one token slot costs, the number capacity plans divide HBM by
+            registry.gauge("serving/kv_pool_dtype", dtype=kv_name).set(1.0)
+            registry.gauge("serving/kv_bytes_per_token").set(
+                float(self.kv_bytes_per_token))
             c_preempt = registry.counter("serving/preemptions")
             c_tokens = registry.counter("serving/tokens_decoded")
             c_chains = registry.counter("serving/chains")
@@ -600,7 +726,7 @@ class InferenceEngineV2:
                             continue
                     raise RuntimeError(
                         f"KV pool too small for a single sequence "
-                        f"({self.config.num_kv_blocks} blocks x {self.config.kv_block_size})"
+                        f"({self.num_kv_blocks} blocks x {self.config.kv_block_size})"
                     )
                 continue
 
@@ -634,7 +760,7 @@ class InferenceEngineV2:
                 if not uids:
                     raise RuntimeError(
                         f"KV pool too small for a single sequence "
-                        f"({self.config.num_kv_blocks} blocks x {self.config.kv_block_size})"
+                        f"({self.num_kv_blocks} blocks x {self.config.kv_block_size})"
                     )
                 k = self.config.decode_chain
             last = [gen[active[u]][-1] for u in uids]

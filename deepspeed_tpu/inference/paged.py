@@ -35,21 +35,63 @@ class PagedKVPool(NamedTuple):
     the trash slot (reference: FastGen preallocates the KV arena up front from
     a memory budget, ``DSStateManager`` + ``KVCacheConfig``). ``block_size``
     is carried by the engine, not here — this NamedTuple is a jit pytree and
-    must hold only arrays."""
+    must hold only arrays.
+
+    Quantized storage (``kv_quant='int8'|'fp8'``): k/v hold int8/e4m3 values
+    and ``k_scale``/``v_scale`` carry one fp32 scale per (layer, slot, kv-head)
+    — the quantization block is the ``hd`` head vector, so a token's KV write
+    is one ``ops.quant`` block-math call and dequant needs only the slot's own
+    scale (fused into the paged-attention block loads). ``None`` scales mean a
+    full-precision pool (the pre-quantization layout, unchanged)."""
 
     k: jax.Array
     v: jax.Array
+    k_scale: Optional[jax.Array] = None  # [L, S_flat, kvH, 1] fp32, or None
+    v_scale: Optional[jax.Array] = None
 
     @property
     def num_slots(self) -> int:  # excludes trash
         return self.k.shape[1] - 1
 
+    @property
+    def quant(self) -> Optional[str]:
+        """Storage quantization mode, derived from the value dtype (trace-time
+        static): None | 'int8' | 'fp8'."""
+        if self.k_scale is None:
+            return None
+        return "fp8" if self.k.dtype == jnp.float8_e4m3fn else "int8"
+
+
+_KV_QUANT_DTYPES = {"int8": jnp.int8, "fp8": jnp.float8_e4m3fn}
+
 
 def init_pool(
-    cfg: TransformerConfig, num_blocks: int, block_size: int, dtype: Any = jnp.bfloat16
+    cfg: TransformerConfig, num_blocks: int, block_size: int, dtype: Any = jnp.bfloat16,
+    kv_quant: Optional[str] = None,
 ) -> PagedKVPool:
     shape = (cfg.num_layers, num_blocks * block_size + 1, cfg.kv_heads, cfg.dims_per_head)
-    return PagedKVPool(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+    if kv_quant is None:
+        return PagedKVPool(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+    if kv_quant not in _KV_QUANT_DTYPES:
+        raise ValueError(f"kv_quant must be None|'int8'|'fp8', got {kv_quant!r}")
+    qdt = _KV_QUANT_DTYPES[kv_quant]
+    sshape = shape[:3] + (1,)
+    return PagedKVPool(k=jnp.zeros(shape, qdt), v=jnp.zeros(shape, qdt),
+                       k_scale=jnp.zeros(sshape, jnp.float32),
+                       v_scale=jnp.zeros(sshape, jnp.float32))
+
+
+def _kv_block_quant(x: jax.Array, quant: str):
+    """``[T, kvH, hd] float -> (values [T, kvH, hd], scales [T, kvH, 1])``
+    through THE shared block math (``ops.quant``): one symmetric absmax block
+    per (token, head) ``hd`` vector, so pool scatters stay one-scatter-per-
+    array and dequant is a per-slot multiply."""
+    from deepspeed_tpu.ops.quant import fp8_block_math, int8_block_math
+
+    T, kvH, hd = x.shape
+    x2 = x.astype(jnp.float32).reshape(T * kvH, hd)
+    q, s = int8_block_math(x2) if quant == "int8" else fp8_block_math(x2)
+    return q.reshape(T, kvH, hd), s.reshape(T, kvH, 1)
 
 
 def _slot_ids(block_tables: jax.Array, positions: jax.Array, valid: jax.Array,
@@ -65,13 +107,18 @@ from deepspeed_tpu.ops.registry import dispatch, register
 
 @register("paged_attention", "xla")
 def _xla_paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block_size,
-                         new_lens=None, alibi_slopes=None):
+                         new_lens=None, alibi_slopes=None, k_scale=None, v_scale=None):
     """Masked GQA attention of new queries against paged caches (dense-gather
     fallback; the Pallas flash-decode kernel in
     ``ops/pallas/paged_attention.py`` wins dispatch on TPU).
 
     q: [N, C, H, hd]; pool_{k,v}_l: [S_flat, kvH, hd] (one layer's pool);
     block_tables: [N, P]; q_positions: [N, C]. Returns [N, C, H, hd].
+
+    ``k_scale``/``v_scale`` ([S_flat, kvH, 1] fp32) mark a quantized pool:
+    dequantization happens on the GATHERED blocks ([N, P*bs, ...], bounded by
+    the batch's block tables) — the full-precision [S_flat, kvH, hd] pool is
+    never materialized.
     """
     N, C, H, hd = q.shape
     P = block_tables.shape[1]
@@ -79,6 +126,9 @@ def _xla_paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block
     slot = slot.reshape(N, P * block_size)  # global position j -> pool slot
     ck = pool_k_l[slot]  # [N, P*bs, kvH, hd]
     cv = pool_v_l[slot]
+    if k_scale is not None:
+        ck = (ck.astype(jnp.float32) * k_scale[slot]).astype(q.dtype)
+        cv = (cv.astype(jnp.float32) * v_scale[slot]).astype(q.dtype)
     kvH = ck.shape[2]
     G = H // kvH
     qg = q.reshape(N, C, kvH, G, hd)
@@ -98,15 +148,19 @@ def _xla_paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block
 
 
 def paged_attention(q, pool_k_l, pool_v_l, block_tables, q_positions, block_size,
-                    new_lens=None, impl: str = "auto", alibi_slopes=None):
+                    new_lens=None, impl: str = "auto", alibi_slopes=None,
+                    k_scale=None, v_scale=None):
     import deepspeed_tpu.ops.pallas.paged_attention  # noqa: F401  (registers the kernel)
 
     # alibi is fused in BOTH implementations (the Pallas flash-decode kernel
     # adds slope * key-position on its existing position iota), so dispatch
-    # is uniform — bloom keeps the fast decode path.
+    # is uniform — bloom keeps the fast decode path. Likewise quantized-pool
+    # dequant: the kernel fuses it into its VMEM block loads, the XLA
+    # fallback applies it to the gathered blocks.
     return dispatch("paged_attention", impl)(
         q, pool_k_l, pool_v_l, block_tables, q_positions, block_size,
-        new_lens=new_lens, alibi_slopes=alibi_slopes
+        new_lens=new_lens, alibi_slopes=alibi_slopes,
+        k_scale=k_scale, v_scale=v_scale,
     )
 
 
@@ -145,8 +199,10 @@ def _forward_hidden(
     if "layers" not in params:
         raise ValueError("ragged inference requires scan_layers=True stacked params")
 
+    quant = pool.quant  # static at trace time (value dtype + scale presence)
+
     def body(x, xs):
-        lp, pk, pv = xs
+        lp, pk, pv, psk, psv = xs
         h = _apply_norm(lp["attn_norm"], cfg, x)
         q, k, v = _qkv(lp["attn"], cfg, h)
         if cfg.position == "rope":
@@ -154,27 +210,40 @@ def _forward_hidden(
 
             q, k = apply_qk_rope(cfg, q, k, positions)
         kvH, hd = k.shape[-2], k.shape[-1]
-        pk = pk.at[flat_slot].set(k.astype(pk.dtype).reshape(-1, kvH, hd), mode="drop")
-        pv = pv.at[flat_slot].set(v.astype(pv.dtype).reshape(-1, kvH, hd), mode="drop")
+        if quant is not None:
+            # quantized KV write: the same one-scatter-per-array shape, plus
+            # one scale scatter per array (pad rows route to the trash slot
+            # for values AND scales alike)
+            kq, ks = _kv_block_quant(k.reshape(-1, kvH, hd), quant)
+            vq, vs = _kv_block_quant(v.reshape(-1, kvH, hd), quant)
+            pk = pk.at[flat_slot].set(kq.astype(pk.dtype), mode="drop")
+            pv = pv.at[flat_slot].set(vq.astype(pv.dtype), mode="drop")
+            psk = psk.at[flat_slot].set(ks, mode="drop")
+            psv = psv.at[flat_slot].set(vs, mode="drop")
+        else:
+            pk = pk.at[flat_slot].set(k.astype(pk.dtype).reshape(-1, kvH, hd), mode="drop")
+            pv = pv.at[flat_slot].set(v.astype(pv.dtype).reshape(-1, kvH, hd), mode="drop")
         ctx = paged_attention(q, pk, pv, block_tables, positions, bs,
-                              new_lens=new_lens, alibi_slopes=alibi)
+                              new_lens=new_lens, alibi_slopes=alibi,
+                              k_scale=psk, v_scale=psv)
         attn_out = _attn_out(lp["attn"], cfg, ctx)
         if cfg.parallel_block:
             # falcon/phi-style: attn and FFN read the shared input norm;
             # gpt-neox-style (parallel_mlp_norm): FFN reads its own ln2(x)
             ffn_in = _apply_norm(lp["mlp_norm"], cfg, x) if cfg.parallel_mlp_norm else h
             ffn = _moe(lp["moe"], cfg, ffn_in) if cfg.num_experts > 0 else _mlp(lp["mlp"], cfg, ffn_in)
-            return x + attn_out + ffn, (pk, pv)
+            return x + attn_out + ffn, (pk, pv, psk, psv)
         x = x + attn_out
         h = _apply_norm(lp["mlp_norm"], cfg, x)
         if cfg.num_experts > 0:
             x = x + _moe(lp["moe"], cfg, h)
         else:
             x = x + _mlp(lp["mlp"], cfg, h)
-        return x, (pk, pv)
+        return x, (pk, pv, psk, psv)
 
-    x, (k_new, v_new) = jax.lax.scan(body, x, (params["layers"], pool.k, pool.v))
-    pool = pool._replace(k=k_new, v=v_new)
+    x, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+        body, x, (params["layers"], pool.k, pool.v, pool.k_scale, pool.v_scale))
+    pool = pool._replace(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new)
 
     last = jnp.take_along_axis(
         x, jnp.maximum(new_lens - 1, 0)[:, None, None], axis=1

@@ -188,7 +188,47 @@ def woq_format(quant_cfg) -> str:
     raise ValueError(f"unsupported WOQ bits={quant_cfg.bits} (8 or 4)")
 
 
-def quantize_params(params: Any, fmt: str, min_size: int = 1 << 16) -> Any:
+# Per-tensor-class selection (``QuantConfig.tensor_classes``): which weight
+# families get quantized storage. Matching is on quoted path tokens (the
+# ``keystr`` idiom used everywhere in this file) so e.g. 'wo' never matches
+# inside another name.
+TENSOR_CLASSES = {
+    "attn": ("'wq'", "'wk'", "'wv'", "'wo'"),
+    "mlp": ("'w_up'", "'w_gate'", "'w_down'"),
+    "experts": ("'experts'",),
+    "lm_head": ("'lm_head'",),
+}
+
+
+def _class_selected(key: str, classes) -> bool:
+    if classes is None:
+        return True
+    for c in classes:
+        if c not in TENSOR_CLASSES:
+            raise ValueError(
+                f"unknown WOQ tensor class {c!r} (choose from {sorted(TENSOR_CLASSES)})")
+        if any(tok in key for tok in TENSOR_CLASSES[c]):
+            return True
+    return False
+
+
+def _eligible(key: str, shape, size: int, fmt: str, min_size: int, classes) -> bool:
+    """THE quantization predicate — shared by :func:`quantize_params` and the
+    pre-flight byte estimate so the guard's math can't drift from what
+    actually quantizes. ``shape``/``size`` only (works on abstract leaves)."""
+    if "embed" in key:
+        return False
+    if len(shape) < 2 or size < min_size:
+        return False
+    if shape[-1] % 2 and fmt == "int4":
+        return False  # odd trailing dim: leave dense
+    if "'layers'" in key and len(shape) < 3:
+        return False  # a [L, n] stack quantizes per-row poorly; leave dense
+    return _class_selected(key, classes)
+
+
+def quantize_params(params: Any, fmt: str, min_size: int = 1 << 16,
+                    classes=None) -> Any:
     """Quantize every 2D+ floating kernel above ``min_size`` elements.
 
     Norm scales, biases, and small tensors stay in the compute dtype (the
@@ -198,24 +238,61 @@ def quantize_params(params: Any, fmt: str, min_size: int = 1 << 16) -> Any:
 
     Leaves under a stacked ``'layers'`` subtree (scan_layers layout) are
     quantized per leading slice so ``lax.scan`` over the stack stays valid.
+
+    ``classes`` (None = everything eligible) restricts quantization to the
+    named :data:`TENSOR_CLASSES` — the reference exposes per-matrix-type WOQ
+    config the same way (attention vs MLP vs head).
     """
 
     def leaf(path, x):
         if not isinstance(x, jax.Array) or not jnp.issubdtype(x.dtype, jnp.floating):
             return x
         key = jax.tree_util.keystr(path)
-        if "embed" in key:
+        if not _eligible(key, x.shape, x.size, fmt, min_size, classes):
             return x
-        if x.ndim < 2 or x.size < min_size:
-            return x
-        if x.shape[-1] % 2 and fmt == "int4":
-            return x  # odd trailing dim: leave dense
-        stacked = "'layers'" in key
-        if stacked and x.ndim < 3:
-            return x  # a [L, n] stack quantizes per-row poorly; leave dense
-        return _quantize_leaf(x, fmt, stacked=stacked)
+        return _quantize_leaf(x, fmt, stacked="'layers'" in key)
 
     return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def quantized_bytes_estimate(params: Any, fmt: str, min_size: int = 1 << 16,
+                             classes=None, dense_itemsize: int = 2,
+                             block: int = _BLOCK) -> int:
+    """HBM bytes the tree will occupy AFTER :func:`quantize_params` — without
+    quantizing anything (the pre-flight guard runs BEFORE materialization).
+
+    Uses the same :func:`_eligible` predicate as the real pass: quantized
+    leaves cost ``size * fmt_bytes + ceil(size/block) * 4`` (values + fp32
+    scales), everything else stays at ``dense_itemsize`` (floats; integer
+    leaves keep their own itemsize).
+    """
+    per_el = {"int8": 1.0, "fp8": 1.0, "int4": 0.5}[fmt]
+    total = 0
+
+    def leaf(path, x):
+        nonlocal total
+        size = int(x.size)
+        floating = jnp.issubdtype(jnp.asarray(x).dtype if not hasattr(x, "dtype")
+                                  else x.dtype, jnp.floating)
+        if not floating:
+            total += size * jnp.dtype(x.dtype).itemsize
+            return x
+        key = jax.tree_util.keystr(path)
+        if _eligible(key, x.shape, size, fmt, min_size, classes):
+            # stacked leaves quantize per layer slice; the block count is the
+            # same total either way (blocks never span layers)
+            if "'layers'" in key and len(x.shape) >= 3:
+                per_layer = size // x.shape[0]
+                nb = x.shape[0] * (-(-per_layer // min(block, max(per_layer, 1))))
+            else:
+                nb = -(-size // min(block, max(size, 1)))
+            total += int(size * per_el) + nb * 4
+        else:
+            total += size * dense_itemsize
+        return x
+
+    jax.tree_util.tree_map_with_path(leaf, params)
+    return total
 
 
 def dequantize_params(params: Any, dtype) -> Any:

@@ -42,10 +42,12 @@ def _blocked(x: jax.Array, block_size: int):
 
 @register("quantize_fp8", "xla")
 def _quantize_fp8(x: jax.Array, block_size: int = DEFAULT_BLOCK):
+    # THE shared fp8 block math (ops.quant) — same formula as the wire codec,
+    # the fused collective hop kernel, and the quantized KV pool
+    from deepspeed_tpu.ops.quant import fp8_block_math
+
     x2, n, _ = _blocked(x, block_size)
-    absmax = jnp.max(jnp.abs(x2), axis=-1, keepdims=True)
-    scale = jnp.where(absmax == 0.0, 1.0, absmax / _FP8_MAX)
-    q = (x2 / scale).astype(jnp.float8_e4m3fn)
+    q, scale = fp8_block_math(x2)
     return q.reshape(-1)[:n].reshape(x.shape), scale.reshape(-1)
 
 

@@ -22,6 +22,12 @@ The KV-insert+RoPE side of the reference's kernel pair
 (``linear_blocked_kv_rotary``) stays an XLA scatter: ``.at[slots].set`` with
 the RoPE rotation feeding it fuses into a single scatter program under XLA,
 so a hand kernel buys nothing there.
+
+Quantized KV pools (int8/e4m3 values + per-(slot, head) fp32 scales — see
+``inference/paged.py``): the scale pages DMA alongside the value pages and
+dequantization happens on the VMEM tiles right after the block load, so the
+full-precision pool never materializes anywhere — HBM holds the quantized
+bytes, VMEM holds one dequantized page-chunk at a time.
 """
 
 from __future__ import annotations
@@ -50,11 +56,20 @@ def _cdiv(a: int, b: int) -> int:
     return (a + b - 1) // b
 
 
-def _decode_kernel(bt_ref, ap_ref, *refs, bs, ppcb, alibi=False):
+def _decode_kernel(bt_ref, ap_ref, *refs, bs, ppcb, alibi=False, quantized=False):
     refs = list(refs)
     q_ref, qpos_ref = refs.pop(0), refs.pop(0)
     slopes_ref = refs.pop(0) if alibi else None
-    (k_hbm, v_hbm, o_ref, kbuf, vbuf, acc_ref, m_ref, l_ref, sem_k, sem_v) = refs
+    (k_hbm, v_hbm) = refs.pop(0), refs.pop(0)
+    ks_hbm = vs_hbm = None
+    if quantized:
+        ks_hbm, vs_hbm = refs.pop(0), refs.pop(0)
+    o_ref = refs.pop(0)
+    kbuf, vbuf = refs.pop(0), refs.pop(0)
+    ksbuf = vsbuf = None
+    if quantized:
+        ksbuf, vsbuf = refs.pop(0), refs.pop(0)
+    acc_ref, m_ref, l_ref, sem_k, sem_v = refs
     n = pl.program_id(0)
     kh = pl.program_id(1)
     pc = pl.program_id(2)
@@ -76,14 +91,30 @@ def _decode_kernel(bt_ref, ap_ref, *refs, bs, ppcb, alibi=False):
             copies.append(pltpu.make_async_copy(
                 v_hbm.at[pl.ds(page * bs, bs), pl.ds(kh, 1)],
                 vbuf.at[pl.ds(i * bs, bs)], sem_v))
+            if quantized:
+                # the per-(slot, head) scales ride the same page DMAs — the
+                # fp-precision pool never exists anywhere, the dequant below
+                # happens on the VMEM tiles right after the block load
+                copies.append(pltpu.make_async_copy(
+                    ks_hbm.at[pl.ds(page * bs, bs), pl.ds(kh, 1)],
+                    ksbuf.at[pl.ds(i * bs, bs)], sem_k))
+                copies.append(pltpu.make_async_copy(
+                    vs_hbm.at[pl.ds(page * bs, bs), pl.ds(kh, 1)],
+                    vsbuf.at[pl.ds(i * bs, bs)], sem_v))
         for c in copies:
             c.start()
         for c in copies:
             c.wait()
 
         q = q_ref[0, 0]  # [Cg, hd] (pre-scaled)
-        k = kbuf[:, 0]  # [ppcb*bs, hd]
-        v = vbuf[:, 0]
+        if quantized:
+            # fused block-load dequant: int8/e4m3 tile * its per-slot scale,
+            # cast to the compute dtype (matches the XLA fallback's math)
+            k = (kbuf[:, 0].astype(jnp.float32) * ksbuf[:, 0][:, None]).astype(q_ref.dtype)
+            v = (vbuf[:, 0].astype(jnp.float32) * vsbuf[:, 0][:, None]).astype(q_ref.dtype)
+        else:
+            k = kbuf[:, 0]  # [ppcb*bs, hd]
+            v = vbuf[:, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [Cg, T]
@@ -130,6 +161,8 @@ def flash_decode_paged(
     new_lens: jax.Array = None,  # [N] live tokens (for page skipping)
     pages_per_block: int = DEFAULT_PAGES_PER_BLOCK,
     alibi_slopes: jax.Array = None,  # [H] fp32 (bloom ALiBi, fused in-kernel)
+    k_scale: jax.Array = None,  # [S_flat, kvH, 1] fp32 — quantized pool scales
+    v_scale: jax.Array = None,
 ) -> jax.Array:
     N, C, H, hd = q.shape
     kvH = pool_k_l.shape[1]
@@ -181,8 +214,28 @@ def flash_decode_paged(
         pl.BlockSpec(memory_space=pl.ANY),
         pl.BlockSpec(memory_space=pl.ANY),
     ]
+    quantized = k_scale is not None
+    pools = (pool_k_l, pool_v_l)
+    scratch = [
+        pltpu.VMEM((ppcb * bs, 1, hd), pool_k_l.dtype),
+        pltpu.VMEM((ppcb * bs, 1, hd), pool_v_l.dtype),
+    ]
+    if quantized:
+        # scales stream with their pages: [S_flat, kvH] fp32 in HBM, [bs, 1]
+        # slices DMA'd next to each value page
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        pools = pools + (k_scale.reshape(k_scale.shape[0], kvH),
+                         v_scale.reshape(v_scale.shape[0], kvH))
+        scratch += [
+            pltpu.VMEM((ppcb * bs, 1), jnp.float32),
+            pltpu.VMEM((ppcb * bs, 1), jnp.float32),
+        ]
 
-    kernel = functools.partial(_decode_kernel, bs=bs, ppcb=ppcb, alibi=alibi)
+    kernel = functools.partial(_decode_kernel, bs=bs, ppcb=ppcb, alibi=alibi,
+                               quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -190,9 +243,7 @@ def flash_decode_paged(
             grid=(N, kvH, npc),
             in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, Cgp, hd), lambda n, kh, pc, bt, ap: (n, kh, 0, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((ppcb * bs, 1, hd), pool_k_l.dtype),
-                pltpu.VMEM((ppcb * bs, 1, hd), pool_v_l.dtype),
+            scratch_shapes=scratch + [
                 pltpu.VMEM((Cgp, hd), jnp.float32),
                 pltpu.VMEM((Cgp, _LANES), jnp.float32),
                 pltpu.VMEM((Cgp, _LANES), jnp.float32),
@@ -205,7 +256,7 @@ def flash_decode_paged(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),
-    )(block_tables, active_pages, q5, qpos_rows, *extra, pool_k_l, pool_v_l)
+    )(block_tables, active_pages, q5, qpos_rows, *extra, *pools)
 
     out = out[:, :, :Cg].reshape(N, kvH, C, G, hd).transpose(0, 2, 1, 3, 4)
     return out.reshape(N, C, H, hd)

@@ -40,16 +40,34 @@ def divisible_spec(spec: Optional[P], shape: tuple, mesh: Mesh) -> P:
 
 
 def place_parameters(params: Any, mesh: Mesh, rules: Rules, dtype: Any = None) -> Any:
-    """device_put every leaf by its rule's spec (floats cast to ``dtype``)."""
+    """device_put every leaf by its rule's spec (floats cast to ``dtype``).
+
+    Pre-quantized WOQ leaves (``inference/woq.WOQTensor`` — quantized BEFORE
+    placement so the dense weights never materialize on device) place
+    replicated: the packed [blocks]-flat layout doesn't line up with the
+    name-based dim rules, and under GSPMD replication only costs memory, not
+    correctness. The inference engines therefore only pre-quantize on tp=1
+    meshes (where replicated == the whole model anyway, and the pre-flight
+    guard's quantized estimate is exact); tp>1 places dense shards and
+    quantizes in place instead. Scales stay fp32 (never cast — dequant math
+    needs them).
+    """
+    from deepspeed_tpu.inference.woq import WOQTensor
 
     def _place(path, leaf):
+        if isinstance(leaf, WOQTensor):
+            rep = NamedSharding(mesh, P())
+            return WOQTensor(jax.device_put(leaf.q, rep),
+                             jax.device_put(leaf.scale, rep),
+                             leaf.fmt, leaf.shape, stacked=leaf.stacked)
         arr = jnp.asarray(leaf)
         spec = divisible_spec(rules(jax.tree_util.keystr(path), arr.shape), arr.shape, mesh)
         if dtype is not None and jnp.issubdtype(arr.dtype, jnp.floating):
             arr = arr.astype(dtype)
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
-    return jax.tree_util.tree_map_with_path(_place, params)
+    return jax.tree_util.tree_map_with_path(
+        _place, params, is_leaf=lambda x: isinstance(x, WOQTensor))
 
 
 # ---------------------------------------------------------------------------

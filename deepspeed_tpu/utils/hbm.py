@@ -27,6 +27,47 @@ class HBMBudgetError(RuntimeError):
     """Raised (mode='refuse') when an estimate exceeds the device budget."""
 
 
+# ------------------------------------------------------ quantized-serving math
+# The serving-capacity byte formulas (ISSUE 10): KV bytes/token is the
+# admission bottleneck the guard protects, so the guard, the engine's pool
+# sizing, and the capacity benchmark must all agree on ONE definition of what
+# a quantized block costs. Quantized storage (int8/fp8) holds 1 byte/element
+# plus one fp32 scale per (layer, slot, kv-head) hd-vector block — the
+# ``ops.quant`` block-math layout ``inference/paged.py`` writes.
+
+KV_SCALE_BYTES = 4  # fp32 scale per (slot, head) quantization block
+
+
+def kv_slot_bytes(num_layers: int, kv_heads: int, head_dim: int,
+                  dtype_bytes: int = 2, kv_quant: Optional[str] = None) -> int:
+    """Bytes ONE token slot occupies in the paged KV pool (k + v)."""
+    if kv_quant is None:
+        per_head = head_dim * dtype_bytes
+    else:
+        per_head = head_dim * 1 + KV_SCALE_BYTES
+    return 2 * num_layers * kv_heads * per_head
+
+
+def kv_pool_bytes(num_layers: int, num_slots: int, kv_heads: int, head_dim: int,
+                  dtype_bytes: int = 2, kv_quant: Optional[str] = None) -> int:
+    """Bytes of a paged pool holding ``num_slots`` token slots (pass
+    ``num_blocks * block_size + 1`` to include the trash slot)."""
+    return num_slots * kv_slot_bytes(num_layers, kv_heads, head_dim,
+                                     dtype_bytes, kv_quant)
+
+
+def kv_blocks_for_bytes(pool_bytes: int, num_layers: int, block_size: int,
+                        kv_heads: int, head_dim: int, dtype_bytes: int = 2,
+                        kv_quant: Optional[str] = None) -> int:
+    """How many KV blocks fit a byte budget — the admission-capacity lever:
+    at identical ``pool_bytes`` an int8 pool yields ~2x the blocks of a bf16
+    pool (head_dim ≥ 64: ≥1.88x after the per-block scale), which is what the
+    ``BlockedAllocator`` sizing then admits."""
+    per_block = block_size * kv_slot_bytes(num_layers, kv_heads, head_dim,
+                                           dtype_bytes, kv_quant)
+    return max(int(pool_bytes) // per_block, 1)
+
+
 def record_calibration(
     estimate_bytes: int,
     actual_peak_bytes: Optional[int],

@@ -149,10 +149,14 @@ def test_generate_populates_serving_metrics_and_request_tracks():
     assert reg.counter("serving/slo_met", **lb).value == 3  # generous targets
     # per-request token accounting is exact
     assert sum(r.tokens for r in eng.lifecycle.records().values()) == 3 * n_new
-    # satellite gauges (chain-boundary scheduler/pool state)
+    # satellite gauges (chain-boundary scheduler/pool state); utilization
+    # carries the KV-storage-dtype label (quantized-serving observability)
     gauges = reg.gauges()
     for name in ("serving/queue_depth", "serving/batch_occupancy",
-                 "serving/kv_pool_free_blocks", "serving/kv_pool_utilization"):
+                 "serving/kv_pool_free_blocks",
+                 'serving/kv_pool_utilization{dtype="fp32"}',
+                 'serving/kv_pool_dtype{dtype="fp32"}',
+                 "serving/kv_bytes_per_token"):
         assert name in gauges, name
     assert gauges["serving/kv_pool_free_blocks"] == eng.state.free_blocks
     assert reg.counters()["serving/preemptions"] == 0

@@ -169,6 +169,108 @@ def test_v1_engine_refuses_over_budget(monkeypatch):
                                      config={"dtype": "fp32", "hbm_check": "refuse"})
 
 
+# --------------------------------------------- quantized-serving byte math
+def test_kv_byte_formulas():
+    """The quantized pool/block formulas the guard, the engine sizing, and
+    the capacity bench all share (utils/hbm.py)."""
+    from deepspeed_tpu.utils.hbm import kv_blocks_for_bytes, kv_pool_bytes, kv_slot_bytes
+
+    # head_dim=64: bf16 slot-head = 128 B; int8 = 64 + 4 (fp32 scale) = 68 B
+    assert kv_slot_bytes(2, 2, 64, 2, None) == 2 * 2 * 2 * 128
+    assert kv_slot_bytes(2, 2, 64, 2, "int8") == 2 * 2 * 2 * 68
+    assert kv_slot_bytes(2, 2, 64, 2, "fp8") == kv_slot_bytes(2, 2, 64, 2, "int8")
+    assert kv_pool_bytes(2, 100, 2, 64, 2, None) == 100 * kv_slot_bytes(2, 2, 64, 2)
+    # at identical bytes, int8 yields >=1.8x the blocks (the capacity lever)
+    budget = 1 << 22
+    b_bf16 = kv_blocks_for_bytes(budget, 2, 16, 2, 64, 2, None)
+    b_int8 = kv_blocks_for_bytes(budget, 2, 16, 2, 64, 2, "int8")
+    assert b_int8 / b_bf16 >= 1.8
+
+
+def test_v2_quantized_pool_fits_where_dense_refuses(monkeypatch):
+    """The v2 pre-flight learns the quantized pool bytes: a budget the fp32
+    pool blows is admitted with kv_cache_dtype='int8' — refuse-before-
+    materialize with the REAL (smaller) byte count."""
+    from tests.unit.inference.test_inference_v2 import make_model
+
+    from deepspeed_tpu.inference import InferenceEngineV2
+
+    cfg, _, params = make_model()
+    # 4096 x 16 slots, head_dim 8: fp32 pool ~16.8 MB, int8 pool ~6.3 MB
+    monkeypatch.setenv("DSTPU_DEVICE_MEMORY_GB", "0.012")  # ~12.9 MB budget
+    v2_cfg = {"dtype": "fp32", "kv_block_size": 16, "num_kv_blocks": 4096,
+              "hbm_check": "refuse"}
+    with pytest.raises(HBMBudgetError, match="KV pool"):
+        InferenceEngineV2(cfg, params, v2_cfg)
+    eng = InferenceEngineV2(cfg, params, dict(v2_cfg, kv_cache_dtype="int8"))
+    assert eng.pool.k.dtype.name == "int8" and eng.pool.k_scale is not None
+
+
+def test_v2_woq_estimate_admits_where_dense_refuses(monkeypatch):
+    """WOQ weights enter the pre-flight with the quantized byte formula
+    (values + scales through the same eligibility predicate as the real
+    pass): a model that only fits quantized is admitted."""
+    from tests.unit.inference.test_inference_v2 import make_model
+
+    from deepspeed_tpu.inference import InferenceEngineV2
+    from deepspeed_tpu.inference.woq import quantized_bytes_estimate, woq_bytes
+
+    cfg, _, params = make_model(vocab_size=512, hidden_size=256,
+                                intermediate_size=512)
+    import jax
+
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    dense_mb = n_params * 4 / (1 << 20)
+    est = quantized_bytes_estimate(params, "int8", min_size=0, dense_itemsize=4)
+    assert est < 0.6 * n_params * 4  # the estimate reflects the shrink
+    budget_gb = (est + 0.35 * (dense_mb * (1 << 20))) / (1 << 30) / 0.92
+    monkeypatch.setenv("DSTPU_DEVICE_MEMORY_GB", f"{budget_gb:.6f}")
+    v2_cfg = {"dtype": "fp32", "kv_block_size": 4, "num_kv_blocks": 8,
+              "hbm_check": "refuse"}
+    with pytest.raises(HBMBudgetError):
+        InferenceEngineV2(cfg, params, v2_cfg)
+    eng = InferenceEngineV2(cfg, params, dict(
+        v2_cfg, quant={"enabled": True, "bits": 8, "min_leaf_size": 0}))
+    # and the estimate the guard admitted on tracks what actually landed
+    actual = woq_bytes(eng.params)
+    assert actual <= est * 1.05
+
+
+def test_v2_quantized_estimate_calibration_within_threshold():
+    """The serving estimate with quantized pool bytes still covers the XLA
+    peak of the captured decode program inside the 1.2x warn threshold
+    (telemetry/programs.py calibration — the guard isn't flying blind on
+    quantized configs)."""
+    from tests.unit.inference.test_inference_v2 import make_model
+
+    from deepspeed_tpu.inference import InferenceEngineV2
+    from deepspeed_tpu.telemetry import get_tracer
+    from deepspeed_tpu.telemetry.programs import get_program_registry
+
+    tr = get_tracer()
+    was = tr.enabled
+    tr.configure(enabled=True)
+    reg = get_program_registry()
+    reg.reset()
+    try:
+        cfg, _, params = make_model()
+        eng = InferenceEngineV2(cfg, params, {
+            "dtype": "fp32", "kv_block_size": 4, "num_kv_blocks": 64,
+            "chunk_bucket": 8, "decode_chain": 4, "hbm_check": "off",
+            "kv_cache_dtype": "int8"})
+        eng.generate([np.arange(6) % cfg.vocab_size], max_new_tokens=6)
+        assert reg.hbm_estimate("serving")
+        chains = [lbl for lbl in reg.labels() if lbl.startswith("v2:decode_chain")]
+        assert chains, f"no decode-chain capture in {reg.labels()}"
+        ratio = reg.latest(chains[0]).hbm_estimate_ratio
+        assert ratio is not None and ratio < 1.2, ratio
+    finally:
+        tr.configure(enabled=was)
+        reg.reset()
+        if not was:
+            tr.reset()
+
+
 # ------------------------------------------------------- MoE x TP refusal
 def test_moe_tp_mesh_raises(devices):
     """ep×tp composition is unverified (no cross-tp token gather/drop):

@@ -121,7 +121,10 @@ def test_lint_scans_telemetry_and_serving_sources():
 def test_known_names_pass_and_bad_names_fail():
     """The checker itself: real names from the tree pass, malformed fail."""
     for good in ("serving/ttft_ms", "span/serve:dispatch", "comm/bytes",
-                 "mem/device_bytes_in_use", "anomaly/step_straggler"):
+                 "mem/device_bytes_in_use", "anomaly/step_straggler",
+                 # quantized-serving capacity gauges (ISSUE 10)
+                 "serving/kv_pool_dtype", "serving/kv_bytes_per_token",
+                 "serving/kv_pool_utilization"):
         assert _check_name(good) is None, good
     for bad in ("ttft", "Serving/ttft", "serving ttft", "{x}/y", "bogus/name"):
         assert _check_name(bad) is not None, bad

@@ -224,6 +224,79 @@ def _tiny_model():
     return cfg, params
 
 
+def _kv_bench_model():
+    """Capacity-sweep model: head_dim=64 (the realistic 64-128 range) so the
+    int8-vs-bf16 byte ratio is the production one — per (slot, head):
+    bf16 = 64*2 = 128 B, int8 = 64*1 + 4 (fp32 scale) = 68 B → 1.88x."""
+    import jax
+
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256, num_layers=2,
+        num_heads=2, num_kv_heads=2, max_seq_len=256)
+    module = CausalLM(cfg)
+    params = module.init({"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(0)},
+                         {"input_ids": np.zeros((1, 8), np.int32)}, train=False)["params"]
+    return cfg, params
+
+
+def bench_kv_capacity(kv_dtypes=("bf16", "int8", "fp8"), pool_blocks_bf16=96,
+                      block_size=16, prompt_len=24, n_new=24, timing_rows=8) -> Dict:
+    """The quantized-serving capacity sweep (ISSUE 10): at IDENTICAL pool
+    bytes, how many concurrent requests does each KV storage dtype admit?
+
+    The byte budget is fixed at what ``pool_blocks_bf16`` bf16 blocks cost;
+    each engine derives its own block count from that budget through the real
+    block-byte formula (``utils/hbm.kv_blocks_for_bytes`` — the same math the
+    pre-flight guard and the allocator sizing use), then requests of
+    ``prompt_len + n_new`` tokens are admitted through the REAL admission
+    check until it refuses. A short real generate at ``timing_rows`` rows
+    measures CPU wall µs/decoded-token per dtype (device shares the host
+    here, so quantize/dequant math shows up in it — the capacity column is
+    the accelerator-relevant result)."""
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.utils.hbm import kv_slot_bytes
+
+    cfg, params = _kv_bench_model()
+    pool_bytes = pool_blocks_bf16 * block_size * kv_slot_bytes(
+        cfg.num_layers, cfg.num_kv_heads, cfg.hidden_size // cfg.num_heads, 2, None)
+    rng = np.random.RandomState(0)
+    seq_tokens = prompt_len + n_new
+    out: Dict[str, Dict] = {"pool_bytes": pool_bytes,
+                            "tokens_per_request": seq_tokens, "sweep": {}}
+    for kvd in kv_dtypes:
+        eng = InferenceEngineV2(cfg, params, {
+            "dtype": "fp32", "kv_block_size": block_size,
+            "kv_pool_bytes": pool_bytes, "kv_cache_dtype": kvd,
+            "max_seqs": 512, "hbm_check": "off"})
+        # real admission: how many (prompt + full generation) sequences the
+        # scheduler accepts concurrently at this byte budget
+        admitted = 0
+        while eng.can_schedule(list(range(admitted + 1)), [seq_tokens] * (admitted + 1)):
+            admitted += 1
+        rows = min(admitted, timing_rows)
+        prompts = [rng.randint(0, cfg.vocab_size, (prompt_len,)) for _ in range(rows)]
+        eng.generate(prompts, max_new_tokens=4)  # compile outside the window
+        for u in list(eng.state._seqs):
+            eng.flush(u)
+        t0 = time.perf_counter()
+        outs = eng.generate(prompts, max_new_tokens=n_new)
+        wall = time.perf_counter() - t0
+        total = sum(len(o) for o in outs)
+        out["sweep"][kvd] = {
+            "kv_bytes_per_token": eng.kv_bytes_per_token,
+            "num_kv_blocks": eng.num_kv_blocks,
+            "max_concurrent_requests": admitted,
+            "cpu_wall_us_per_token": round(wall * 1e6 / total, 1),
+        }
+    if "bf16" in out["sweep"] and "int8" in out["sweep"]:
+        out["int8_capacity_gain"] = round(
+            out["sweep"]["int8"]["max_concurrent_requests"]
+            / out["sweep"]["bf16"]["max_concurrent_requests"], 3)
+    return out
+
+
 def bench_host_path(rows=8, n_new=64, chain=8, prompt_len=32) -> Dict:
     """Pure host serving overhead: the device programs are replaced by
     shape-correct host stubs, so the measured time is EXACTLY the work the
@@ -463,6 +536,9 @@ def main() -> None:
     ap.add_argument("--rows", type=int, default=8)
     ap.add_argument("--tokens", type=int, default=64)
     ap.add_argument("--chain", type=int, default=8)
+    ap.add_argument("--kv-dtype", type=str, default="bf16,int8,fp8",
+                    help="comma list of KV-cache storage dtypes for the "
+                         "fixed-byte capacity sweep (bf16|int8|fp8)")
     ap.add_argument("--slo", action="store_true",
                     help="run the open-loop SLO mode (TTFT/TPOT/queue-wait "
                          "percentiles + goodput + exposition artifacts)")
@@ -482,6 +558,8 @@ def main() -> None:
                                      chain=args.chain),
         "end_to_end": bench_end_to_end(rows=args.rows, n_new=args.tokens,
                                        chain=args.chain),
+        "kv_capacity": bench_kv_capacity(
+            kv_dtypes=tuple(d.strip() for d in args.kv_dtype.split(",") if d.strip())),
     }
     if args.slo:
         out["slo"] = bench_slo(n_requests=args.requests, rate=args.rate,

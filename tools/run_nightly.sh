@@ -53,6 +53,22 @@ JAX_PLATFORMS=cpu python -m pytest tests/unit/comm/test_collectives.py -q \
 pallas_rc=${PIPESTATUS[0]}
 [ "${pallas_rc}" -ne 0 ] && rc=1
 
+# Quantized-serving smoke (ISSUE 10): int8 KV chain decode on the CPU bench
+# model must stay token-identical to the fp pool, the fused Pallas loads
+# must match the XLA fallback under interpret, and the decode program census
+# must show no full-precision pool materialization. Census line lands in the
+# committed log so a quantization regression is auditable per round.
+{
+  echo "# quantized-serving smoke: pytest tests/unit/inference/test_quantized_serving.py"
+} >> "${OUT}"
+# prefixed for the same reason as the pallas smoke: the footer census grep
+# must only match the nightly tier's own summary
+JAX_PLATFORMS=cpu python -m pytest tests/unit/inference/test_quantized_serving.py -q \
+  -p no:cacheprovider -p no:xdist -p no:randomly \
+  --tb=line 2>&1 | tail -5 | sed 's/^/quant-serving-smoke: /' | tee -a "${OUT}"
+quant_rc=${PIPESTATUS[0]}
+[ "${quant_rc}" -ne 0 ] && rc=1
+
 # Compiled-program inventory (ISSUE 7): the registry must capture a real
 # train-step and v2 decode-chain program with nonzero flops/peak-HBM and a
 # computed hbm/estimate_ratio. Committed alongside this log as its own
@@ -70,7 +86,7 @@ prog_rc=${PIPESTATUS[0]}
 echo "# program inventory: ${PROG_OUT} (exit ${prog_rc})" >> "${OUT}"
 
 {
-  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, program report: ${prog_rc})"
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, program report: ${prog_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
 echo "wrote ${OUT} ${PROG_OUT}"
