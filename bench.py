@@ -908,6 +908,105 @@ def bench_compile_observability():
     }
 
 
+def bench_coll_observability():
+    """Host overhead of the collective observatory's timing mode
+    (``collectives/observatory.py``) — the <2% bound ISSUE 11 commits to,
+    same paired-step discipline as the PR-5/PR-7 overhead guards.
+
+    ONE engine built with the ``collectives.observe`` block enabled steps in
+    PAIRED alternation with the observatory flipped off/on around each step.
+    A routed collective signature is registered on the engine's mesh before
+    the clock (the PR-1 comm-probe idiom), so enabled steps pay the real
+    ``on_step`` hook INCLUDING sampled probe dispatches at the configured
+    cadence; probe compiles happen during warmup (``sample_now``), never on
+    the clock. ``pairs`` is a whole number of cadence cycles so off/on see
+    identical probe phases."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist_mod
+    from deepspeed_tpu.collectives import observatory
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+    from deepspeed_tpu.utils.compat import shard_map
+
+    cfg = TransformerConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, max_seq_len=256,
+    )
+    seq, micro, sample_every, pairs, warmup = 256, 4, 4, 48, 5
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+            "collectives": {"enabled": True,
+                            "observe": {"enabled": True,
+                                        "sample_every": sample_every,
+                                        "persist": False,
+                                        "refit_every": 0}},
+        })
+    obs = engine._coll_observatory
+    assert obs is not None
+    # register one routed signature on the engine's mesh (the GSPMD step
+    # has no explicit facade collective to observe — PR-8 note), so probes
+    # have something real to time
+    axis = "dp"
+    n = int(engine.mesh.shape[axis])
+    probe = jax.jit(shard_map(
+        lambda v: dist_mod.all_reduce(v, axis, algorithm="ring", codec="int8",
+                                      block_size=256),
+        mesh=engine.mesh, in_specs=P(axis), out_specs=P(axis),
+        check_vma=False))
+    probe(jnp.ones((n * n * 256,), jnp.float32)).block_until_ready()
+    probes_warm = obs.sample_now()  # probe compiles off the clock
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    for _ in range(warmup):
+        m = engine.train_batch(batch)
+    np.asarray(m["loss"])
+
+    def one_step(enabled):
+        obs.config.enabled = enabled
+        t0 = time.perf_counter()
+        m = engine.train_batch(batch)
+        np.asarray(m["loss"])  # paired timing needs the per-step sync
+        return time.perf_counter() - t0
+
+    t_off = t_on = 0.0
+    try:
+        for _ in range(pairs):
+            t_off += one_step(False)
+            t_on += one_step(True)
+    finally:
+        obs.config.enabled = True
+
+    s = obs.summary()
+    ms_off = t_off / pairs * 1e3
+    ms_on = t_on / pairs * 1e3
+    overhead_pct = (ms_on - ms_off) / ms_off * 100.0
+    return {
+        "model": "gpt2_cpu_bench_2L_128h_seq256_micro4",
+        "sample_every": sample_every,
+        "ms_per_step_observatory_off": round(ms_off, 3),
+        "ms_per_step_observatory_on": round(ms_on, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "bound_pct": 2.0,
+        "within_bound": bool(overhead_pct < 2.0),
+        "probes_warmup": probes_warm,
+        "probes_merged": s["merged_samples"],
+        "table_rows": s["table_rows"],
+        "routes": s["routes"],
+    }
+
+
 # Confidence-ordered registry (safest first): a relay wedge mid-queue loses
 # everything after it, so known-good shapes go first and the big/novel
 # configs last. Each entry: name -> (fn(peak_flops)->dict, timeout_s).
@@ -915,6 +1014,7 @@ EXTRA_BENCHES = {
     "serving_overhead_host": (lambda peak: bench_serving_overhead(), 420),
     "elastic_snapshot_overhead": (lambda peak: bench_snapshot_overhead(), 420),
     "compile_observability": (lambda peak: bench_compile_observability(), 420),
+    "coll_observability": (lambda peak: bench_coll_observability(), 420),
     "llama_550m_zero3_remat": (bench_train_llama_z3, 420),
     "mixtral_style_moe": (bench_train_moe, 420),
     "inference_v1_gpt2_125m": (lambda peak: bench_inference(), 420),
@@ -1145,6 +1245,12 @@ def main() -> None:
         extras["compile_observability"] = bench_compile_observability()
     except Exception as e:  # noqa: BLE001
         extras["compile_observability"] = {"error": str(e)[:200]}
+    # Collective-observatory timing-mode overhead around an unchanged step
+    # program — CPU-measurable, same <2% bound as on chip (ISSUE 11).
+    try:
+        extras["coll_observability"] = bench_coll_observability()
+    except Exception as e:  # noqa: BLE001
+        extras["coll_observability"] = {"error": str(e)[:200]}
     result = {
         "metric": f"tokens_per_sec_per_chip_gpt2_125m_bf16_seq{seq}" if on_tpu
         else f"tokens_per_sec_cpu_smoke_seq{seq}",

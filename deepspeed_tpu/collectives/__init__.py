@@ -32,9 +32,21 @@ from deepspeed_tpu.collectives.pallas_backend import (
 )
 from deepspeed_tpu.collectives.selector import (
     Decision,
+    calibrate,
     configure,
     get_config,
     select,
+)
+from deepspeed_tpu.collectives.table import (
+    SCHEMA_VERSION,
+    load_table,
+    merge_rows,
+    write_table,
+)
+from deepspeed_tpu.collectives.observatory import (
+    CollectiveObservatory,
+    ObservatoryConfig,
+    get_observatory,
 )
 from deepspeed_tpu.collectives.overlap import (
     double_buffered,

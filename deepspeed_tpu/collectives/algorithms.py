@@ -62,6 +62,12 @@ def _permute_wire(wire, axis, perm):
 
 
 def _hop_span(name: str, axis, hop: int, codec: Codec, **tags):
+    from deepspeed_tpu.collectives import observatory
+
+    # trace-time hop census for the observatory (one count per hop, every
+    # backend — ppermute, remote-DMA, and fused hops all come through here);
+    # a no-op outside a routed-collective trace scope
+    observatory.on_hop()
     tracer = telemetry.get_tracer()
     if not tracer.enabled:
         return telemetry.NOOP_SPAN

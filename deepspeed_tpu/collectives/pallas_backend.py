@@ -86,6 +86,17 @@ def backend_token() -> str:
     return "pallas" if available() else "ppermute"
 
 
+def hop_backend(algorithm: str) -> str:
+    """The hop-backend family an algorithm name implies — THE one
+    classification behind decision-table ``backend`` stamps, the selector's
+    calibrated alpha/beta lookup, and the observatory's sample labels
+    (``"xla"`` for the native lowering, ``"pallas"`` for the remote-DMA
+    kernels, ``"ppermute"`` for everything else)."""
+    if algorithm == "lax":
+        return "xla"
+    return "pallas" if is_pallas(algorithm) else "ppermute"
+
+
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 

@@ -23,10 +23,9 @@ same Perfetto trace as the step.
 
 from __future__ import annotations
 
-import json
 import math
 import threading
-from dataclasses import dataclass, replace as dc_replace
+from dataclasses import dataclass, field, replace as dc_replace
 from typing import Dict, List, Optional, Tuple
 
 from deepspeed_tpu import telemetry
@@ -81,6 +80,12 @@ class SelectorConfig:
     # passes no explicit algorithm/codec. None = plain jax.lax lowering.
     facade_algorithm: Optional[str] = None  # "auto" | concrete name | None
     facade_codec: Optional[str] = None
+    # Per-backend (alpha_us, beta_us_per_mb) overrides fitted from OBSERVED
+    # hop timings (collectives/observatory.py refit -> calibrate()); keys
+    # "ppermute" / "pallas" / "xla". When present they replace the static
+    # alpha/beta (and the pallas_alpha_scale discount) for that backend's
+    # candidates, so model mode re-costs from what this mesh measured.
+    backend_ab: Dict[str, Tuple[float, float]] = field(default_factory=dict)
 
 
 _lock = threading.Lock()
@@ -97,20 +102,35 @@ def configure(config: Optional[SelectorConfig] = None, **kwargs) -> SelectorConf
     with _lock:
         # copy, never mutate the caller's template instance
         cfg = dc_replace(config, **kwargs) if config is not None else SelectorConfig(**kwargs)
+        cfg.backend_ab = dict(cfg.backend_ab)  # calibrate() mutates in place
         _config = cfg
         _cache.clear()
         _measured.clear()
         _stats["hits"] = _stats["misses"] = 0
         if cfg.decision_table and cfg.mode != "model":
+            from deepspeed_tpu.collectives.table import load_table
+
             try:
-                with open(cfg.decision_table) as f:
-                    rows = json.load(f)
-                _measured.extend(rows if isinstance(rows, list) else rows.get("rows", []))
+                # versioned envelope or legacy bare list; a schema-version
+                # mismatch is rejected (with its own warning) inside
+                # load_table and leaves _measured empty -> model fallback
+                _measured.extend(load_table(cfg.decision_table))
             except (OSError, ValueError) as e:
                 logger.warning(
                     f"collectives: decision table {cfg.decision_table!r} unreadable "
                     f"({e}); falling back to the alpha-beta model")
     return _config
+
+
+def calibrate(backend: str, alpha_us: float, beta_us_per_mb: float) -> None:
+    """Install OBSERVED per-backend alpha/beta constants (the observatory's
+    least-squares refit lands here); clears the decision cache so future
+    picks re-cost under the calibrated model. Survives until the next
+    :func:`configure` (a fresh engine re-installs its config — persistent
+    calibration rides the observatory's on-disk table instead)."""
+    with _lock:
+        _config.backend_ab[backend] = (float(alpha_us), float(beta_us_per_mb))
+        _cache.clear()
 
 
 def get_config() -> SelectorConfig:
@@ -179,6 +199,21 @@ def _hops_and_volume(op: str, algorithm: str, nbytes: int, n: int) -> Tuple[int,
     raise ValueError(f"no cost model for op={op!r} algorithm={algorithm!r}")
 
 
+def model_terms(op: str, algorithm: str, codec: str, nbytes: int, n: int,
+                itemsize: int = 4, block_size: Optional[int] = None,
+                cfg: Optional[SelectorConfig] = None) -> Tuple[int, float]:
+    """(hops, wire_mb) — THE regressors of the alpha-beta model.
+    ``estimate_us`` charges exactly ``hops*alpha + wire_mb*beta`` from
+    these, and the observatory's refit fits observed latencies against the
+    SAME terms — one formula, or fitted constants would be applied to
+    different regressors than they were fit against."""
+    cfg = cfg or _config
+    hops, vol = _hops_and_volume(op, algorithm, nbytes, n)
+    c = get_codec(codec, block_size if block_size is not None else cfg.block_size)
+    wire = c.wire_bytes(max(int(vol // itemsize), 1), itemsize)
+    return hops, wire / 1e6
+
+
 def estimate_us(op: str, algorithm: str, codec: str, nbytes: int, n: int,
                 cfg: Optional[SelectorConfig] = None, itemsize: int = 4) -> float:
     """Alpha-beta time estimate for one (algorithm, codec) pair.
@@ -187,12 +222,18 @@ def estimate_us(op: str, algorithm: str, codec: str, nbytes: int, n: int,
     an element count before the codec's wire-byte model applies, so a bf16
     payload's int8 wire is costed at ~1/2, not the fp32 default's ~1/4."""
     cfg = cfg or _config
-    hops, vol = _hops_and_volume(op, algorithm, nbytes, n)
-    c = get_codec(codec, cfg.block_size)
-    wire = c.wire_bytes(max(int(vol // itemsize), 1), itemsize)
-    alpha = cfg.alpha_us * (cfg.pallas_alpha_scale
-                            if pallas_backend.is_pallas(algorithm) else 1.0)
-    return hops * alpha + (wire / 1e6) * cfg.beta_us_per_mb
+    hops, wire_mb = model_terms(op, algorithm, codec, nbytes, n, itemsize,
+                                cfg=cfg)
+    fitted = cfg.backend_ab.get(pallas_backend.hop_backend(algorithm))
+    if fitted is not None:
+        # observed constants (observatory refit) replace the static model —
+        # including the pallas alpha discount, which the fit subsumes
+        alpha, beta = fitted
+    else:
+        alpha = cfg.alpha_us * (cfg.pallas_alpha_scale
+                                if pallas_backend.is_pallas(algorithm) else 1.0)
+        beta = cfg.beta_us_per_mb
+    return hops * alpha + wire_mb * beta
 
 
 def _model_pick(op: str, nbytes: int, n: int, codec: Optional[str],
@@ -230,7 +271,7 @@ def _model_pick(op: str, nbytes: int, n: int, codec: Optional[str],
 
 
 def _measured_pick(op: str, nbytes: int, n: int, codec: Optional[str],
-                   cfg: SelectorConfig) -> Optional[Decision]:
+                   cfg: SelectorConfig, itemsize: int = 4) -> Optional[Decision]:
     if codec is not None:
         allowed = {codec}
     else:
@@ -243,6 +284,15 @@ def _measured_pick(op: str, nbytes: int, n: int, codec: Optional[str],
     rows = [r for r in _measured
             if r.get("op") == op and int(r.get("world", 0)) == n
             and r.get("codec", "none") in allowed and _row_backend_ok(r)]
+    # a mixed-itemsize table (online rows + sweeps at different dtypes)
+    # keeps separate rows per element width because a lossy wire costs per
+    # ELEMENT: answer from rows measured at the querying payload's width
+    # when any exist; tables without itemsize coverage keep the legacy
+    # any-row behavior rather than starving measured mode
+    # legacy rows default to the historical sweep width (bf16, 2) — the
+    # same default table.row_key uses, so they stay visible to bf16 queries
+    same_width = [r for r in rows if int(r.get("itemsize", 2)) == int(itemsize)]
+    rows = same_width or rows
     if not rows:
         return None
     size_mb = nbytes / 1e6
@@ -322,7 +372,7 @@ def select(op: str, nbytes: int, axis_size: int, codec: Optional[str] = None,
         # A FORCED lossy codec needs an algorithmic path, so it bypasses it.
         decision = Decision(op, "lax", "none", 0.0, "model")
     elif cfg.mode == "measured" or (cfg.mode == "auto" and _measured):
-        decision = _measured_pick(op, nbytes, axis_size, codec, cfg)
+        decision = _measured_pick(op, nbytes, axis_size, codec, cfg, itemsize)
     if decision is None:
         decision = _model_pick(op, nbytes, axis_size, codec, cfg, itemsize)
     with _lock:

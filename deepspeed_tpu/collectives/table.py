@@ -1,0 +1,144 @@
+"""Versioned decision-table schema shared by the sweep and the observatory.
+
+ONE row format feeds the selector's measured mode, whether the rows came
+from an offline ``comm/benchmark.py --sweep`` or from the online
+observatory's sampled probes (``collectives/observatory.py``)::
+
+    {"op": "all_reduce", "world": 8, "size_mb": 0.131,   # PER-DEVICE payload
+     "algorithm": "ring", "codec": "int8", "backend": "ppermute",
+     "latency_ms": 0.42, "busbw_gbps": 1.9, "itemsize": 2, "samples": 3}
+
+``size_mb`` is the per-device payload (what the selector is queried with at
+trace time), ``backend`` is the hop backend the row was measured with
+(selector measured mode never applies a ppermute row to a pallas algorithm
+or vice versa), ``itemsize`` is the payload element width the probe ran
+with (the alpha/beta refit needs it to reconstruct wire bytes), ``samples``
+counts how many observations were EMA-merged into the row.
+
+On disk a table is a versioned envelope ``{"schema": 1, "source": ...,
+"rows": [...]}``. Loading accepts the envelope (schema checked,
+reject-with-warning on mismatch) AND the legacy bare-list format PR-3 sweep
+files used — an old table keeps working, a FUTURE schema never silently
+routes traffic. ``merge_rows`` is the one fold implementation behind both
+``--merge`` (sweep into an existing table) and the observatory's online EMA.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+SCHEMA_VERSION = 1
+
+# the identity of a measurement: everything but the measured numbers
+_KEY_FIELDS = ("op", "world", "algorithm", "codec")
+
+
+def row_key(row: Dict) -> Tuple:
+    """Merge identity of one row; ``size_mb`` participates rounded to the
+    4 decimals every writer emits so float repr noise cannot split rows.
+    ``itemsize`` participates too: a bf16 and an fp32 payload of the same
+    per-device BYTES are different programs under a lossy codec (one wire
+    byte per ELEMENT), so their measurements must not EMA into one row.
+    Legacy rows default the missing fields — backend from the algorithm
+    name, itemsize to the historical sweep default (bf16, 2) — so a fresh
+    stamped sweep REPLACES an old row instead of duplicating it."""
+    from deepspeed_tpu.collectives.pallas_backend import hop_backend
+
+    backend = row.get("backend") or hop_backend(str(row.get("algorithm", "")))
+    return tuple(row.get(f) for f in _KEY_FIELDS) + (
+        backend, int(row.get("itemsize", 2)),
+        round(float(row.get("size_mb", 0.0)), 4))
+
+
+def load_table(path: str, strict: bool = False) -> List[Dict]:
+    """Rows of a decision table file: versioned envelope or legacy bare
+    list. A schema-version mismatch is rejected WITH a warning (an empty
+    row list falls back to the alpha-beta model downstream) — mis-keyed
+    rows from a future format must never route production collectives;
+    ``strict=True`` raises on the mismatch instead (the ``--merge`` CLI
+    must distinguish "no rows" from "rows I must not destroy").
+    Raises ``OSError``/``ValueError`` like ``json.load`` for unreadable
+    files (callers own that fallback)."""
+    with open(path) as f:
+        payload = json.load(f)
+    if isinstance(payload, list):
+        # legacy PR-3 sweep format (pre-versioning): accepted as-is
+        return payload
+    if not isinstance(payload, dict):
+        raise ValueError(f"decision table {path!r} is neither a row list "
+                         f"nor a schema envelope ({type(payload).__name__})")
+    if "schema" not in payload:
+        # a schema-LESS dict ({"rows": [...]}) is another legacy shape the
+        # selector used to accept — only an explicit wrong version is a
+        # future format worth rejecting
+        rows = payload.get("rows", [])
+        return rows if isinstance(rows, list) else []
+    schema = payload.get("schema")
+    if schema != SCHEMA_VERSION:
+        if strict:
+            raise ValueError(
+                f"decision table {path!r} has schema {schema!r}, this build "
+                f"speaks {SCHEMA_VERSION}")
+        logger.warning(
+            f"collectives: decision table {path!r} has schema {schema!r}, "
+            f"this build speaks {SCHEMA_VERSION} — rejecting the table "
+            "(selector falls back to the alpha-beta model; re-sweep or "
+            "re-run the observatory to regenerate it)")
+        return []
+    rows = payload.get("rows", [])
+    return rows if isinstance(rows, list) else []
+
+
+def write_table(path: str, rows: List[Dict], source: str = "sweep",
+                extra: Optional[Dict] = None) -> str:
+    """Atomically write the versioned envelope (tmp + ``os.replace`` so a
+    crash mid-write never leaves a half-table a warm-starting selector
+    would choke on)."""
+    payload = {"schema": SCHEMA_VERSION, "source": source,
+               "rows": list(rows)}
+    if extra:
+        payload.update(extra)
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + f".tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def merge_rows(base: List[Dict], new: List[Dict],
+               ema: Optional[float] = None) -> List[Dict]:
+    """Fold ``new`` measurements into ``base`` rows by :func:`row_key`.
+
+    ``ema=None`` (the ``--merge`` CLI): a fresh measurement REPLACES the
+    matching row's numbers (a full re-sweep is the better estimate), sample
+    counts add. ``ema`` in (0, 1] (the online observatory): latency and
+    bandwidth move by ``(1-ema)*old + ema*new`` so one noisy probe cannot
+    flip a routing decision. Rows only in ``base`` are kept either way —
+    folding a narrow sweep into a broad online table must not lose the
+    signatures the sweep did not cover."""
+    out: Dict[Tuple, Dict] = {row_key(r): dict(r) for r in base}
+    for r in new:
+        k = row_key(r)
+        prev = out.get(k)
+        if prev is None:
+            merged = dict(r)
+            merged.setdefault("samples", 1)
+        else:
+            merged = dict(prev)
+            if ema is not None:
+                a = float(ema)
+                for f in ("latency_ms", "busbw_gbps"):
+                    if f in r:
+                        old = float(prev.get(f, r[f]))
+                        merged[f] = round((1.0 - a) * old + a * float(r[f]), 4)
+            else:
+                merged.update(r)
+            merged["samples"] = int(prev.get("samples", 1)) + int(r.get("samples", 1))
+        out[k] = merged
+    return list(out.values())

@@ -103,6 +103,43 @@ def run_collective_bench(
 _SWEEP_OPS = ("all_reduce", "all_gather", "reduce_scatter")
 
 
+def candidate_pairs(world: int, codecs, algorithms=None):
+    """(algorithm, codec) measurement candidates for one axis size — THE
+    enumeration shared by ``run_sweep`` and the observatory's probe queue,
+    so online rows stay comparable with sweep rows: lax + the ppermute
+    schedule families (+ the pallas algorithms when the backend is
+    available), ``rhd`` only on power-of-two worlds, the native lowering
+    never paired with a wire codec."""
+    from deepspeed_tpu.collectives import pallas_backend
+    from deepspeed_tpu.collectives.algorithms import ALGORITHMS
+    from deepspeed_tpu.collectives.pallas_backend import PALLAS_ALGORITHMS
+
+    if algorithms is None:
+        algorithms = ["lax"] + list(ALGORITHMS)
+        if pallas_backend.available():
+            algorithms += list(PALLAS_ALGORITHMS)
+    pow2 = world > 0 and not (world & (world - 1))
+    out = []
+    for alg in algorithms:
+        if alg == "rhd" and not pow2:
+            continue
+        for cd in codecs:
+            if alg == "lax" and cd != "none":
+                continue  # the lax lowering has no wire codec
+            if (alg, cd) not in out:
+                out.append((alg, cd))
+    return out
+
+
+def probe_elems(n: int, elems: int) -> int:
+    """Round a global element count to the sweep's payload base (a multiple
+    of ``n*n*128``): the per-device shard must itself divide by ``n`` for
+    reduce_scatter and stay lane-aligned. Shared by ``run_sweep`` and the
+    observatory's probe payloads so both measure the same shapes."""
+    base = n * n * 128
+    return (elems // base) * base or base
+
+
 def _algorithmic_fn(op: str, axis: str, algorithm: str, codec: str, block_size: int):
     """Per-device body routing through the comm facade's algorithmic path
     (so the sweep measures exactly what ``selector`` will later dispatch)."""
@@ -168,48 +205,39 @@ def run_sweep(
     mesh = mesh if mesh is not None else build_mesh(axis_sizes={axis: -1})
     n = mesh.shape[axis]
     itemsize = jnp.dtype(dtype).itemsize
-    pow2 = not (n & (n - 1))
-
     rows: List[Dict] = []
     for op in ops:
         for size_mb in sizes_mb:
-            elems = max(int(size_mb * 1e6 / itemsize), n)
-            # per-device shard must itself divide by n for reduce_scatter
-            # (lane-aligned too), so round to a multiple of n*n*128
-            base = n * n * 128
-            elems = (elems // base) * base or base
+            elems = probe_elems(n, max(int(size_mb * 1e6 / itemsize), n))
             x = jax.device_put(jnp.ones((elems,), dtype), NamedSharding(mesh, P(axis)))
-            for alg in algorithms:
-                if alg == "rhd" and not pow2:
-                    continue
-                for codec in codecs:
-                    if alg == "lax" and codec != "none":
-                        continue  # the lax lowering has no wire codec
-                    fn = (_collective_fn(op, axis) if alg == "lax"
-                          else _algorithmic_fn(op, axis, alg, codec, block_size))
-                    out_spec = P() if op == "all_reduce" else P(axis)
-                    f = jax.jit(shard_map(fn, mesh=mesh, in_specs=P(axis),
-                                          out_specs=out_spec, check_vma=False))
-                    dt = _time_collective(f, x, iters, warmup)
-                    payload = elems * itemsize
-                    busbw = payload / dt * _busbw_factor(op, n)
-                    # size_mb is the PER-DEVICE payload: selector.select is
-                    # queried at trace time with the local shard's bytes
-                    # (inside shard_map), so table rows must bucket the same
-                    # quantity or measured mode matches a world-x-off regime
-                    rows.append({
-                        "op": op, "world": n, "size_mb": round(payload / n / 1e6, 4),
-                        "algorithm": alg, "codec": codec,
-                        # the hop backend these timings were measured with:
-                        # selector measured mode only applies a row to
-                        # algorithms of the same backend (a ppermute table
-                        # must never route pallas hop counts, nor vice versa)
-                        "backend": ("xla" if alg == "lax"
-                                    else "pallas" if pallas_backend.is_pallas(alg)
-                                    else "ppermute"),
-                        "latency_ms": round(dt * 1e3, 4),
-                        "busbw_gbps": round(busbw / 1e9, 3),
-                    })
+            for alg, codec in candidate_pairs(n, codecs, algorithms):
+                fn = (_collective_fn(op, axis) if alg == "lax"
+                      else _algorithmic_fn(op, axis, alg, codec, block_size))
+                out_spec = P() if op == "all_reduce" else P(axis)
+                f = jax.jit(shard_map(fn, mesh=mesh, in_specs=P(axis),
+                                      out_specs=out_spec, check_vma=False))
+                dt = _time_collective(f, x, iters, warmup)
+                payload = elems * itemsize
+                busbw = payload / dt * _busbw_factor(op, n)
+                # size_mb is the PER-DEVICE payload: selector.select is
+                # queried at trace time with the local shard's bytes
+                # (inside shard_map), so table rows must bucket the same
+                # quantity or measured mode matches a world-x-off regime
+                rows.append({
+                    "op": op, "world": n, "size_mb": round(payload / n / 1e6, 4),
+                    "algorithm": alg, "codec": codec,
+                    # the hop backend these timings were measured with:
+                    # selector measured mode only applies a row to
+                    # algorithms of the same backend (a ppermute table
+                    # must never route pallas hop counts, nor vice versa)
+                    "backend": pallas_backend.hop_backend(alg),
+                    "latency_ms": round(dt * 1e3, 4),
+                    "busbw_gbps": round(busbw / 1e9, 3),
+                    # payload element width: the observatory's alpha/beta
+                    # refit reconstructs wire bytes from it (table.py v1)
+                    "itemsize": itemsize,
+                    "samples": 1,
+                })
     return rows
 
 
@@ -232,10 +260,21 @@ def main(argv=None) -> int:  # pragma: no cover - CLI body exercised via run_col
                         "pallas algorithms are skipped with a logged reason "
                         "off-TPU rather than measured under the interpreter)")
     p.add_argument("--output", default=None,
-                   help="write the --sweep decision table JSON here (default stdout)")
+                   help="write the --sweep decision table JSON here (default "
+                        "stdout; versioned schema envelope — see "
+                        "collectives/table.py)")
+    p.add_argument("--merge", default=None, metavar="TABLE",
+                   help="fold the sweep into an EXISTING decision table "
+                        "(e.g. the observatory's online coll_table.json): "
+                        "matching rows are replaced by the fresh sweep, rows "
+                        "the sweep did not cover are kept; written to "
+                        "--output (default: back onto TABLE)")
     a = p.parse_args(argv)
     sizes = [float(s) for s in a.sizes_mb.split(",")]
     if a.sweep:
+        from deepspeed_tpu.collectives import table as table_mod
+        from deepspeed_tpu.utils.logging import logger
+
         ops = _SWEEP_OPS if a.op == "all" else (a.op,)
         bad = [op for op in ops if op not in _SWEEP_OPS]
         if bad:
@@ -245,13 +284,35 @@ def main(argv=None) -> int:  # pragma: no cover - CLI body exercised via run_col
                          algorithms=([s for s in a.algorithms.split(",") if s]
                                      if a.algorithms else None),
                          codecs=[c for c in a.codecs.split(",") if c])
-        payload = json.dumps(rows, indent=1)
-        if a.output:
-            with open(a.output, "w") as f:
-                f.write(payload)
-            print(f"wrote {len(rows)} decision rows to {a.output}")
+        source = "sweep"
+        out_path = a.output
+        if a.merge:
+            out_path = out_path or a.merge
+            try:
+                base = table_mod.load_table(a.merge, strict=True)
+            except FileNotFoundError:
+                base = []  # first merge into a table nobody persisted yet
+            except (OSError, ValueError) as e:
+                # unreadable or version-mismatched base: the (possibly
+                # long, on-TPU) sweep that just ran must not be thrown
+                # away — but neither may rows we cannot parse be DESTROYED
+                # by overwriting the base file with sweep-only content
+                base = []
+                if out_path == a.merge:
+                    out_path = a.merge + ".sweep.json"
+                logger.warning(
+                    f"--merge: base table {a.merge!r} unreadable or "
+                    f"version-mismatched ({e}); leaving it untouched and "
+                    f"writing the fresh sweep to {out_path}")
+            rows = table_mod.merge_rows(base, rows)
+            source = "merged"
+        if out_path:
+            table_mod.write_table(out_path, rows, source=source)
+            print(f"wrote {len(rows)} decision rows to {out_path} "
+                  f"(schema {table_mod.SCHEMA_VERSION}, source {source})")
         else:
-            print(payload)
+            print(json.dumps({"schema": table_mod.SCHEMA_VERSION,
+                              "source": source, "rows": rows}, indent=1))
         return 0
     ops = OPS if a.op == "all" else (a.op,)
     for op in ops:

@@ -173,6 +173,14 @@ def _record(op_name: str, axis, x, **tags):
     axis_str = "+".join(axis) if isinstance(axis, (tuple, list)) else str(axis)
     nbytes, world = _nbytes(x), _axis_size(axis)
     comms_logger.record(op_name, axis_str, nbytes, world)
+    if op_name in ("ppermute", "remote_dma"):
+        # hop-wire census for the collective observatory: inside a routed
+        # collective's trace scope these ARE the wire bytes the selector's
+        # routing put on the interconnect (no-op outside a scope — pipeline
+        # ppermutes etc. are not routed wires)
+        from deepspeed_tpu.collectives import observatory as _coll_obs
+
+        _coll_obs.on_wire(nbytes)
     tracer = telemetry.get_tracer()
     if not tracer.enabled:
         return telemetry.NOOP_SPAN
@@ -253,6 +261,22 @@ def _algorithmic(op_name: str, x, axis, algorithm, codec, reduce_op: str = "sum"
     return algorithm, codec or "none"
 
 
+def _observe_route(op_name: str, x, axis, algorithm: str, codec: str,
+                   block_size: Optional[int]):
+    """Trace-time observatory registration of one ROUTED collective: the
+    returned context collects this trace's hop/wire census
+    (``collectives/observatory.py``). A nullcontext when the observatory is
+    disabled — the traced program is identical either way (the observatory
+    never adds operations; its timings come from standalone probe
+    dispatches)."""
+    from deepspeed_tpu.collectives import observatory as _coll_obs
+
+    return _coll_obs.note_route(
+        op_name, algorithm, codec, _nbytes(x), _itemsize(x),
+        _axis_size(axis), axis, str(getattr(x, "dtype", "unknown")),
+        block_size)
+
+
 def _resolved_block_size(block_size: Optional[int]) -> Optional[int]:
     """The configured quantization block for auto-routed collectives (the
     caller's explicit block_size wins)."""
@@ -270,9 +294,11 @@ def all_reduce(x, axis, op: str = "sum", *, algorithm: Optional[str] = None,
     if alg is not None:
         from deepspeed_tpu import collectives
 
-        with _record(f"all_reduce_{op}", axis, x, algorithm=alg, codec=cd):
+        bs = _resolved_block_size(block_size)
+        with _record(f"all_reduce_{op}", axis, x, algorithm=alg, codec=cd), \
+                _observe_route("all_reduce", x, axis, alg, cd, bs):
             return collectives.all_reduce(x, axis, algorithm=alg, codec=cd, op=op,
-                                          block_size=_resolved_block_size(block_size))
+                                          block_size=bs)
     with _record(f"all_reduce_{op}", axis, x):
         if op == "sum":
             return jax.lax.psum(x, axis)
@@ -300,10 +326,12 @@ def all_gather(x, axis, *, concat_axis: int = 0, tiled: bool = True,
         alg, cd = _algorithmic("all_gather", x, axis, algorithm, codec)
     if alg is not None:
         from deepspeed_tpu import collectives
-        with _record("all_gather", axis, x, algorithm=alg, codec=cd):
+
+        bs = _resolved_block_size(block_size)
+        with _record("all_gather", axis, x, algorithm=alg, codec=cd), \
+                _observe_route("all_gather", x, axis, alg, cd, bs):
             return collectives.all_gather(x, axis, algorithm=alg, codec=cd,
-                                          concat_axis=concat_axis,
-                                          block_size=_resolved_block_size(block_size))
+                                          concat_axis=concat_axis, block_size=bs)
     with _record("all_gather", axis, x):
         return jax.lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
 
@@ -321,10 +349,13 @@ def reduce_scatter(x, axis, *, scatter_axis: int = 0, tiled: bool = True,
         alg, cd = _algorithmic("reduce_scatter", x, axis, algorithm, codec)
     if alg is not None:
         from deepspeed_tpu import collectives
-        with _record("reduce_scatter", axis, x, algorithm=alg, codec=cd):
+
+        bs = _resolved_block_size(block_size)
+        with _record("reduce_scatter", axis, x, algorithm=alg, codec=cd), \
+                _observe_route("reduce_scatter", x, axis, alg, cd, bs):
             return collectives.reduce_scatter(x, axis, algorithm=alg, codec=cd,
                                               scatter_axis=scatter_axis,
-                                              block_size=_resolved_block_size(block_size))
+                                              block_size=bs)
     with _record("reduce_scatter", axis, x):
         return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=tiled)
 

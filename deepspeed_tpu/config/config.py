@@ -376,6 +376,48 @@ class RecoveryConfig(DeepSpeedConfigModel):
     backoff_max_s: float = 60.0
 
 
+class CollObserveConfig(DeepSpeedConfigModel):
+    """collectives.observe section — the collective performance observatory
+    (``collectives/observatory.py``): on sampled steps the routed hop-scope
+    programs are re-dispatched standalone and host-clocked, observations
+    EMA-merge into an on-disk decision table that warm-starts measured mode
+    on the next run, a least-squares refit calibrates the per-backend
+    alpha/beta constants live, and observed-vs-predicted drift warns loudly
+    and arms the diagnostics profiler capture. Disabled (the default) the
+    traced step programs and the facade are byte-identical to today's —
+    and they stay identical when enabled too: probes are separate
+    dispatches, never ops inside the step."""
+
+    enabled: bool = False
+    # 1-in-N train steps runs probe work (the steady-state path is untouched
+    # between samples; amortized overhead guarded <2% by bench.py's
+    # coll_observability extra); <= 0 disables sampling while keeping
+    # route registration + the trace-time census live
+    sample_every: int = 16
+    probes_per_sample: int = 1
+    iters: int = 1       # timed iterations per probe dispatch
+    warmup: int = 1      # probe warmup (the first pays the probe compile)
+    # also time candidate algorithms (lax baseline + the other families) so
+    # the online table can CHANGE a decision, not just confirm one
+    probe_alternatives: bool = True
+    # compile new probe programs on a background worker and only time them
+    # once warm — a multi-second XLA compile must never stall train_batch
+    async_compile: bool = True
+    # online table location (default <telemetry dir>/coll_table.json); the
+    # engine feeds it back as the measured-mode decision table on the next
+    # run when no explicit collectives.decision_table is configured
+    table_path: Optional[str] = None
+    persist: bool = True
+    ema: float = 0.25          # EMA weight folding new samples into rows
+    drift_ratio: float = 3.0   # observed/predicted beyond this (either way)
+    refit_every: int = 8       # alpha/beta refit cadence (merged samples)
+    # per-refit forgetting on the fit statistics (1.0 = never): lets the
+    # calibration track an interconnect regime change on long runs
+    fit_decay: float = 0.5
+    max_probe_mb: float = 64.0  # never time payloads above this
+    max_programs: int = 32     # probe program cache bound
+
+
 class CollectivesConfig(DeepSpeedConfigModel):
     """collectives section — the algorithmic collective library
     (``deepspeed_tpu/collectives``): hop-composed ring / bidirectional-ring /
@@ -416,6 +458,9 @@ class CollectivesConfig(DeepSpeedConfigModel):
     # T3-style double buffering of the zeropp qwZ gather wire: chunk count
     # (1 = off). Chunk k's dequantize overlaps chunk k+1's gather.
     overlap_chunks: int = 1
+    # The performance observatory: live hop timing, online calibration,
+    # drift detection (active only when `enabled` above is too).
+    observe: CollObserveConfig = Field(default_factory=CollObserveConfig)
 
 
 class CommsLoggerConfig(DeepSpeedConfigModel):

@@ -44,7 +44,12 @@ def _to_device(x, dev_sharding):
         entries = entries[len(entries) - x.ndim:]
     elif len(entries) < x.ndim:
         entries = [None] * (x.ndim - len(entries)) + entries
-    sh = NamedSharding(dev_sharding.mesh, PartitionSpec(*entries), memory_kind="device")
+    from deepspeed_tpu.utils.compat import with_memory_kind
+
+    # the compat fallback keeps the read path traceable on backends with a
+    # single memory space (CPU: the transfer degrades to a no-op placement)
+    sh = with_memory_kind(
+        NamedSharding(dev_sharding.mesh, PartitionSpec(*entries)), "device")
     # device_put is traceable and compiles to the host->device DMA (the
     # `memories` API); with_sharding_constraint would only annotate layout
     return jax.device_put(x, sh)
@@ -308,15 +313,21 @@ def dequantize_params(params: Any, dtype) -> Any:
 def offload_params(params: Any, min_size: int = 1 << 16) -> Any:
     """ZeRO-Inference placement: big non-embedding leaves move to pinned host
     memory behind stream-on-read wrappers; small leaves and the embedding
-    (consumed by gather, which cannot read host operands) stay on device."""
+    (consumed by gather, which cannot read host operands) stay on device.
+
+    Memory kinds resolve through ``utils/compat.with_memory_kind``: CPU
+    backends expose only ``unpinned_host``, where the host/device split
+    degrades to same-space placement (the offload machinery still runs
+    end-to-end, it just has nowhere colder to put the bytes)."""
+    from deepspeed_tpu.utils.compat import with_memory_kind
 
     def host(x):
-        return jax.device_put(x, x.sharding.with_memory_kind("pinned_host"))
+        return jax.device_put(x, with_memory_kind(x.sharding, "pinned_host"))
 
     def leaf(path, x):
         if isinstance(x, WOQTensor):
-            dev = (x.q.sharding.with_memory_kind("device"),
-                   x.scale.sharding.with_memory_kind("device"))
+            dev = (with_memory_kind(x.q.sharding, "device"),
+                   with_memory_kind(x.scale.sharding, "device"))
             return WOQTensor(host(x.q), host(x.scale), x.fmt, x.shape,
                              dev_sharding=dev, stacked=x.stacked)
         key = jax.tree_util.keystr(path)
@@ -329,7 +340,7 @@ def offload_params(params: Any, min_size: int = 1 << 16) -> Any:
             return x
         if x.ndim < 2 or x.size < min_size:
             return x
-        return OffloadedTensor(host(x), dev_sharding=x.sharding.with_memory_kind("device"))
+        return OffloadedTensor(host(x), dev_sharding=with_memory_kind(x.sharding, "device"))
 
     return jax.tree_util.tree_map_with_path(
         leaf, params, is_leaf=lambda x: isinstance(x, WOQTensor)

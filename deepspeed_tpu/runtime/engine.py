@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 import time
 from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional, Tuple
 
@@ -341,6 +342,7 @@ class DeepSpeedTPUEngine:
         ccfg = self._collectives_cfg
         from deepspeed_tpu.collectives import selector as coll_selector
 
+        self._coll_observatory = None
         if not ccfg.enabled:
             # the selector is process-global: a disabled engine must restore
             # the plain-lax defaults or it would inherit a previous engine's
@@ -373,11 +375,27 @@ class DeepSpeedTPUEngine:
                     f"the lax lowering; pass algorithm= explicitly inside "
                     f"full-manual regions instead")
                 facade_alg = None
+            ocfg = ccfg.observe
+            decision_table = ccfg.decision_table
+            if ocfg.enabled and not decision_table and ccfg.mode != "model":
+                # warm-start measured mode from the table a previous run's
+                # observatory persisted (collectives/observatory.py): the
+                # online rows ARE sweep-schema rows, so the selector consumes
+                # them exactly like a `benchmark --sweep` table
+                from deepspeed_tpu.collectives import observatory as coll_obs
+
+                # resolve THIS engine's path: the process-global observatory
+                # still holds the previous engine's config at this point
+                _table = ocfg.table_path or coll_obs.default_table_path()
+                if os.path.exists(_table):
+                    decision_table = _table
+                    log_dist(f"collectives: warm-starting measured mode from "
+                             f"the observatory table {_table}", ranks=[0])
             coll_selector.configure(
                 mode=ccfg.mode, alpha_us=ccfg.alpha_us,
                 beta_us_per_mb=ccfg.beta_us_per_mb,
                 codecs=tuple(ccfg.codecs), block_size=ccfg.block_size,
-                decision_table=ccfg.decision_table,
+                decision_table=decision_table,
                 min_quant_bytes=ccfg.min_quant_bytes,
                 min_algorithmic_bytes=ccfg.min_algorithmic_bytes,
                 pallas_alpha_scale=ccfg.pallas_alpha_scale,
@@ -385,6 +403,35 @@ class DeepSpeedTPUEngine:
                 # "auto" = no forced codec: the selector picks among `codecs`;
                 # a concrete name (incl. "none") pins that wire
                 facade_codec=ccfg.codec if ccfg.codec != "auto" else None)
+            if ocfg.enabled:
+                from deepspeed_tpu.collectives import observatory as coll_obs
+
+                obs = coll_obs.configure(
+                    enabled=True, sample_every=ocfg.sample_every,
+                    probes_per_sample=ocfg.probes_per_sample,
+                    iters=ocfg.iters, warmup=ocfg.warmup,
+                    probe_alternatives=ocfg.probe_alternatives,
+                    async_compile=ocfg.async_compile,
+                    table_path=ocfg.table_path, persist=ocfg.persist,
+                    ema=ocfg.ema, drift_ratio=ocfg.drift_ratio,
+                    refit_every=ocfg.refit_every, fit_decay=ocfg.fit_decay,
+                    max_probe_mb=ocfg.max_probe_mb,
+                    max_programs=ocfg.max_programs)
+                # drift arms the anomaly profiler capture when diagnostics
+                # wired one (diagnostics are built before this section)
+                pc = (self.diagnostics.profiler_capture
+                      if self.diagnostics is not None else None)
+                obs.install(mesh=self.mesh,
+                            profiler_arm=pc.arm if pc is not None else None)
+                self._coll_observatory = obs
+        if self._coll_observatory is None:
+            # observatory hygiene (process-global, like the selector reset
+            # above): an engine that does not enable it must not inherit a
+            # previous engine's probes/routes — but only when some earlier
+            # engine actually imported+enabled the module
+            _obs_mod = sys.modules.get("deepspeed_tpu.collectives.observatory")
+            if _obs_mod is not None and _obs_mod.enabled():
+                _obs_mod.configure(enabled=False)
         if self.config.model.dump_state:
             # reference engine.py dump_state: print the resolved config once
             log_dist(f"engine config: {self.config.model.model_dump()}", ranks=[0])
@@ -2035,6 +2082,10 @@ class DeepSpeedTPUEngine:
             # AFTER the abort check: a step the health policy aborted must
             # never become the snapshot the recovery loop rewinds to
             self.snapshot_manager.after_step(step)
+        if self._coll_observatory is not None:
+            # sampled (1-in-N) timed probes of the routed collective
+            # signatures — standalone dispatches, the step program untouched
+            self._coll_observatory.on_step(step)
         if self.monitor is not None:
             scalars = {
                 "Train/loss": metrics["loss"],

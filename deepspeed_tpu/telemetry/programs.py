@@ -169,6 +169,11 @@ class ProgramRecord:
     custom_kernels: List[Dict[str, Any]] = field(default_factory=list)
     hbm_estimate_bytes: Optional[int] = None
     hbm_estimate_ratio: Optional[float] = None
+    # wire bytes the collectives observatory traced for the ROUTED facade
+    # collectives of this program, and the ratio of the HLO-extracted
+    # collective bytes to them (collectives/observatory.py reconciliation)
+    routed_wire_bytes: int = 0
+    wire_ratio: Optional[float] = None
 
     @property
     def collective_bytes(self) -> int:
@@ -204,6 +209,8 @@ class ProgramRecord:
             "custom_kernels": list(self.custom_kernels),
             "hbm_estimate_bytes": self.hbm_estimate_bytes,
             "hbm_estimate_ratio": self.hbm_estimate_ratio,
+            "routed_wire_bytes": self.routed_wire_bytes,
+            "wire_ratio": self.wire_ratio,
         }
 
 
@@ -452,6 +459,30 @@ class ProgramRegistry:
             collectives=colls, custom_kernels=kernels,
         )
 
+        # Reconcile the wire bytes the selector's routing traced (the
+        # observatory's per-trace census, drained since the last capture)
+        # against what the compiled HLO actually moves. HLO collective
+        # bytes include EVERY collective (loss psums, GSPMD resharding), so
+        # the ratio runs >= 1 on healthy programs; well below 1 means routed
+        # wires the extraction cannot see — the selector is costing bytes
+        # that never hit the interconnect.
+        try:
+            from deepspeed_tpu.collectives import observatory as _coll_obs
+
+            routed = _coll_obs.drain_program_wire()
+        except Exception:  # noqa: BLE001 — reconciliation is best-effort
+            routed = 0
+        if routed > 0:
+            record.routed_wire_bytes = routed
+            record.wire_ratio = record.collective_bytes / routed
+            if record.wire_ratio < 0.5:
+                logger.warning(
+                    f"collectives: program {label!r} lowered "
+                    f"{record.collective_bytes} collective bytes but the "
+                    f"selector's routing traced {routed} wire bytes "
+                    f"(ratio {record.wire_ratio:.2f}) — routed wires are "
+                    "not reaching the interconnect as costed")
+
         estimate = self.hbm_estimate(hbm_scope) if hbm_scope else None
         if estimate:
             from deepspeed_tpu.utils.hbm import record_calibration
@@ -486,6 +517,8 @@ class ProgramRegistry:
             ("program/custom_kernel_count", r.custom_kernel_count),
         ):
             reg.gauge(name, program=r.label).set(float(value))
+        if r.wire_ratio is not None:
+            reg.gauge("coll/wire_bytes_ratio", program=r.label).set(r.wire_ratio)
         reg.counter("compile/count", program=r.label).add(1.0)
         if r.compile_wall_s is not None:
             reg.gauge("compile/last_wall_ms", program=r.label).set(

@@ -136,6 +136,26 @@ def memory_space(space: str):
     return TransferToMemoryKind("pinned_host" if space == "host" else "device")
 
 
+def with_memory_kind(sharding, kind: str):
+    """``sharding.with_memory_kind(kind)`` with a device-capability fallback.
+
+    CPU devices on this jax address exactly ONE memory space
+    (``unpinned_host``) — there is no pinned-host/device split to place
+    into, and constructing a sharding with either kind raises ``ValueError:
+    Could not find memory addressable by device cpu``. Offload placement
+    (ZeRO-Inference's pinned-host weights, the stream-on-read device
+    reads) degrades to the device-set's default kind there: every
+    ``device_put`` through the returned sharding is a same-space no-op, so
+    the code path stays exercised end-to-end on CPU instead of crashing,
+    and real TPU/GPU backends get the requested kind unchanged."""
+    try:
+        return sharding.with_memory_kind(kind)
+    except ValueError:
+        # requested kind unaddressable on this backend: keep the sharding's
+        # current (default) memory kind — placement becomes the identity
+        return sharding
+
+
 def shard_map(
     f: Callable,
     *,

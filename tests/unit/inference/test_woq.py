@@ -122,7 +122,17 @@ def test_zero_inference_offload_generate(devices):
     eng = _engine(zero_inference={"enabled": True, "min_leaf_size": 0})
     wq = eng.params["layers"]["attn"]["wq"]["kernel"]
     assert isinstance(wq, OffloadedTensor)
-    assert wq.x.sharding.memory_kind == "pinned_host"
+    # the host placement resolves through the compat fallback: pinned_host
+    # where the backend has it, the device-set default kind on CPU (which
+    # addresses only unpinned_host — placement degrades to the identity).
+    # Expectation derived from the DEVICE's capabilities, not from the
+    # object under test, so a regression in offload_params stays visible
+    # on backends that do have pinned_host.
+    dev = jax.devices()[0]
+    kinds = {m.kind for m in dev.addressable_memories()}
+    expected_kind = ("pinned_host" if "pinned_host" in kinds
+                     else dev.default_memory().kind)
+    assert wq.x.sharding.memory_kind == expected_kind
     # the embedding stays device-resident (gather cannot read host operands)
     emb = eng.params["embed"]["embedding"]
     assert not isinstance(emb, OffloadedTensor)

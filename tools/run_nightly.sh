@@ -85,9 +85,27 @@ prog_rc=${PIPESTATUS[0]}
 [ "${prog_rc}" -ne 0 ] && rc=1
 echo "# program inventory: ${PROG_OUT} (exit ${prog_rc})" >> "${OUT}"
 
+# Collective observatory report (ISSUE 11): routed hop-scope probes on the
+# 8-CPU mesh must persist a consumable decision table, the alpha/beta refit
+# must land in the selector, and the drift alarm must fire on an injected
+# slow sample without poisoning the table. Committed as its own artifact so
+# the selector's feedback loop is auditable per round.
+COLL_OUT="COLL_${ROUND}.log"
 {
-  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, program report: ${prog_rc})"
+  echo "# collective observatory — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  echo "# HEAD: ${HEAD_SHA}"
+  echo "# uncommitted-diff sha256: ${DIFF_SHA}"
+  echo "# cmd: python tools/coll_report.py"
+} > "${COLL_OUT}"
+JAX_PLATFORMS=cpu python tools/coll_report.py \
+  --table telemetry_out/coll_table.json 2>/dev/null | tee -a "${COLL_OUT}"
+coll_rc=${PIPESTATUS[0]}
+[ "${coll_rc}" -ne 0 ] && rc=1
+echo "# collective observatory: ${COLL_OUT} (exit ${coll_rc})" >> "${OUT}"
+
+{
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, program report: ${prog_rc}, coll report: ${coll_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
-echo "wrote ${OUT} ${PROG_OUT}"
+echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT}"
 exit "${rc}"
