@@ -14,5 +14,6 @@ from deepspeed_tpu.inference.engine_v2 import (
     build_hf_engine,
 )
 from deepspeed_tpu.inference.model import KVCache, decode_step, init_cache, prefill
-from deepspeed_tpu.inference.ragged import BlockedAllocator, StateManager
-from deepspeed_tpu.inference.sampling import sample_logits
+from deepspeed_tpu.inference.ragged import BlockedAllocator, PrefixCache, StateManager
+from deepspeed_tpu.inference.router import ServingRouter
+from deepspeed_tpu.inference.sampling import greedy_tokens, sample_logits

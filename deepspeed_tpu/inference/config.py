@@ -65,11 +65,22 @@ class ServingSLOConfig(DeepSpeedConfigModel):
     ``tpot_ms``; a ``None`` target is not enforced. ``window_s`` bounds the
     rolling windows behind the ``serving/goodput``, ``serving/tokens_per_s``
     and ``serving/preemption_rate`` gauges (see ``inference/lifecycle.py``).
+
+    Admission control (serving router, ISSUE 12): ``admission`` turns the
+    TTFT target into a gate applied BEFORE dispatching a prefill — a request
+    whose projected TTFT (wait so far + the replica's estimated time to
+    first token) already exceeds ``ttft_ms * admission_ttft_factor`` is
+    **shed** (rejected immediately, so it stops consuming queue capacity
+    that on-budget requests could use) or **deferred** (left queued for a
+    replica that can still make the budget; it sheds only when every replica
+    is over). ``"none"`` admits everything — the engine-only behavior.
     """
 
     ttft_ms: Optional[float] = None  # time-to-first-token target
     tpot_ms: Optional[float] = None  # mean time-per-output-token target
     window_s: float = 30.0  # rolling window for goodput/rate gauges
+    admission: str = "none"  # none | shed | defer (router-level gate)
+    admission_ttft_factor: float = 1.0  # shed when projected TTFT > target*factor
 
 
 class InferenceConfig(DeepSpeedConfigModel):

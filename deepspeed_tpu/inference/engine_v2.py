@@ -43,12 +43,15 @@ from deepspeed_tpu.inference.config import QuantConfig, ServingSLOConfig
 from deepspeed_tpu.inference.lifecycle import LifecycleTracker
 from deepspeed_tpu.inference.paged import (
     PagedKVPool,
+    copy_pool_blocks,
     init_pool,
     ragged_decode_chain,
     ragged_forward,
+    ragged_spec_decode_chain,
 )
 from deepspeed_tpu.inference.ragged import (
     BatchStaging,
+    PrefixCache,
     RaggedBatch,
     StateManager,
     build_ragged_batch,
@@ -93,6 +96,34 @@ class RaggedInferenceConfig(DeepSpeedConfigModel):
     # (same outputs, K× the dispatch/sync overhead). The effective chain
     # shrinks automatically near max_new_tokens and under KV-pool pressure.
     decode_chain: int = 8
+    # Content-hash prefix cache over the paged pool (ISSUE 12): finished
+    # prefill blocks are indexed by position-aligned token-chain hash and
+    # kept alive by allocator refcounts, so a later prompt sharing the
+    # prefix reuses the QUANTIZED block bytes directly (zero re-prefill,
+    # zero re-quantization); a partially matching block is reused via
+    # copy-on-write at the first divergent token. Off by default — the
+    # decode fast path is byte-identical when disabled.
+    prefix_cache: bool = False
+    # Cap on cache-held blocks as a fraction of the pool
+    # (utils/hbm.prefix_cache_capacity_blocks) — cache-aware pool sizing:
+    # the cache can never starve live sequences below (1-fraction) of the
+    # pool, and admission pressure evicts LRU entries before preempting.
+    prefix_cache_fraction: float = 0.5
+    # Record a blake2b digest of each cached block's quantized pool bytes at
+    # insert (one jitted fetch + D2H per NEW block, prefill-boundary only).
+    # The digest is the cached artifact's integrity identity — the
+    # correctness harness and the nightly smoke compare it at hit time.
+    # Lookups key on token-chain hashes either way, so latency-critical
+    # deployments can turn the fetch off without changing cache behavior.
+    prefix_cache_hash_bytes: bool = True
+    # Speculative decoding (ISSUE 12): number of draft tokens verified per
+    # model forward inside the decode chain (0 = off). Drafts come from an
+    # on-device n-gram (prompt-lookup) proposer over the row's history;
+    # verify-and-accept runs in the SAME jitted chain program — still one
+    # dispatch + one host sync per chain, >1 accepted token per forward on
+    # agreeable text. Greedy-only (acceptance compares argmax targets).
+    spec_decode: int = 0
+    spec_ngram: int = 2  # n-gram length the proposer matches on
     # Pre-flight HBM-fit check (utils/hbm.py) before param/pool
     # materialization: "warn" | "refuse" | "off".
     hbm_check: str = "warn"
@@ -206,6 +237,14 @@ class InferenceEngineV2:
         self.state = StateManager(num_blocks, config.kv_block_size, config.max_seqs,
                                   max_blocks_per_seq=self.max_pages)
         self._staging = BatchStaging(self.max_pages)
+        self.prefix_cache: Optional[PrefixCache] = None
+        if config.prefix_cache:
+            from deepspeed_tpu.utils.hbm import prefix_cache_capacity_blocks
+
+            self.prefix_cache = PrefixCache(
+                self.state.allocator, config.kv_block_size,
+                capacity_blocks=prefix_cache_capacity_blocks(
+                    num_blocks, config.prefix_cache_fraction))
 
         n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
         kv_on_tp = model_config.kv_heads % mesh.shape["tp"] == 0
@@ -309,6 +348,7 @@ class InferenceEngineV2:
         )
         self._step_cache: Dict[Tuple, Any] = {}
         self._chain_buf: Dict[int, Dict[str, np.ndarray]] = {}
+        self._spec_buf: Dict[int, Dict[str, np.ndarray]] = {}
         self._tracer = get_tracer()
         # Serving flight recorder (opt-in): per-request ring so a crash dump
         # names the in-flight requests even with the tracer disabled.
@@ -335,6 +375,13 @@ class InferenceEngineV2:
         self.dispatch_count = 0        # compiled programs dispatched
         self.host_sync_count = 0       # host blocking fetches
         self.tokens_decoded = 0        # decode tokens produced by generate()
+        # prefix-cache + speculative accounting (plain int adds; the serving
+        # benchmark and the router smoke read these)
+        self.prefill_tokens_total = 0  # prompt tokens submitted for prefill
+        self.prefill_tokens_cached = 0  # of those, served from the prefix cache
+        self.cow_copies = 0            # copy-on-write block clones dispatched
+        self.spec_model_steps = 0      # model forwards inside spec chains
+        self.spec_tokens_emitted = 0   # tokens those forwards emitted
 
     # ---------------------------------------------------------------- admission
     def query(self, uid: int) -> Tuple[int, int]:
@@ -429,10 +476,224 @@ class InferenceEngineV2:
                 self._kw_tag(sample_kw, eos_id))
         return self._step_cache[key]
 
+    def _spec_chain_fn(self, rows: int, k: int, eos_id: Optional[int]):
+        """Speculative K-step decode chain program
+        (paged.ragged_spec_decode_chain). Keyed (rows, k) like the plain
+        chain — n_spec/ngram are engine config, so one compiled program per
+        (rows, K) still holds. Greedy-only by construction."""
+        key = ("spec", rows, k, eos_id)
+        if key not in self._step_cache:
+            cfg = self.model_config
+            bs = self.config.kv_block_size
+            n_spec = self.config.spec_decode
+            ngram = self.config.spec_ngram
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def chain(params, pool, tokens, start_pos, block_tables, active,
+                      budgets, rng, history, hist_len):
+                return ragged_spec_decode_chain(
+                    params, cfg, pool, tokens, start_pos, block_tables, bs,
+                    active, budgets, rng, k, eos_id, history, hist_len,
+                    n_spec=n_spec, ngram=ngram)
+
+            self._step_cache[key] = self._watch(
+                chain, "spec_chain", f"r{rows}", f"k{k}", f"m{n_spec}",
+                self._kw_tag((), eos_id))
+        return self._step_cache[key]
+
+    def _cow_fn(self):
+        """Copy-on-write block clone (paged.copy_pool_blocks): src/dst ride
+        as traced scalars, so ONE compiled program serves every COW event."""
+        key = ("cow",)
+        if key not in self._step_cache:
+            bs = self.config.kv_block_size
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def cow(pool, src, dst):
+                return copy_pool_blocks(pool, src, dst, bs)
+
+            self._step_cache[key] = self._watch(cow, "cow")
+        return self._step_cache[key]
+
     def jit_cache_size(self, kind: Optional[str] = None) -> int:
         """Number of compiled step programs (optionally of one kind:
-        'logits' | 'sample' | 'chain') — recompile assertions in tests."""
+        'logits' | 'sample' | 'chain' | 'spec' | 'cow') — recompile
+        assertions in tests."""
         return sum(1 for k in self._step_cache if kind is None or k[0] == kind)
+
+    # ---------------------------------------------------------- prefix cache
+    def _block_fetch_fn(self):
+        """One jitted dynamic-slice program fetching a block's pool pages
+        (the slot offset rides as a traced scalar — eager slicing would
+        compile a fresh XLA program per distinct block offset)."""
+        key = ("blockfetch",)
+        if key not in self._step_cache:
+            bs = self.config.kv_block_size
+
+            @jax.jit
+            def fetch(pool, start):
+                def sl(a):
+                    if a is None:
+                        return None
+                    return jax.lax.dynamic_slice_in_dim(a, start, bs, axis=1)
+
+                return (sl(pool.k), sl(pool.v), sl(pool.k_scale), sl(pool.v_scale))
+
+            self._step_cache[key] = fetch
+        return self._step_cache[key]
+
+    def _block_content_hash(self, block: int) -> str:
+        """blake2b over the block's pool bytes — for a quantized pool the
+        int8/fp8 value pages AND the fp32 scale pages together (the PR-10
+        layout travels as one unit). This digest is the cached artifact's
+        identity: tests and the nightly smoke compare it at hit time against
+        the insert-time digest to prove sharing/COW/eviction never touched
+        the stored bytes, and it is taken over exactly the bytes the
+        paged-attention block loads read (a hit is never re-quantized)."""
+        import hashlib
+
+        bs = self.config.kv_block_size
+        parts = self._block_fetch_fn()(self.pool, jnp.int32(block * bs))
+        h = hashlib.blake2b(digest_size=16)
+        for arr in parts:
+            if arr is not None:
+                h.update(np.asarray(arr).tobytes())
+        return h.hexdigest()
+
+    def prefix_probe(self, cand: np.ndarray):
+        """Prefix-cache lookup for admission accounting: returns
+        ``(hit, admission_token_count)`` where the count excludes the
+        tokens fully cached blocks cover. The COW clone's block is
+        deliberately NOT subtracted — ``_attach_prefix`` allocates it
+        outside ``can_schedule``, and counting its tokens as to-prefill
+        makes the admission estimate cover that allocation. One definition
+        shared by ``generate`` and the serving router."""
+        pc = self.prefix_cache
+        if pc is None:
+            return None, len(cand)
+        hit = pc.match(cand)
+        return hit, len(cand) - hit.n_blocks * self.config.kv_block_size
+
+    def _pin_hit(self, hit) -> None:
+        """Take a temporary reference on every block of a PrefixHit. Between
+        ``prefix_probe`` and ``_attach_prefix`` the admission path may evict
+        LRU cache entries (``_can_schedule_evicting``) — without the pin,
+        eviction of an entry whose ONLY holder was the cache would free the
+        very blocks the hit is about to share, and the attach would raise
+        mid-serving. Pinned blocks survive eviction (the entry goes, the
+        bytes stay) and the pin is dropped by ``_unpin_hit`` either way."""
+        if hit is None:
+            return
+        blocks = list(hit.blocks)
+        if hit.cow_block is not None:
+            blocks.append(hit.cow_block)
+        self.state.allocator.share(blocks)
+
+    def _unpin_hit(self, hit) -> None:
+        if hit is None:
+            return
+        blocks = list(hit.blocks)
+        if hit.cow_block is not None:
+            blocks.append(hit.cow_block)
+        self.state.allocator.release(blocks)
+
+    def _attach_prefix(self, uid: int, hit) -> int:
+        """Wire a PrefixHit into a fresh sequence: share the full cached
+        blocks, clone the COW block (if any) up to the divergent token, and
+        return how many prompt tokens the cache covered (== the new
+        sequence's ``seen_tokens``)."""
+        bs = self.config.kv_block_size
+        alloc = self.state.allocator
+        seq = self.state.get_or_create(uid)
+        assert seq.seen_tokens == 0 and seq.n_blocks == 0
+        reuse = 0
+        if hit.blocks:
+            alloc.share(hit.blocks)
+            seq.append_blocks(np.asarray(hit.blocks, np.int32))
+            reuse = len(hit.blocks) * bs
+        if hit.cow_block is not None and hit.cow_len > 0:
+            # hold the source across the allocation (our own allocate may
+            # trigger LRU eviction, which could otherwise free the source)
+            alloc.share([hit.cow_block])
+            dst = self._ensure_blocks(1)
+            with self._tracer.span("serve:cow", src=hit.cow_block, dst=int(dst[0])):
+                self.pool = self._cow_fn()(
+                    self.pool, jnp.int32(hit.cow_block), jnp.int32(dst[0]))
+            self.dispatch_count += 1
+            alloc.release([hit.cow_block])
+            seq.append_blocks(dst)
+            reuse += hit.cow_len
+            self.cow_copies += 1
+        seq.seen_tokens = reuse
+        return reuse
+
+    def _ensure_blocks(self, n: int) -> np.ndarray:
+        """Allocate ``n`` blocks, evicting LRU prefix-cache entries if the
+        free stack runs short."""
+        pc = self.prefix_cache
+        while (self.state.free_blocks < n and pc is not None
+               and pc.evict_one()):
+            pass
+        return self.state.allocator.allocate(n)
+
+    def _insert_prefix(self, uid: int, full_tokens: np.ndarray) -> None:
+        """Index the finished prefill's full blocks (values already in the
+        pool — the entries' content hashes are snapshots of the quantized
+        bytes as written)."""
+        pc = self.prefix_cache
+        seq = self.state.get(uid)
+        if pc is None or seq is None:
+            return
+        hasher = (self._block_content_hash
+                  if self.config.prefix_cache_hash_bytes else None)
+        pc.insert(full_tokens, seq.blocks, hasher=hasher)
+
+    def try_admit(self, uid: int, cand: np.ndarray, other_uids: Sequence[int],
+                  other_counts: Sequence[int]) -> Optional[np.ndarray]:
+        """ONE definition of prefix-aware admission, shared by ``generate``
+        and the serving router: probe the cache, pin the hit across the
+        (evicting) schedule check, attach shared/COW blocks on success, and
+        account the reuse. Returns the suffix tokens still needing prefill,
+        or None when the request does not fit alongside ``other_uids``
+        (state unchanged — the pin is dropped either way)."""
+        hit, adm_count = self.prefix_probe(cand)
+        self._pin_hit(hit)
+        if not self._can_schedule_evicting(
+                list(other_uids) + [uid], list(other_counts) + [adm_count]):
+            self._unpin_hit(hit)
+            return None
+        reuse = 0
+        if hit is not None and (hit.blocks or hit.cow_len):
+            reuse = self._attach_prefix(uid, hit)
+        self._unpin_hit(hit)
+        if self.prefix_cache is not None:
+            self.prefix_cache.record(hit)
+        self.prefill_tokens_total += len(cand)
+        self.prefill_tokens_cached += reuse
+        return cand[reuse:]
+
+    def chain_window(self, budgets: Sequence[int], k: int) -> List[int]:
+        """KV tokens one K-step chain may consume per row: each of the K
+        iterations emits up to ``1 + spec_decode`` tokens, plus the
+        ``spec_decode`` transient rejected-draft slots. One formula for
+        ``generate`` and the router's pressure loops (spec_decode=0 reduces
+        to the plain ``min(k, budget)``)."""
+        m = 1 + self.config.spec_decode
+        return [min(k * m, b) + self.config.spec_decode for b in budgets]
+
+    def _can_schedule_evicting(self, uids, counts) -> bool:
+        """``can_schedule`` that reclaims cache-only blocks under pressure:
+        LRU prefix entries release their references until admission fits or
+        the cache is dry — cached prefixes never starve live traffic."""
+        if self.state.can_schedule(uids, counts):
+            return True
+        pc = self.prefix_cache
+        if pc is None:
+            return False
+        while pc.evict_one():
+            if self.state.can_schedule(uids, counts):
+                return True
+        return False
 
     # ---------------------------------------------------------------- put
     def _build_batch(self, uids, token_lists) -> RaggedBatch:
@@ -565,6 +826,77 @@ class InferenceEngineV2:
             self.state.get(uid).seen_tokens += int(e)
         return out, emitted, rng
 
+    def decode_spec_chain(
+        self,
+        uids: Sequence[int],
+        last_tokens: Sequence[int],
+        budgets: Sequence[int],
+        k: int,
+        rng: jax.Array,
+        histories: Sequence[np.ndarray],
+        eos_id: Optional[int] = None,
+        tracker: Optional[LifecycleTracker] = None,
+        rids: Optional[Sequence[int]] = None,
+    ) -> Tuple[np.ndarray, np.ndarray, jax.Array]:
+        """One speculative chain over ``uids``: ``k`` verify forwards, each
+        proposing ``spec_decode`` n-gram drafts — up to ``k * (1+n_spec)``
+        accepted tokens from ONE dispatch and ONE host sync. ``histories``
+        are the rows' full token contexts (prompt + generated, INCLUDING the
+        ``last_tokens`` entry) feeding the on-device proposer. Greedy only.
+
+        Block tables are pre-extended for the emission window plus
+        ``n_spec`` transient slots (rejected-draft KV writes land past the
+        last accepted token and are overwritten by later steps).
+        """
+        n = len(uids)
+        n_spec = self.config.spec_decode
+        m = 1 + n_spec
+        rows = -(-n // self.config.row_bucket) * self.config.row_bucket
+        with self._tracer.span("serve:assemble", kind="spec_chain", rows=rows):
+            buf = self._chain_arrays(rows)
+            sb = self._spec_buf.get(rows)
+            if sb is None:
+                sb = {"hist": np.zeros((rows, self.max_seq_len), np.int32),
+                      "hist_len": np.zeros((rows,), np.int32)}
+                self._spec_buf[rows] = sb
+            else:
+                sb["hist"][:] = 0
+                sb["hist_len"][:] = 0
+            for i, uid in enumerate(uids):
+                window = min(k * m, int(budgets[i]))
+                seq = self.state.extend(uid, window + n_spec)
+                buf["tables"][i, : seq.n_blocks] = seq.blocks
+                buf["pos"][i] = seq.seen_tokens
+                h = histories[i]
+                sb["hist"][i, : len(h)] = h
+                sb["hist_len"][i] = len(h)
+            buf["tokens"][:n] = last_tokens
+            buf["active"][:n] = True
+            buf["budgets"][:n] = np.minimum(budgets, k * m)
+        chain = self._spec_chain_fn(rows, k, eos_id)
+        with self._tracer.span("serve:dispatch", kind="spec_chain", rows=rows,
+                               k=k, n_spec=n_spec):
+            if tracker is not None and rids is not None:
+                tracker.mark_dispatch(rids, "chain")
+            out, emitted, _, steps, rng, self.pool = chain(
+                self.params, self.pool,
+                jnp.asarray(buf["tokens"]), jnp.asarray(buf["pos"]),
+                jnp.asarray(buf["tables"]), jnp.asarray(buf["active"]),
+                jnp.asarray(buf["budgets"]), rng,
+                jnp.asarray(sb["hist"]), jnp.asarray(sb["hist_len"]),
+            )
+        self.dispatch_count += 1
+        with self._tracer.span("serve:fetch", kind="spec_chain"):
+            out = np.asarray(out[:n])
+            emitted = np.asarray(emitted[:n])
+            steps = np.asarray(steps[:n])
+        self.host_sync_count += 1
+        for uid, e in zip(uids, emitted):
+            self.state.get(uid).seen_tokens += int(e)
+        self.spec_model_steps += int(steps.sum())
+        self.spec_tokens_emitted += int(emitted.sum())
+        return out, emitted, rng
+
     # ---------------------------------------------------------------- serving loop
     def generate(
         self,
@@ -606,13 +938,22 @@ class InferenceEngineV2:
         """
         prompts = [np.asarray(p, np.int32) for p in prompts]
         pool_tokens = self.num_kv_blocks * self.config.kv_block_size
+        n_spec = self.config.spec_decode
+        if n_spec > 0 and do_sample:
+            raise ValueError(
+                "spec_decode is greedy-only (verify-and-accept compares "
+                "argmax targets); disable do_sample or set spec_decode=0")
+        # spec chains write up to n_spec transient (rejected-draft) KV slots
+        # past the last emitted token — the length guards carry that margin
+        margin = n_spec
         for i, p in enumerate(prompts):
-            if len(p) + max_new_tokens > self.max_seq_len:
+            if len(p) + max_new_tokens + margin > self.max_seq_len:
                 raise ValueError(
                     f"prompt {i} ({len(p)} tokens) + max_new_tokens={max_new_tokens} "
-                    f"exceeds engine max_seq_len={self.max_seq_len}"
+                    f"(+{margin} speculative slack) exceeds engine "
+                    f"max_seq_len={self.max_seq_len}"
                 )
-            if len(p) + max_new_tokens > pool_tokens:
+            if len(p) + max_new_tokens + margin > pool_tokens:
                 raise ValueError(
                     f"prompt {i} ({len(p)} tokens) + max_new_tokens={max_new_tokens} "
                     f"cannot ever fit the KV pool ({pool_tokens} slots); no amount of "
@@ -635,7 +976,13 @@ class InferenceEngineV2:
         active: Dict[int, int] = {}  # uid -> idx
         order: Dict[int, None] = {}  # admission order (insertion-ordered set)
         outputs: Dict[int, np.ndarray] = {}
-        rng = jax.random.PRNGKey(seed)
+        # committed key, replicated like every step output: a fresh PRNGKey
+        # is uncommitted, but the key a chain returns carries
+        # NamedSharding(mesh, P()) — jit caches on that difference, so an
+        # uncommitted first key makes the SECOND admission wave recompile
+        # the prefill program mid-serving (a ~0.4s TTFT cliff under bursts)
+        rng = jax.device_put(jax.random.PRNGKey(seed),
+                             NamedSharding(self.mesh, P()))
         next_uid = 0
         registry = self._tracer.registry if self._tracer.enabled else None
 
@@ -667,6 +1014,13 @@ class InferenceEngineV2:
             c_tokens = registry.counter("serving/tokens_decoded")
             c_chains = registry.counter("serving/chains")
             h_chain_len = registry.histogram("serving/chain_len")
+            g_pfx_hit = g_pfx_blocks = g_spec_acc = g_spec_tpf = None
+            if self.prefix_cache is not None:
+                g_pfx_hit = registry.gauge("serving/prefix_hit_rate")
+                g_pfx_blocks = registry.gauge("serving/prefix_cached_blocks")
+            if self.config.spec_decode > 0:
+                g_spec_acc = registry.gauge("serving/spec_accept_rate")
+                g_spec_tpf = registry.gauge("serving/spec_tokens_per_forward")
 
         def context(idx: int) -> np.ndarray:
             return np.concatenate([prompts[idx], np.asarray(gen[idx], np.int32)])
@@ -685,25 +1039,29 @@ class InferenceEngineV2:
                 if tracker is not None:
                     tracker.finish(idx)
 
+        pc = self.prefix_cache
         while queue or active:
             # ---- admit pending prompts (fused prefill + first-token sample)
             adm_uids: List[int] = []
             adm_tokens: List[np.ndarray] = []
             adm_counts: List[int] = []
+            adm_full: List[np.ndarray] = []  # full contexts, for cache insert
             decoding = list(active.keys())  # reserve 1-token decode headroom
             while queue and len(active) < self.config.max_seqs:
                 idx = queue[0]
                 if arr is not None and time.perf_counter() - t_start < arr[idx]:
                     break  # open-loop workload: not arrived yet
                 cand = context(idx)
-                if not self.state.can_schedule(
-                        decoding + adm_uids + [next_uid],
-                        [1] * len(decoding) + adm_counts + [len(cand)]):
+                suffix = self.try_admit(
+                    next_uid, cand, decoding + adm_uids,
+                    [1] * len(decoding) + adm_counts)
+                if suffix is None:
                     break
                 queue.popleft()
                 adm_uids.append(next_uid)
-                adm_tokens.append(cand)
-                adm_counts.append(len(cand))
+                adm_tokens.append(suffix)
+                adm_counts.append(len(suffix))
+                adm_full.append(cand)
                 if tracker is not None:
                     tracker.admit(idx, next_uid)
                 active[next_uid] = idx
@@ -713,6 +1071,11 @@ class InferenceEngineV2:
                 adm_rids = [active[u] for u in adm_uids]
                 toks, rng = self._put_sample(adm_uids, adm_tokens, rng, sample_kw,
                                              tracker=tracker, rids=adm_rids)
+                if pc is not None:
+                    # index the freshly written full blocks (quantized bytes
+                    # are in the pool now — hashes snapshot them as written)
+                    for u, full in zip(adm_uids, adm_full):
+                        self._insert_prefix(u, full)
                 if tracker is not None:
                     tracker.emitted_batch(adm_rids, (1,) * len(adm_rids))
                 for u, t in zip(adm_uids, toks):
@@ -733,17 +1096,18 @@ class InferenceEngineV2:
             # ---- one chained decode over the active set. K stays pinned at
             # decode_chain so one compiled program serves every chain (per-row
             # budget masks inside the scan handle the max_new_tokens tail);
-            # only KV-pool pressure shrinks the window, then preempts.
+            # only KV-pool pressure shrinks the window, then preempts. With
+            # speculative decoding each of the K forwards may emit up to
+            # 1+n_spec tokens, so the KV window scales by that factor plus
+            # the n_spec transient-write slack.
             uids = list(active.keys())
             budgets = [max_new_tokens - len(gen[active[u]]) for u in uids]
             k = self.config.decode_chain
             while True:
-                def window(kk):
-                    return [min(kk, b) for b in budgets]
-
-                while k > 1 and not self.state.can_schedule(uids, window(k)):
+                while k > 1 and not self._can_schedule_evicting(
+                        uids, self.chain_window(budgets, k)):
                     k -= 1
-                if self.state.can_schedule(uids, window(k)):
+                if self._can_schedule_evicting(uids, self.chain_window(budgets, k)):
                     break
                 victim = next(reversed(order))
                 del order[victim]
@@ -765,9 +1129,15 @@ class InferenceEngineV2:
                 k = self.config.decode_chain
             last = [gen[active[u]][-1] for u in uids]
             chain_rids = [active[u] for u in uids]
-            out, emitted, rng = self.decode_chain(
-                uids, last, budgets, k, rng, eos_id=eos_token_id,
-                sample_kw=sample_kw, tracker=tracker, rids=chain_rids)
+            if n_spec > 0:
+                histories = [context(active[u]) for u in uids]
+                out, emitted, rng = self.decode_spec_chain(
+                    uids, last, budgets, k, rng, histories,
+                    eos_id=eos_token_id, tracker=tracker, rids=chain_rids)
+            else:
+                out, emitted, rng = self.decode_chain(
+                    uids, last, budgets, k, rng, eos_id=eos_token_id,
+                    sample_kw=sample_kw, tracker=tracker, rids=chain_rids)
             n_emitted = int(emitted.sum())
             self.tokens_decoded += n_emitted
             if tracker is not None:
@@ -783,6 +1153,15 @@ class InferenceEngineV2:
                 g_occ.set(len(active) / self.config.max_seqs)
                 g_free.set(float(self.state.free_blocks))
                 g_util.set(self.state.utilization)
+                if g_pfx_hit is not None:
+                    g_pfx_hit.set(pc.hit_rate)
+                    g_pfx_blocks.set(float(len(pc)))
+                if g_spec_acc is not None and self.spec_model_steps:
+                    g_spec_acc.set(
+                        (self.spec_tokens_emitted - self.spec_model_steps)
+                        / (self.spec_model_steps * n_spec))
+                    g_spec_tpf.set(
+                        self.spec_tokens_emitted / self.spec_model_steps)
             for i, u in enumerate(uids):
                 for t in out[i, : emitted[i]]:
                     if u in active:

@@ -61,7 +61,8 @@ class RequestRecord:
 
     __slots__ = ("rid", "uid", "arrival", "admit", "first_admit", "first_token",
                  "last_emit", "finish", "tokens", "chains", "preemptions",
-                 "readmissions", "decode_s", "dispatch_stamps", "phase")
+                 "readmissions", "decode_s", "dispatch_stamps", "phase",
+                 "last_preempt", "replica")
 
     def __init__(self, rid: int, arrival: float):
         self.rid = rid
@@ -69,6 +70,8 @@ class RequestRecord:
         self.arrival = arrival
         self.admit: Optional[float] = None  # most recent admission
         self.first_admit: Optional[float] = None
+        self.last_preempt: Optional[float] = None  # readmit-wait anchor
+        self.replica: Optional[int] = None  # router affinity (None = local)
         self.first_token: Optional[float] = None
         self.last_emit: Optional[float] = None  # previous boundary stamp
         self.finish: Optional[float] = None
@@ -154,6 +157,7 @@ class LifecycleTracker:
             self._c_requests = reg.counter("serving/requests", **lb)
             self._c_finished = reg.counter("serving/requests_finished", **lb)
             self._c_readmit = reg.counter("serving/readmissions", **lb)
+            self._h_readmit = reg.histogram("serving/readmit_wait_ms", **lb)
             self._c_slo_met = reg.counter("serving/slo_met", **lb)
             self._c_slo_missed = reg.counter("serving/slo_missed", **lb)
             self._g_goodput = reg.gauge("serving/goodput", **lb)
@@ -197,9 +201,15 @@ class LifecycleTracker:
             if self._emit:
                 self._h_queue.observe((now - rec.arrival) * 1e3)
         else:
+            # re-admission after preemption: the wait lands in its OWN
+            # histogram; queue_wait stays pinned to the first admission and
+            # TTFT stays measured from the ORIGINAL arrival (never restarted
+            # — the fake-clock test pins both)
             rec.readmissions += 1
             if self._emit:
                 self._c_readmit.add(1.0)
+                anchor = rec.last_preempt if rec.last_preempt is not None else rec.arrival
+                self._h_readmit.observe((now - anchor) * 1e3)
         self._record_to_recorder(rec)
 
     def mark_dispatch(self, rids: Sequence[int], kind: str,
@@ -278,6 +288,7 @@ class LifecycleTracker:
             return
         rec.preemptions += 1
         rec.phase = "preempted"
+        rec.last_preempt = now  # anchor for serving/readmit_wait_ms
         # decode pauses while re-queued: break the TPOT chain so queue time
         # is charged to the (re)admission wait, not to per-token latency
         rec.last_emit = None

@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference.model import _apply_norm, _attn_out, _logits, _mlp, _moe, _qkv
-from deepspeed_tpu.inference.sampling import sample_logits
+from deepspeed_tpu.inference.sampling import greedy_tokens, sample_logits
 from deepspeed_tpu.models.transformer import TransformerConfig
 
 
@@ -173,10 +173,15 @@ def _forward_hidden(
     new_lens: jax.Array,  # [N] int32
     block_tables: jax.Array,  # [N, P] int32
     block_size: int,
+    all_positions: bool = False,
 ) -> Tuple[jax.Array, PagedKVPool]:
     """One mixed prefill/decode layer-stack pass -> (last-token hidden [N, E],
     pool). Shared by the single-step ``ragged_forward`` and the K-step
     ``ragged_decode_chain`` — one definition of the serving transformer math.
+
+    ``all_positions=True`` returns the full ``[N, C, E]`` hidden states
+    instead of the last-token selection — the speculative verify step needs
+    a logit at EVERY draft position to accept/reject in one pass.
     """
     N, C = tokens.shape
     bs = block_size
@@ -245,6 +250,8 @@ def _forward_hidden(
         body, x, (params["layers"], pool.k, pool.v, pool.k_scale, pool.v_scale))
     pool = pool._replace(k=k_new, v=v_new, k_scale=ks_new, v_scale=vs_new)
 
+    if all_positions:
+        return x, pool  # [N, C, E]
     last = jnp.take_along_axis(
         x, jnp.maximum(new_lens - 1, 0)[:, None, None], axis=1
     )[:, 0]  # [N, E]
@@ -341,3 +348,152 @@ def ragged_decode_chain(
     (pool, _, _, active, emitted, rng), outs = jax.lax.scan(
         step, carry0, None, length=k_steps)
     return outs.T, emitted, active, rng, pool
+
+
+def copy_pool_blocks(pool: PagedKVPool, src: jax.Array, dst: jax.Array,
+                     block_size: int) -> PagedKVPool:
+    """Copy one block's slots (values + scale pages together — the PR-10
+    layout travels as a unit) from block ``src`` to block ``dst`` across
+    every layer. The prefix cache's copy-on-write: a shared block diverging
+    mid-block is cloned into a private block before the divergent token's
+    KV write. ``src``/``dst`` are traced scalars, so ONE jitted program
+    serves every COW event."""
+
+    def cp(arr):
+        if arr is None:
+            return None
+        sl = jax.lax.dynamic_slice_in_dim(arr, src * block_size, block_size, axis=1)
+        return jax.lax.dynamic_update_slice_in_dim(arr, sl, dst * block_size, axis=1)
+
+    return PagedKVPool(k=cp(pool.k), v=cp(pool.v),
+                       k_scale=cp(pool.k_scale), v_scale=cp(pool.v_scale))
+
+
+def _ngram_propose(hist: jax.Array, hist_len: jax.Array, n_spec: int,
+                   ngram: int) -> jax.Array:
+    """Prompt-lookup draft proposal, fully on device: for each row find the
+    LAST previous occurrence of the trailing ``ngram`` tokens in the row's
+    history and propose the ``n_spec`` tokens that followed it. Rows with no
+    match (or matches running off the valid history) fall back to repeating
+    the current token — verification rejects bad drafts, so the fallback
+    only costs acceptance, never correctness.
+
+    hist: [N, H] token history (entries >= hist_len are ignored);
+    hist_len: [N] tokens valid per row (the current input token is
+    ``hist[hist_len - 1]``). Returns drafts [N, n_spec] int32.
+    """
+    N, H = hist.shape
+    pat_idx = jnp.maximum(hist_len[:, None] - ngram + jnp.arange(ngram)[None, :], 0)
+    pat = jnp.take_along_axis(hist, pat_idx, axis=1)  # [N, ngram]
+    histp = jnp.pad(hist, ((0, 0), (0, ngram + n_spec)), constant_values=-1)
+    ok = jnp.ones((N, H), bool)
+    for i in range(ngram):
+        ok = ok & (histp[:, i: i + H] == pat[:, i: i + 1])
+    # window must be a PREVIOUS occurrence fully inside valid history
+    ok = ok & (jnp.arange(H)[None, :] < (hist_len - ngram)[:, None])
+    any_m = ok.any(axis=1)
+    t_star = jnp.where(any_m, H - 1 - jnp.argmax(ok[:, ::-1], axis=1), 0)
+    didx = t_star[:, None] + ngram + jnp.arange(n_spec)[None, :]
+    drafts = jnp.take_along_axis(histp, didx, axis=1)
+    cur = jnp.take_along_axis(hist, jnp.maximum(hist_len - 1, 0)[:, None], axis=1)
+    # a draft slot is valid only INSIDE the row's history: positions in
+    # [hist_len, H) are buffer zeros (not the -1 pad), which would otherwise
+    # propose token id 0 on matches ending near the tail — exactly where a
+    # repetitive text's proposer should shine
+    valid = (didx < hist_len[:, None]) & (drafts >= 0)
+    return jnp.where(any_m[:, None] & valid, drafts, cur).astype(jnp.int32)
+
+
+def ragged_spec_decode_chain(
+    params,
+    cfg: TransformerConfig,
+    pool: PagedKVPool,
+    tokens: jax.Array,  # [N] int32 — last sampled token per row (next input)
+    start_pos: jax.Array,  # [N] int32 — global position of that input token
+    block_tables: jax.Array,  # [N, P], pre-extended for window + n_spec slack
+    block_size: int,
+    active: jax.Array,  # [N] bool
+    budgets: jax.Array,  # [N] int32 — max tokens this chain may emit per row
+    rng: jax.Array,
+    k_steps: int,  # outer verify iterations (model forwards) per dispatch
+    eos_id: Optional[int],
+    history: jax.Array,  # [N, H] int32 — context incl. the input token
+    hist_len: jax.Array,  # [N] int32 — valid history length per row
+    *,
+    n_spec: int,
+    ngram: int = 2,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, PagedKVPool]:
+    """Speculative K-step decode chain: greedy verify-and-accept over n-gram
+    drafts, still ONE dispatch + ONE host sync per chain.
+
+    Each of the ``k_steps`` scan iterations forwards ``1 + n_spec`` tokens
+    (the current input plus proposed drafts) through the SAME ragged layer
+    stack as the plain chain, takes greedy targets at every position, and
+    accepts the longest draft prefix that matches — emitting between 1 and
+    ``1 + n_spec`` tokens per model forward. Rejected-draft KV writes are
+    position-addressed, so the next iteration's writes simply overwrite
+    them; accepted-draft KV is already correct (the verify forward IS the
+    target forward at those positions). Greedy only: acceptance compares
+    against argmax targets, which keeps spec output token-identical to the
+    plain chain by construction.
+
+    Transient KV writes run ``n_spec`` positions past the last emitted
+    token, so the caller pre-extends block tables for ``window + n_spec``
+    tokens (see ``InferenceEngineV2.decode_spec_chain``).
+
+    Returns ``(out_tokens [N, k_steps*(1+n_spec)] compacted, emitted [N],
+    active [N], steps [N], rng, pool)`` — ``out_tokens[i, :emitted[i]]``
+    valid, ``steps[i]`` = model forwards row i was live for (the
+    accepted-tokens/forward telemetry denominator).
+    """
+    m = 1 + n_spec
+    N = tokens.shape[0]
+    idx = jnp.arange(m)[None, :]
+
+    def step(carry, _):
+        pool, tok, pos, live, emitted, hist, hlen, steps, key = carry
+        drafts = _ngram_propose(hist, hlen, n_spec, ngram)  # [N, n_spec]
+        inputs = jnp.concatenate([tok[:, None], drafts], axis=1)  # [N, m]
+        positions = pos[:, None] + jnp.arange(m)[None, :]
+        new_lens = jnp.where(live, m, 0)
+        hs, pool = _forward_hidden(params, cfg, pool, inputs, positions,
+                                   new_lens, block_tables, block_size,
+                                   all_positions=True)
+        logits = _logits(params, cfg, hs)  # [N, m, V]
+        g = greedy_tokens(logits)  # [N, m] greedy targets
+        # draft j accepted iff it matches the target at its previous
+        # position AND every earlier draft was accepted (cumulative)
+        match = (inputs[:, 1:] == g[:, :-1]).astype(jnp.int32)  # [N, n_spec]
+        n_acc = jnp.cumprod(match, axis=1).sum(axis=1)
+        e = jnp.minimum(n_acc + 1, budgets - emitted)
+        has_eos = jnp.zeros((N,), bool)
+        if eos_id is not None:
+            is_eos = (g == eos_id) & (idx < e[:, None])
+            has_eos = is_eos.any(axis=1)
+            e = jnp.where(has_eos, jnp.argmax(is_eos, axis=1) + 1, e)
+        e = jnp.where(live, e, 0)
+        out = jnp.where((idx < e[:, None]) & live[:, None], g, -1)
+        nxt = jnp.take_along_axis(g, jnp.maximum(e - 1, 0)[:, None], axis=1)[:, 0]
+        # append the emitted tokens to the on-device history (the proposer's
+        # source); masked slots scatter out of bounds and drop
+        hidx = jnp.where(idx < e[:, None], hlen[:, None] + idx, hist.shape[1])
+        hist = hist.at[jnp.arange(N)[:, None], hidx].set(g, mode="drop")
+        emitted = emitted + e
+        still = live & (emitted < budgets) & ~has_eos
+        steps = steps + live.astype(jnp.int32)
+        return (pool, jnp.where(live, nxt, tok), pos + e, still, emitted,
+                hist, hlen + e, steps, key), out
+
+    zeros = jnp.zeros_like(start_pos)
+    carry0 = (pool, tokens, start_pos, active, zeros, history, hist_len,
+              zeros, rng)
+    (pool, _, _, active, emitted, _, _, steps, rng), outs = jax.lax.scan(
+        step, carry0, None, length=k_steps)
+    # compact: each iteration's emitted prefix packs to the row's front, so
+    # the host contract stays out[i, :emitted[i]] exactly like the plain chain
+    o = outs.transpose(1, 0, 2).reshape(N, k_steps * m)
+    valid = o >= 0
+    tgt = jnp.where(valid, jnp.cumsum(valid, axis=1) - 1, k_steps * m)
+    compact = jnp.full((N, k_steps * m), -1, jnp.int32)
+    compact = compact.at[jnp.arange(N)[:, None], tgt].set(o, mode="drop")
+    return compact, emitted, active, steps, rng, pool

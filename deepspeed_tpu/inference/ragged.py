@@ -44,6 +44,15 @@ class BlockedAllocator:
     fixed cost would dominate it). ``allocate`` returns an int32 ndarray so
     downstream block-table writes stay vectorized. ``free`` validates the
     whole batch before mutating — a bad call leaves the allocator unchanged.
+
+    Ref-counted sharing (prefix cache, ISSUE 12): every allocated block
+    carries a reference count (``allocate`` sets it to 1). ``share`` adds a
+    holder, ``release`` drops one and returns the block to the free stack at
+    zero. ``free`` keeps the strict single-owner contract: freeing a block
+    another holder still references raises. All four ops validate the whole
+    batch before mutating and roll back on error — a bad call leaves the
+    bitmap, the refcounts, and the stack unchanged (invariant:
+    ``_refs[b] == 0  <=>  _state[b] == 1`` i.e. free).
     """
 
     def __init__(self, num_blocks: int):
@@ -52,10 +61,15 @@ class BlockedAllocator:
         self.num_blocks = num_blocks
         self._free_stack: List[int] = list(range(num_blocks - 1, -1, -1))
         self._state = bytearray(b"\x01" * num_blocks)  # 1 = free
+        self._refs: List[int] = [0] * num_blocks  # holders per block
 
     @property
     def free_blocks(self) -> int:
         return len(self._free_stack)
+
+    def refcount(self, block: int) -> int:
+        """Holders of ``block`` (0 = free)."""
+        return self._refs[block]
 
     def allocate(self, n: int) -> np.ndarray:
         stack = self._free_stack
@@ -66,27 +80,89 @@ class BlockedAllocator:
         out = stack[-n:]
         del stack[-n:]
         state = self._state
+        refs = self._refs
         for b in out:
             state[b] = 0
+            refs[b] = 1
         return np.asarray(out, dtype=np.int32)
 
     def free(self, blocks: Sequence[int]) -> None:
+        """Strict single-owner free: every block must have exactly one holder.
+        Freeing a shared block (refcount > 1) raises — use ``release`` for
+        refcounted holders."""
         lst = blocks.tolist() if isinstance(blocks, np.ndarray) else list(blocks)
         if not lst:
             return
         state = self._state
+        refs = self._refs
         num = self.num_blocks
         i = 0
         try:
             for i, b in enumerate(lst):
                 if b < 0 or b >= num or state[b]:  # bitmap catches in-call dupes too
                     raise ValueError(f"bad free of block {b}")
+                if refs[b] != 1:
+                    raise ValueError(
+                        f"free of shared block {b} (refcount {refs[b]}); "
+                        "holders must release, not free")
                 state[b] = 1
+                refs[b] = 0
         except ValueError:
             for b in lst[:i]:  # roll back: a bad call leaves state unchanged
                 state[b] = 0
+                refs[b] = 1
             raise
         self._free_stack.extend(lst)
+
+    def share(self, blocks: Sequence[int]) -> None:
+        """Add one holder to each allocated block (batch-validated: a bad id
+        or a free block anywhere in the call leaves every refcount
+        unchanged)."""
+        lst = blocks.tolist() if isinstance(blocks, np.ndarray) else list(blocks)
+        if not lst:
+            return
+        refs = self._refs
+        num = self.num_blocks
+        i = 0
+        try:
+            for i, b in enumerate(lst):
+                if b < 0 or b >= num or refs[b] < 1:
+                    raise ValueError(f"share of unallocated block {b}")
+                refs[b] += 1
+        except ValueError:
+            for b in lst[:i]:
+                refs[b] -= 1
+            raise
+        return None
+
+    def release(self, blocks: Sequence[int]) -> int:
+        """Drop one holder from each block; blocks reaching zero holders
+        return to the free stack. Releasing a free block (double release)
+        raises, with full rollback. Returns how many blocks became free."""
+        lst = blocks.tolist() if isinstance(blocks, np.ndarray) else list(blocks)
+        if not lst:
+            return 0
+        state = self._state
+        refs = self._refs
+        num = self.num_blocks
+        freed: List[int] = []
+        i = 0
+        try:
+            for i, b in enumerate(lst):
+                if b < 0 or b >= num or refs[b] < 1:
+                    raise ValueError(f"double release of block {b}")
+                refs[b] -= 1
+                if refs[b] == 0:
+                    state[b] = 1
+                    freed.append(b)
+        except ValueError:
+            for b in lst[:i]:  # roll back refcounts AND the bitmap
+                if refs[b] == 0:
+                    state[b] = 0
+                refs[b] += 1
+            raise
+        self._free_stack.extend(freed)
+        return len(freed)
 
 
 @dataclasses.dataclass
@@ -190,10 +266,211 @@ class StateManager:
         return seq
 
     def flush(self, uid: int) -> None:
-        """Release a finished sequence (reference ``flush_uid`` engine_v2.py)."""
+        """Release a finished sequence (reference ``flush_uid`` engine_v2.py).
+        Refcount-aware: blocks the prefix cache still holds stay allocated
+        (the sequence drops its reference); exclusively-owned blocks return
+        to the free stack — identical to ``free`` when nothing is shared."""
         seq = self._seqs.pop(uid, None)
         if seq is not None and seq.n_blocks:
-            self.allocator.free(seq.blocks)
+            self.allocator.release(seq.blocks)
+
+
+# --------------------------------------------------------------- prefix cache
+@dataclasses.dataclass
+class PrefixHit:
+    """Result of a prefix-cache lookup against a prompt.
+
+    ``blocks`` are FULL cached blocks covering ``len(blocks) * block_size``
+    leading tokens (already position-aligned: chain keys start at position
+    0, so a hit is only possible for identically positioned content).
+    ``cow_block``/``cow_len`` describe an optional partial hit one block
+    deeper: a cached block whose first ``cow_len`` tokens match the prompt's
+    next tokens — reusable via copy-on-write at the first divergent token.
+    """
+
+    blocks: List[int]
+    cow_block: Optional[int] = None
+    cow_len: int = 0
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.blocks)
+
+
+@dataclasses.dataclass
+class _PrefixEntry:
+    key: bytes
+    block: int
+    tokens: np.ndarray  # the block_size token ids this block's KV encodes
+    parent: bytes  # chain key of the preceding prefix ('' for block 0)
+    content_hash: Optional[str] = None  # blake2b over the quantized pool bytes
+
+
+class PrefixCache:
+    """Content-addressed KV-block reuse over the paged pool (ROADMAP #1b).
+
+    Host-side index: chain-hash of position-aligned token blocks -> pool
+    block id, with the allocator's refcounts making shared blocks safe
+    (the cache itself holds one reference per entry; sequences reusing a
+    block hold their own). Each entry additionally records a blake2b digest
+    of the block's *quantized pool bytes* (values + scale pages together —
+    exactly the PR-10 layout) at insert time: the cached artifact IS the
+    quantized bytes attention reads, so a hit is never re-quantized and the
+    digest pins that sharing/COW/eviction never corrupted the stored bytes
+    (asserted by the correctness tests and the nightly smoke).
+
+    LRU eviction: entries release their block reference in LRU order when
+    ``capacity_blocks`` is exceeded or the engine needs blocks back
+    (``evict_one`` under admission pressure). Releasing while a live
+    sequence still references the block only drops the cache's hold — the
+    block returns to the free stack at refcount zero.
+    """
+
+    def __init__(self, allocator: BlockedAllocator, block_size: int,
+                 capacity_blocks: Optional[int] = None):
+        from collections import OrderedDict
+
+        self.allocator = allocator
+        self.block_size = block_size
+        self.capacity_blocks = capacity_blocks
+        self._entries: "OrderedDict[bytes, _PrefixEntry]" = OrderedDict()
+        self._children: Dict[bytes, List[bytes]] = {}
+        # accounting for serving/prefix_* metrics
+        self.lookups = 0
+        self.hits = 0  # lookups that reused >= 1 token
+        self.hit_tokens = 0  # tokens served from cache (incl. COW prefixes)
+        self.insertions = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @staticmethod
+    def _chain_key(parent: bytes, tokens: np.ndarray) -> bytes:
+        import hashlib
+
+        h = hashlib.blake2b(parent, digest_size=16)
+        h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+        return h.digest()
+
+    # ----------------------------------------------------------------- lookup
+    def match(self, tokens: np.ndarray) -> PrefixHit:
+        """Longest cached prefix of ``tokens``, full blocks first, then an
+        optional COW partial block. Reuse is capped at ``len(tokens) - 1``
+        so at least one token remains to prefill (the step that samples the
+        first new token needs a non-empty row)."""
+        bs = self.block_size
+        usable = max(len(tokens) - 1, 0)
+        key = b""
+        blocks: List[int] = []
+        pos = 0
+        while pos + bs <= usable:
+            k = self._chain_key(key, tokens[pos: pos + bs])
+            e = self._entries.get(k)
+            if e is None:
+                break
+            self._entries.move_to_end(k)  # LRU touch
+            blocks.append(e.block)
+            key = k
+            pos += bs
+        # partial hit one block deeper: longest common prefix against any
+        # cached child of the matched chain -> COW at the divergent token
+        cow_block, cow_len, cow_key = None, 0, None
+        rest = np.asarray(tokens[pos:usable], np.int32)
+        if len(rest) > 0:
+            for ck in self._children.get(key, ()):
+                e = self._entries.get(ck)
+                if e is None:
+                    continue
+                n = min(len(rest), bs)
+                lcp = int((e.tokens[:n] == rest[:n]).cumprod().sum())
+                if lcp > cow_len and lcp < bs:
+                    cow_block, cow_len, cow_key = e.block, lcp, ck
+            if cow_key is not None:
+                self._entries.move_to_end(cow_key)
+        return PrefixHit(blocks=blocks, cow_block=cow_block, cow_len=cow_len)
+
+    def record(self, hit: Optional[PrefixHit]) -> None:
+        """Count one ADMISSION's lookup outcome. Deliberately separate from
+        ``match``: admission may re-probe the same stalled request every
+        scheduling round while the pool is full — counting at match time
+        would let one stalled request skew ``serving/prefix_hit_rate`` by
+        its retry count."""
+        self.lookups += 1
+        if hit is not None and (hit.blocks or hit.cow_len):
+            self.hits += 1
+            self.hit_tokens += len(hit.blocks) * self.block_size + hit.cow_len
+
+    @property
+    def hit_rate(self) -> float:
+        """Fraction of admissions that reused at least one cached token."""
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    # ----------------------------------------------------------------- insert
+    def insert(self, tokens: np.ndarray, blocks: Sequence[int],
+               hasher=None) -> int:
+        """Index the FULL blocks of ``tokens`` (``blocks[i]`` holds tokens
+        ``[i*bs, (i+1)*bs)``). Already-cached prefixes are skipped; each new
+        entry takes one ``share`` reference on its block and records
+        ``hasher(block_id)`` (the quantized-bytes digest) when a hasher is
+        given — called only for NEW entries, so re-inserting a warm prefix
+        costs no device fetch. Returns the number of entries added."""
+        bs = self.block_size
+        n_full = min(len(tokens) // bs, len(blocks))
+        key = b""
+        added = 0
+        for i in range(n_full):
+            chunk = np.asarray(tokens[i * bs: (i + 1) * bs], np.int32)
+            k = self._chain_key(key, chunk)
+            if k not in self._entries:
+                if self.capacity_blocks is not None:
+                    while (len(self._entries) >= self.capacity_blocks
+                           and self.evict_one()):
+                        pass
+                    if len(self._entries) >= self.capacity_blocks:
+                        break
+                self.allocator.share([int(blocks[i])])
+                self._entries[k] = _PrefixEntry(
+                    key=k, block=int(blocks[i]), tokens=chunk.copy(),
+                    parent=key,
+                    content_hash=hasher(int(blocks[i])) if hasher else None)
+                self._children.setdefault(key, []).append(k)
+                self.insertions += 1
+                added += 1
+            else:
+                self._entries.move_to_end(k)
+            key = k
+        return added
+
+    def entry_for_block(self, block: int) -> Optional[_PrefixEntry]:
+        for e in self._entries.values():
+            if e.block == block:
+                return e
+        return None
+
+    # --------------------------------------------------------------- eviction
+    def evict_one(self) -> bool:
+        """Release the LRU entry's block reference. Returns False when
+        empty."""
+        if not self._entries:
+            return False
+        key, e = next(iter(self._entries.items()))
+        del self._entries[key]
+        sibs = self._children.get(e.parent)
+        if sibs is not None:
+            try:
+                sibs.remove(key)
+            except ValueError:
+                pass
+            if not sibs:
+                del self._children[e.parent]
+        self.allocator.release([e.block])
+        self.evictions += 1
+        return True
+
+    def clear(self) -> None:
+        while self.evict_one():
+            pass
 
 
 @dataclasses.dataclass

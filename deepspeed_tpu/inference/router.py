@@ -1,0 +1,498 @@
+"""SLO-aware continuous-batching router over N engine_v2 replicas.
+
+The serving tier's front end (ROADMAP open item 1a): one process-level
+scheduler dispatching requests over N :class:`InferenceEngineV2` replicas.
+The engines' serving loop (``generate``) stays the single-replica path; the
+router drives the same primitives directly — ``can_schedule`` admission,
+fused ``_put_sample`` prefill, ``decode_chain``/``decode_spec_chain`` — so
+every fast-path invariant (one dispatch + one host sync per K tokens,
+on-device sampling, prefix-cache reuse, speculative chains) holds per
+replica unchanged.
+
+Scheduling model (single-threaded, chain-granular):
+
+  - **Assignment**: an arrived request is bound to the least-loaded replica.
+    The load signal is the same per-replica ``serving/queue_depth`` /
+    ``serving/goodput`` state the PR-5 gauges expose — assigned-but-waiting
+    plus active rows, discounted by the replica's rolling goodput (a replica
+    missing its SLO window attracts less new load).
+  - **SLO-aware admission** (``serving_slo`` config block): before a prefill
+    is dispatched, the request's projected TTFT — wait so far plus the
+    replica's EMA time-to-first-token — is checked against
+    ``ttft_ms * admission_ttft_factor``. ``admission="shed"`` rejects a
+    request that can no longer make its budget (it returns ``None`` and
+    stops consuming queue capacity that on-budget requests could use);
+    ``"defer"`` holds it queued while any replica could still make the
+    budget and sheds only when none can. Shedding happens strictly BEFORE
+    admission: an admitted request is never dropped (the nightly router
+    smoke gates on exactly that).
+  - **Replica-affine re-admission**: a preemption at a chain boundary
+    re-queues the request pinned to its replica, so its prefix-cache
+    blocks there (PR-12 content-hash reuse) make the re-prefill nearly
+    free — the preempted context re-enters through the cache instead of
+    recomputing.
+
+Observability: per-replica ``LifecycleTracker``s (labels ``{"replica": i}``)
+feed the standard ``serving/*`` SLO metrics per replica, ``router/*``
+counters/gauges cover the router's own decisions, and each replica gets its
+own Perfetto track with one slice per dispatched program.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.inference.lifecycle import LifecycleTracker
+from deepspeed_tpu.telemetry import get_tracer
+
+# virtual Perfetto track ids for replica tracks (request tracks live at
+# lifecycle.TRACK_BASE = 0x5E51_0000; replicas get their own range)
+REPLICA_TRACK_BASE = 0x5E52_0000
+
+
+class _Replica:
+    """Router-side view of one engine replica."""
+
+    def __init__(self, index: int, engine: InferenceEngineV2):
+        self.index = index
+        self.engine = engine
+        self.active: Dict[int, int] = {}  # uid -> rid
+        self.order: Dict[int, None] = {}  # admission order (insertion-ordered)
+        self.assigned: deque = deque()  # rids bound here, not yet admitted
+        self.tracker: Optional[LifecycleTracker] = None
+        # host-observed EMAs (seconds): the admission gate's TTFT projection
+        self.prefill_ema = 0.0
+        self.chain_ema = 0.0
+        self.dispatches = 0
+
+    def load(self) -> float:
+        """Queue-depth-based load score, goodput-discounted: replicas
+        missing their SLO window attract less new load."""
+        depth = len(self.assigned) + len(self.active)
+        goodput = 1.0
+        if self.tracker is not None and self.tracker._emit:
+            g = self.tracker._g_goodput.value
+            if g is not None and self.tracker._win_slo:
+                goodput = float(g)
+        return depth + (1.0 - goodput)
+
+    def ema(self, attr: str, value: float, alpha: float = 0.3) -> None:
+        cur = getattr(self, attr)
+        setattr(self, attr, value if cur == 0.0 else (1 - alpha) * cur + alpha * value)
+
+
+class ServingRouter:
+    """Continuous-batching front end over N engine replicas.
+
+    ``engines`` must share model/config semantics (the router assumes any
+    replica can serve any request). ``slo`` defaults to the first engine's
+    ``serving_slo`` block; ``clock`` is injectable so the admission gate is
+    testable against a fake clock.
+    """
+
+    def __init__(self, engines: Sequence[InferenceEngineV2], slo=None,
+                 clock=time.perf_counter):
+        if not engines:
+            raise ValueError("ServingRouter needs at least one engine replica")
+        self.replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        self.slo = slo if slo is not None else engines[0].config.serving_slo
+        self._clock = clock
+        self._tracer = get_tracer()
+        # decision accounting (always on — the smoke and tests read these)
+        self.shed_count = 0
+        self.deferred_count = 0
+        self.preemptions = 0
+        self.affine_readmits = 0
+
+    @classmethod
+    def build(cls, model_config, params, engine_config=None, replicas: int = 2,
+              **kw) -> "ServingRouter":
+        """N replicas from one (config, params) — each gets its own KV pool
+        and scheduler state; params are shared (same host arrays)."""
+        engines = [InferenceEngineV2(model_config, params, dict(engine_config or {}))
+                   for _ in range(replicas)]
+        return cls(engines, **kw)
+
+    # ------------------------------------------------------------ admission
+    def _projected_ttft_s(self, waited_s: float, rep: _Replica) -> float:
+        """Wait so far + the replica's estimated time to first token: one
+        prefill dispatch — which the scheduling round runs BEFORE the decode
+        chains, so a replica with admission capacity prefills immediately; a
+        full replica adds one chain boundary (its earliest slot)."""
+        est = rep.prefill_ema
+        if len(rep.active) >= rep.engine.config.max_seqs:
+            est += rep.chain_ema
+        return waited_s + est
+
+    def _admission_decision(self, waited_s: float, rep: _Replica) -> str:
+        """'admit' | 'defer' | 'shed' for a request that has waited
+        ``waited_s`` and would prefill on ``rep`` next. Pure function of the
+        SLO block + replica EMAs — pinned by the fake-clock tests."""
+        slo = self.slo
+        mode = getattr(slo, "admission", "none") if slo is not None else "none"
+        ttft_ms = getattr(slo, "ttft_ms", None) if slo is not None else None
+        if mode == "none" or ttft_ms is None:
+            return "admit"
+        budget_s = ttft_ms * getattr(slo, "admission_ttft_factor", 1.0) / 1e3
+        if self._projected_ttft_s(waited_s, rep) <= budget_s:
+            return "admit"
+        if mode == "defer":
+            # hold while ANY replica could still make the budget; shed only
+            # when the wait alone has already blown it everywhere
+            if any(self._projected_ttft_s(waited_s, r) <= budget_s
+                   for r in self.replicas):
+                return "defer"
+            return "shed" if waited_s > budget_s else "defer"
+        return "shed"
+
+    def _least_loaded(self) -> _Replica:
+        return min(self.replicas, key=lambda r: (r.load(), r.index))
+
+    # ---------------------------------------------------------------- serve
+    def serve(
+        self,
+        prompts: Sequence[np.ndarray],
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+        do_sample: bool = False,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        arrival_times: Optional[Sequence[float]] = None,
+    ) -> List[Optional[np.ndarray]]:
+        """Route ``prompts`` across the replicas; returns one output per
+        prompt, ``None`` for requests the admission gate shed. The loop is
+        the engine's ``generate`` lifted one level: assignment + SLO gate,
+        then per replica the admit/prefill/chain round — each replica's
+        device work is still one fused program per phase."""
+        prompts = [np.asarray(p, np.int32) for p in prompts]
+        n_req = len(prompts)
+        spec = self.replicas[0].engine.config.spec_decode > 0
+        if spec and do_sample:
+            raise ValueError(
+                "spec_decode is greedy-only (verify-and-accept compares "
+                "argmax targets); disable do_sample or set spec_decode=0")
+        # the same feasibility guards engine.generate applies — a prompt no
+        # replica can ever serve must raise here, not stall the router loop
+        for rep in self.replicas:
+            eng = rep.engine
+            pool_tokens = eng.num_kv_blocks * eng.config.kv_block_size
+            margin = eng.config.spec_decode
+            for i, p in enumerate(prompts):
+                if len(p) + max_new_tokens + margin > eng.max_seq_len:
+                    raise ValueError(
+                        f"prompt {i} ({len(p)} tokens) + max_new_tokens="
+                        f"{max_new_tokens} (+{margin} speculative slack) "
+                        f"exceeds replica {rep.index} max_seq_len={eng.max_seq_len}")
+                if len(p) + max_new_tokens + margin > pool_tokens:
+                    raise ValueError(
+                        f"prompt {i} ({len(p)} tokens) + max_new_tokens="
+                        f"{max_new_tokens} cannot ever fit replica "
+                        f"{rep.index}'s KV pool ({pool_tokens} slots)")
+        sample_kw = (("do_sample", do_sample), ("temperature", temperature),
+                     ("top_k", top_k), ("top_p", top_p))
+        t_start = self._clock()
+        if arrival_times is not None and len(arrival_times) != n_req:
+            raise ValueError(
+                f"arrival_times has {len(arrival_times)} entries for {n_req} prompts")
+        arr = [float(a) for a in arrival_times] if arrival_times is not None \
+            else [0.0] * n_req
+        pending = deque(sorted(range(n_req), key=lambda i: arr[i]))
+        affinity: List[Optional[int]] = [None] * n_req
+        admitted_once: set = set()  # rids that ever dispatched a prefill
+        gen: Dict[int, List[int]] = {i: [] for i in range(n_req)}
+        outputs: Dict[int, Optional[np.ndarray]] = {}
+        # committed replicated key, like engine.generate: an uncommitted
+        # PRNGKey makes every replica's second admission wave recompile its
+        # prefill program mid-burst (jit caches on committed-ness)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rng = jax.device_put(jax.random.PRNGKey(seed),
+                             NamedSharding(self.replicas[0].engine.mesh, P()))
+        next_uid = 0
+        tr = self._tracer
+        registry = tr.registry if tr.enabled else None
+
+        if registry is not None:
+            c_requests = registry.counter("router/requests")
+            c_shed = registry.counter("router/shed_requests")
+            c_defer = registry.counter("router/deferred")
+            c_preempt = registry.counter("router/preemptions")
+            c_affine = registry.counter("router/affine_readmissions")
+            g_depth = [registry.gauge("router/replica_queue_depth",
+                                      replica=r.index) for r in self.replicas]
+            g_active = [registry.gauge("router/replica_active", replica=r.index)
+                        for r in self.replicas]
+            c_disp = [registry.counter("router/dispatches", replica=r.index)
+                      for r in self.replicas]
+            c_requests.add(float(n_req))
+            for r in self.replicas:
+                tr.name_track(REPLICA_TRACK_BASE + r.index, f"replica {r.index}")
+        for r in self.replicas:
+            if tr.enabled or r.engine._recorder is not None:
+                r.tracker = LifecycleTracker(
+                    tr, slo=self.slo, clock=self._clock,
+                    labels={"k": r.engine.config.decode_chain,
+                            "replica": r.index},
+                    recorder=r.engine._recorder)
+
+        def context(idx: int) -> np.ndarray:
+            return np.concatenate([prompts[idx], np.asarray(gen[idx], np.int32)])
+
+        def replica_span(rep: _Replica, name: str, t0: float, t1: float) -> None:
+            if registry is None:
+                return
+            tr.append_events([{
+                "kind": "span", "name": name, "cat": "router",
+                "ts": t0 - tr.origin(), "dur": max(t1 - t0, 0.0),
+                "tid": REPLICA_TRACK_BASE + rep.index,
+                "args": {"replica": rep.index}}])
+
+        def accept(rep: _Replica, u: int, t: int) -> None:
+            idx = rep.active[u]
+            gen[idx].append(int(t))
+            if len(gen[idx]) >= max_new_tokens or (
+                    eos_token_id is not None and int(t) == eos_token_id):
+                outputs[idx] = np.asarray(gen[idx], np.int32)
+                rep.active.pop(u)
+                rep.order.pop(u)
+                rep.engine.flush(u)
+                if rep.tracker is not None:
+                    rep.tracker.finish(idx)
+
+        def shed(idx: int, rep: Optional[_Replica]) -> None:
+            outputs[idx] = None
+            self.shed_count += 1
+            if registry is not None:
+                c_shed.add(1.0)
+            if rep is not None and rep.tracker is not None:
+                # an arrived-but-never-served request still counts against
+                # the replica's request totals (goodput's denominator is
+                # finished requests only; shed ones are reported separately)
+                rep.tracker.arrive(idx, now=t_start + arr[idx])
+
+        while pending or any(r.assigned or r.active for r in self.replicas):
+            now = self._clock()
+            did_work = False
+
+            # ---- phase 1: bind arrived requests to the least-loaded
+            # replica (preempted requests keep their affinity — their cached
+            # prefix blocks live there)
+            while pending and now - t_start >= arr[pending[0]]:
+                idx = pending.popleft()
+                if affinity[idx] is not None:
+                    rep = self.replicas[affinity[idx]]
+                    self.affine_readmits += 1
+                    if registry is not None:
+                        c_affine.add(1.0)
+                else:
+                    rep = self._least_loaded()
+                    affinity[idx] = rep.index
+                rep.assigned.append(idx)
+
+            # ---- phase 2: per replica, SLO-gated admission + fused prefill
+            for rep in self.replicas:
+                eng = rep.engine
+                adm_uids: List[int] = []
+                adm_tokens: List[np.ndarray] = []
+                adm_counts: List[int] = []
+                adm_full: List[np.ndarray] = []
+                decoding = list(rep.active.keys())
+                deferred: List[int] = []
+                while rep.assigned and len(rep.active) < eng.config.max_seqs:
+                    idx = rep.assigned[0]
+                    waited = now - (t_start + arr[idx])
+                    # the SLO gate applies to FIRST admissions only: a
+                    # preempted request was already admitted and holds
+                    # generated tokens — dropping it now would violate the
+                    # "an admitted request is never dropped" invariant (it
+                    # re-admits unconditionally, on its affine replica)
+                    decision = ("admit" if idx in admitted_once
+                                else self._admission_decision(waited, rep))
+                    if decision == "shed":
+                        rep.assigned.popleft()
+                        shed(idx, rep)
+                        continue
+                    if decision == "defer":
+                        # migrate toward the replica the decision says could
+                        # still make the budget — a never-admitted request
+                        # has no KV and no cached prefix to lose by rebinding
+                        rep.assigned.popleft()
+                        best = min(self.replicas,
+                                   key=lambda r: self._projected_ttft_s(waited, r))
+                        if best is not rep:
+                            affinity[idx] = best.index
+                            best.assigned.append(idx)
+                        else:
+                            deferred.append(idx)
+                        self.deferred_count += 1
+                        if registry is not None:
+                            c_defer.add(1.0)
+                        continue
+                    cand = context(idx)
+                    suffix = eng.try_admit(next_uid, cand, decoding + adm_uids,
+                                           [1] * len(decoding) + adm_counts)
+                    if suffix is None:
+                        break
+                    rep.assigned.popleft()
+                    admitted_once.add(idx)
+                    adm_uids.append(next_uid)
+                    adm_tokens.append(suffix)
+                    adm_counts.append(len(suffix))
+                    adm_full.append(cand)
+                    if rep.tracker is not None:
+                        rep.tracker.arrive(idx, now=t_start + arr[idx])
+                        rep.tracker.admit(idx, next_uid)
+                    rep.active[next_uid] = idx
+                    rep.order[next_uid] = None
+                    next_uid += 1
+                rep.assigned.extend(deferred)
+                if adm_uids:
+                    did_work = True
+                    adm_rids = [rep.active[u] for u in adm_uids]
+                    t0 = self._clock()
+                    toks, rng = eng._put_sample(
+                        adm_uids, adm_tokens, rng, sample_kw,
+                        tracker=rep.tracker, rids=adm_rids)
+                    t1 = self._clock()
+                    rep.ema("prefill_ema", t1 - t0)
+                    rep.dispatches += 1
+                    replica_span(rep, "prefill", t0, t1)
+                    if registry is not None:
+                        c_disp[rep.index].add(1.0)
+                    if eng.prefix_cache is not None:
+                        for u, full in zip(adm_uids, adm_full):
+                            eng._insert_prefix(u, full)
+                    if rep.tracker is not None:
+                        rep.tracker.emitted_batch(adm_rids, (1,) * len(adm_rids))
+                    for u, t in zip(adm_uids, toks):
+                        accept(rep, u, t)
+
+            # ---- phase 3: per replica, one chained decode over its rows
+            for rep in self.replicas:
+                if not rep.active:
+                    continue
+                eng = rep.engine
+                did_work = True
+                uids = list(rep.active.keys())
+                budgets = [max_new_tokens - len(gen[rep.active[u]]) for u in uids]
+                k = eng.config.decode_chain
+                while True:
+                    while k > 1 and not eng._can_schedule_evicting(
+                            uids, eng.chain_window(budgets, k)):
+                        k -= 1
+                    if eng._can_schedule_evicting(uids, eng.chain_window(budgets, k)):
+                        break
+                    # preempt the youngest row; it re-queues pinned to THIS
+                    # replica so its cached prefix blocks stay useful
+                    victim = next(reversed(rep.order))
+                    del rep.order[victim]
+                    i = uids.index(victim)
+                    uids.pop(i)
+                    budgets.pop(i)
+                    idx = rep.active.pop(victim)
+                    eng.flush(victim)
+                    pending.appendleft(idx)
+                    self.preemptions += 1
+                    if rep.tracker is not None:
+                        rep.tracker.preempt(idx)
+                    if registry is not None:
+                        c_preempt.add(1.0)
+                    if not uids:
+                        raise RuntimeError(
+                            f"replica {rep.index}: KV pool too small for a "
+                            f"single sequence ({eng.num_kv_blocks} blocks)")
+                    k = eng.config.decode_chain
+                last = [gen[rep.active[u]][-1] for u in uids]
+                chain_rids = [rep.active[u] for u in uids]
+                t0 = self._clock()
+                if spec:
+                    histories = [context(rep.active[u]) for u in uids]
+                    out, emitted, rng = eng.decode_spec_chain(
+                        uids, last, budgets, k, rng, histories,
+                        eos_id=eos_token_id, tracker=rep.tracker,
+                        rids=chain_rids)
+                else:
+                    out, emitted, rng = eng.decode_chain(
+                        uids, last, budgets, k, rng, eos_id=eos_token_id,
+                        sample_kw=sample_kw, tracker=rep.tracker,
+                        rids=chain_rids)
+                t1 = self._clock()
+                rep.ema("chain_ema", t1 - t0)
+                rep.dispatches += 1
+                replica_span(rep, "chain", t0, t1)
+                eng.tokens_decoded += int(emitted.sum())
+                if rep.tracker is not None:
+                    rep.tracker.emitted_batch(chain_rids, emitted, now=t1)
+                    rep.tracker.sample_gauges(now=t1)
+                if registry is not None:
+                    c_disp[rep.index].add(1.0)
+                    g_depth[rep.index].set(float(len(rep.assigned)))
+                    g_active[rep.index].set(float(len(rep.active)))
+                for i, u in enumerate(uids):
+                    for t in out[i, : emitted[i]]:
+                        if u in rep.active:
+                            accept(rep, u, t)
+
+            if not did_work:
+                if pending:
+                    wait = t_start + arr[pending[0]] - self._clock()
+                    if wait > 0:  # open-loop: idle until the next arrival
+                        time.sleep(min(wait, 0.02))
+                    continue
+                if any(r.assigned for r in self.replicas):
+                    if not any(r.active for r in self.replicas):
+                        # nothing decoding anywhere, yet the assigned
+                        # requests could not be admitted: with the serve()
+                        # feasibility guards above this means deferred
+                        # requests waiting out their admission gate — let
+                        # wall time advance instead of spinning hot (they
+                        # admit or shed as `waited` grows)
+                        time.sleep(0.001)
+                    continue  # active rows elsewhere will free capacity
+        for rep in self.replicas:
+            if rep.tracker is not None:
+                rep.tracker.sample_gauges()
+        if registry is not None:
+            for rep in self.replicas:
+                g_depth[rep.index].set(0.0)
+                g_active[rep.index].set(0.0)
+        return [outputs.get(i) for i in range(n_req)]
+
+    def reset_estimates(self) -> None:
+        """Zero the per-replica latency EMAs. Call after a warmup pass: the
+        first dispatch of each program carries its XLA compile time, and an
+        EMA seeded with compile latency makes the admission gate project
+        every cold request over budget (it would shed the whole burst)."""
+        for rep in self.replicas:
+            rep.prefill_ema = 0.0
+            rep.chain_ema = 0.0
+
+    # ------------------------------------------------------------- reporting
+    def goodput(self) -> Tuple[int, int]:
+        """(slo_met, slo_missed) summed over the replica trackers."""
+        met = missed = 0
+        for rep in self.replicas:
+            t = rep.tracker
+            if t is None or not t._emit:
+                continue
+            met += int(t._c_slo_met.value)
+            missed += int(t._c_slo_missed.value)
+        return met, missed
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "replicas": len(self.replicas),
+            "shed": self.shed_count,
+            "deferred": self.deferred_count,
+            "preemptions": self.preemptions,
+            "affine_readmissions": self.affine_readmits,
+            "dispatches": [r.dispatches for r in self.replicas],
+        }

@@ -18,6 +18,15 @@ import jax
 import jax.numpy as jnp
 
 
+def greedy_tokens(logits: jax.Array) -> jax.Array:
+    """logits [..., V] -> argmax ids (int32), any leading dims. The single
+    definition of "the target's greedy choice" — shared by plain sampling
+    and the speculative verify-and-accept step (``paged.
+    ragged_spec_decode_chain``), so acceptance compares against exactly the
+    tokens the plain chain would have emitted."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
 def sample_logits(
     logits: jax.Array,
     rng: jax.Array,
@@ -29,7 +38,7 @@ def sample_logits(
 ) -> jax.Array:
     """logits [B, V] -> token ids [B] (int32)."""
     if not do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return greedy_tokens(logits)
 
     logits = logits.astype(jnp.float32)
     if temperature != 1.0:

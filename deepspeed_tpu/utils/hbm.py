@@ -68,6 +68,18 @@ def kv_blocks_for_bytes(pool_bytes: int, num_layers: int, block_size: int,
     return max(int(pool_bytes) // per_block, 1)
 
 
+def prefix_cache_capacity_blocks(num_blocks: int, fraction: float) -> int:
+    """Cache-aware pool sizing (ISSUE 12): how many pool blocks the prefix
+    cache may hold references to. The cap guarantees live sequences always
+    have at least ``(1 - fraction)`` of the pool available after LRU
+    eviction, and because cached blocks store QUANTIZED bytes, the same
+    ``fraction`` of an int8 pool indexes ~1.9x the prefix tokens of a bf16
+    pool at fixed HBM (the PR-10 byte shrink compounding with reuse)."""
+    if not 0.0 <= fraction <= 1.0:
+        raise ValueError(f"prefix-cache fraction must be in [0, 1], got {fraction}")
+    return int(num_blocks * fraction)
+
+
 def record_calibration(
     estimate_bytes: int,
     actual_peak_bytes: Optional[int],

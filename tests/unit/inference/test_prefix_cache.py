@@ -1,0 +1,268 @@
+"""Content-hash prefix cache over the paged (quantized) KV pool (ISSUE 12).
+
+Contract under test:
+  - cache-hit admission is token-identical to cold prefill (greedy), on the
+    plain bf16-storage pool AND the int8 quantized pool — the cached
+    artifact is the quantized block bytes, never re-quantized
+  - the insert-time blake2b over the pool bytes (values + scale pages) still
+    matches at hit time: sharing, COW, and eviction never corrupt a cached
+    block
+  - COW divergence: a prompt sharing only part of a cached block clones it
+    at the first divergent token; the source block's bytes are untouched
+  - eviction under admission pressure: LRU entries release their blocks,
+    live traffic proceeds, and a re-run of the evicted prompt re-prefills
+    to the same output
+  - allocator refcount bookkeeping: blocks return to the free stack only
+    when BOTH the cache and every sequence have released them
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import InferenceEngineV2
+from deepspeed_tpu.inference.ragged import BlockedAllocator, PrefixCache
+
+from .test_inference_v2 import make_model
+
+
+def _engine(cfg, params, **over):
+    base = {"dtype": "fp32", "kv_block_size": 4, "num_kv_blocks": 64,
+            "chunk_bucket": 8, "decode_chain": 4, "hbm_check": "off",
+            "prefix_cache": True}
+    base.update(over)
+    return InferenceEngineV2(cfg, params, base)
+
+
+# ------------------------------------------------------------ unit: the cache
+def test_prefix_cache_match_insert_roundtrip():
+    a = BlockedAllocator(16)
+    pc = PrefixCache(a, block_size=4)
+    toks = np.arange(11, dtype=np.int32)  # 2 full blocks + 3 tail tokens
+    blocks = a.allocate(3)
+    pc.insert(toks, blocks)
+    assert len(pc) == 2  # only FULL blocks are indexed
+    assert a.refcount(int(blocks[0])) == 2  # owner + cache
+
+    hit = pc.match(toks)
+    assert hit.blocks == [int(blocks[0]), int(blocks[1])]
+    # a diverging prompt matches only the shared leading blocks
+    other = toks.copy()
+    other[5] = 99
+    hit2 = pc.match(other)
+    assert hit2.blocks == [int(blocks[0])]
+    # ...and the partially-matching second block is offered for COW with
+    # the divergence point (token 5 = index 1 into the block)
+    assert hit2.cow_block == int(blocks[1]) and hit2.cow_len == 1
+    # reuse never covers the full prompt: >= 1 token must remain to prefill
+    exact = np.arange(8, dtype=np.int32)
+    hit3 = pc.match(exact)
+    assert hit3.n_blocks == 1  # block 2 would cover tokens [4, 8) == len-0
+
+
+def test_prefix_cache_lru_eviction_and_refcounts():
+    a = BlockedAllocator(16)
+    pc = PrefixCache(a, block_size=4, capacity_blocks=2)
+    t1 = np.arange(8, dtype=np.int32)
+    b1 = a.allocate(2)
+    pc.insert(t1, b1)
+    assert len(pc) == 2
+    t2 = np.arange(100, 108, dtype=np.int32)
+    b2 = a.allocate(2)
+    pc.insert(t2, b2)  # capacity 2 -> the two t1 entries evict (LRU)
+    assert len(pc) == 2 and pc.evictions == 2
+    assert a.refcount(int(b1[0])) == 1  # cache reference gone, owner remains
+    assert pc.match(t1).n_blocks == 0 and pc.match(t2).n_blocks >= 1
+    # releasing the owners returns everything cache-free to the stack
+    a.release(b1)
+    pc.clear()
+    a.release(b2)
+    assert a.free_blocks == 16
+
+
+# ----------------------------------------------------- allocator share/release
+def test_allocator_share_release_validation_and_rollback():
+    a = BlockedAllocator(8)
+    got = a.allocate(3)
+    a.share(got)  # refcount 2 everywhere
+    with pytest.raises(ValueError, match="shared"):
+        a.free(got)  # free-while-shared refuses
+    assert a.free_blocks == 5  # rollback left the stack untouched
+    a.release(got)
+    a.free(got)  # back to single-owner: strict free works
+    assert a.free_blocks == 8
+    with pytest.raises(ValueError, match="double release"):
+        a.release([int(got[0])])
+    with pytest.raises(ValueError, match="unallocated"):
+        a.share([int(got[0])])
+    # batch rollback: one bad id in a share/release leaves ALL counts intact
+    live = a.allocate(2)
+    with pytest.raises(ValueError):
+        a.share([int(live[0]), 999])
+    assert a.refcount(int(live[0])) == 1
+    a.share(live)
+    with pytest.raises(ValueError):
+        a.release([int(live[0]), int(live[1]), int(live[0]), int(live[0]), 7])
+    assert a.refcount(int(live[0])) == 2 and a.refcount(int(live[1])) == 2
+
+
+# ------------------------------------------------------- engine: hit parity
+@pytest.mark.parametrize("kvd", ["bf16", "int8"])
+def test_cache_hit_greedy_identical_to_cold_prefill(kvd):
+    """The acceptance contract: a warm-cache admission produces exactly the
+    cold-prefill greedy tokens, for the plain and the quantized pool."""
+    cfg, _, params = make_model()
+    rng = np.random.RandomState(0)
+    shared = rng.randint(0, cfg.vocab_size, (12,))  # 3 full blocks at bs=4
+    prompts = [np.concatenate([shared, rng.randint(0, cfg.vocab_size, (n,))])
+               for n in (3, 5, 2)]
+
+    cold = _engine(cfg, params, prefix_cache=False, kv_cache_dtype=kvd
+                   ).generate(prompts, max_new_tokens=8)
+    eng = _engine(cfg, params, kv_cache_dtype=kvd)
+    warm0 = eng.generate([prompts[0]], max_new_tokens=8)  # populates the cache
+    np.testing.assert_array_equal(warm0[0], cold[0])
+    assert eng.prefill_tokens_cached == 0 and len(eng.prefix_cache) >= 3
+    hits = eng.generate(prompts[1:], max_new_tokens=8)  # shared prefix cached
+    for got, ref in zip(hits, cold[1:]):
+        np.testing.assert_array_equal(got, ref)
+    assert eng.prefill_tokens_cached >= 2 * len(shared)
+    assert eng.prefix_cache.hit_rate > 0
+
+
+def test_content_hash_stable_at_hit_time():
+    """The quantized-bytes digest taken at insert still matches the pool at
+    hit time — sharing never mutated the cached block."""
+    cfg, _, params = make_model()
+    rng = np.random.RandomState(1)
+    shared = rng.randint(0, cfg.vocab_size, (8,))
+    p1 = np.concatenate([shared, rng.randint(0, cfg.vocab_size, (4,))])
+    p2 = np.concatenate([shared, rng.randint(0, cfg.vocab_size, (6,))])
+    eng = _engine(cfg, params, kv_cache_dtype="int8")
+    eng.generate([p1], max_new_tokens=6)
+    entries = list(eng.prefix_cache._entries.values())
+    assert entries and all(e.content_hash for e in entries)
+    before = {e.block: e.content_hash for e in entries}
+    eng.generate([p2], max_new_tokens=6)  # hits the shared blocks
+    assert eng.prefill_tokens_cached >= len(shared) // 2
+    for blk, h in before.items():
+        assert eng._block_content_hash(blk) == h, "cached block bytes changed"
+
+
+def test_cow_divergence_mid_block():
+    """Prompts sharing a strict prefix INSIDE a block: the second admission
+    clones the partially-shared block (copy-on-write at the first divergent
+    token), output matches cold prefill, and the source block's bytes are
+    untouched."""
+    cfg, _, params = make_model()
+    rng = np.random.RandomState(2)
+    p1 = rng.randint(0, cfg.vocab_size, (8,))  # 2 full blocks at bs=4
+    p2 = p1.copy()
+    p2[6] = (p2[6] + 1) % cfg.vocab_size  # diverge inside block 1 (slot 2)
+    cold = _engine(cfg, params, prefix_cache=False).generate(
+        [p2], max_new_tokens=8)[0]
+    eng = _engine(cfg, params)
+    eng.generate([p1], max_new_tokens=8)
+    assert len(eng.prefix_cache) >= 2
+    src_entry = [e for e in eng.prefix_cache._entries.values()][1]
+    src_hash = eng._block_content_hash(src_entry.block)
+    out = eng.generate([p2], max_new_tokens=8)[0]
+    np.testing.assert_array_equal(out, cold)
+    assert eng.cow_copies == 1
+    # block 0 fully reused + 2 tokens of block 1 via COW
+    assert eng.prefix_cache.hit_tokens >= 4 + 2
+    assert eng._block_content_hash(src_entry.block) == src_hash
+
+
+def test_eviction_under_pressure_reprefills():
+    """Pool small enough that cached prefixes must be reclaimed for live
+    traffic: admission evicts LRU entries instead of failing, and a re-run
+    of the evicted prompt (now a cold prefill again) still matches."""
+    cfg, _, params = make_model()
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, cfg.vocab_size, (8,)) for _ in range(3)]
+    refs = _engine(cfg, params, prefix_cache=False, num_kv_blocks=5,
+                   max_seqs=1).generate(prompts, max_new_tokens=8)
+    # 5 blocks x 4 slots: one request peaks at 4 blocks, each finished
+    # prompt leaves 2 cached — the next request's decode window must evict
+    eng = _engine(cfg, params, num_kv_blocks=5, max_seqs=1,
+                  prefix_cache_fraction=1.0)
+    for p, ref in zip(prompts, refs):
+        np.testing.assert_array_equal(
+            eng.generate([p], max_new_tokens=8)[0], ref)
+    assert eng.prefix_cache.evictions > 0
+    # the first prompt's prefix was evicted -> cold again, same output
+    np.testing.assert_array_equal(
+        eng.generate([prompts[0]], max_new_tokens=8)[0], refs[0])
+
+
+def test_hit_pinned_across_admission_eviction():
+    """Admission pressure deep enough that LRU eviction reaches the very
+    entries the incoming request just matched: the pin taken between
+    ``prefix_probe`` and ``_attach_prefix`` keeps those blocks allocated
+    (the cache entries may go, the bytes stay), so the admission completes
+    with the correct output instead of raising mid-serving."""
+    cfg, _, params = make_model()
+    rng = np.random.RandomState(7)
+    a = rng.randint(0, cfg.vocab_size, (8,))
+    a2 = rng.randint(0, cfg.vocab_size, (8,))
+    b = np.concatenate([a, rng.randint(0, cfg.vocab_size, (20,))])  # shares a
+    ref = _engine(cfg, params, prefix_cache=False, num_kv_blocks=8,
+                  max_seqs=1).generate([b], max_new_tokens=4)[0]
+    # pool 8 blocks: after serving a and a2, the cache holds 4 entries and
+    # only 4 blocks are free; admitting b (28 prompt tokens, 2 blocks
+    # matched from a's prefix) needs 5 fresh blocks -> eviction reaches a's
+    # entries — exactly the matched hit
+    eng = _engine(cfg, params, num_kv_blocks=8, max_seqs=1,
+                  prefix_cache_fraction=1.0)
+    eng.generate([a], max_new_tokens=4)
+    eng.generate([a2], max_new_tokens=4)
+    assert len(eng.prefix_cache) == 4 and eng.state.free_blocks == 4
+    out = eng.generate([b], max_new_tokens=4)[0]
+    np.testing.assert_array_equal(out, ref)
+    assert eng.prefix_cache.evictions > 0  # pressure really evicted
+    assert eng.prefill_tokens_cached >= 8  # ...and the hit still served
+    # hit-rate stats count ADMISSIONS, not probe retries: three requests
+    # were admitted, whatever pressure-induced re-probing happened
+    assert eng.prefix_cache.lookups == 3
+    # nothing leaked: free == pool - cache-held
+    assert eng.state.free_blocks == eng.num_kv_blocks - len(eng.prefix_cache)
+
+
+def test_pool_accounting_consistent_after_serving():
+    """After all sequences flush, allocated blocks == cache-held blocks and
+    every refcount is exactly 1 (the cache's own reference)."""
+    cfg, _, params = make_model()
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)) for n in (9, 6, 11)]
+    eng = _engine(cfg, params)
+    eng.generate(prompts, max_new_tokens=6)
+    alloc = eng.state.allocator
+    held = len(eng.prefix_cache)
+    assert alloc.free_blocks == eng.num_kv_blocks - held
+    for e in eng.prefix_cache._entries.values():
+        assert alloc.refcount(e.block) == 1
+    eng.prefix_cache.clear()
+    assert alloc.free_blocks == eng.num_kv_blocks
+
+
+def test_prefix_gauges_land():
+    from deepspeed_tpu.telemetry import get_tracer
+
+    cfg, _, params = make_model()
+    tr = get_tracer()
+    was = tr.enabled
+    tr.configure(enabled=True)
+    tr.reset()
+    try:
+        rng = np.random.RandomState(5)
+        shared = rng.randint(0, cfg.vocab_size, (8,))
+        eng = _engine(cfg, params)
+        eng.generate([np.concatenate([shared, [3]])], max_new_tokens=6)
+        eng.generate([np.concatenate([shared, [5]])], max_new_tokens=6)
+        gauges = tr.registry.gauges()
+        assert gauges["serving/prefix_hit_rate"] > 0
+        assert gauges["serving/prefix_cached_blocks"] >= 2
+    finally:
+        tr.configure(enabled=was)
+        if not was:
+            tr.reset()

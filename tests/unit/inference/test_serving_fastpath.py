@@ -186,6 +186,63 @@ def test_allocator_never_double_allocates():
         a.free([0, 0])  # duplicate ids within one call
 
 
+def test_allocator_share_release_fuzz():
+    """Refcount property fuzz (ISSUE 12): random allocate/free/share/release
+    interleavings conserve blocks, never hand out a held block, and keep the
+    bitmap consistent with the refcounts — double-release and
+    free-while-shared raise without corrupting state."""
+    rng = np.random.RandomState(12)
+    a = BlockedAllocator(64)
+    refs = {}  # block -> holder count we believe it has
+
+    def check():
+        assert a.free_blocks + len(refs) == 64
+        for b, n in refs.items():
+            assert a.refcount(b) == n, f"block {b}: {a.refcount(b)} != {n}"
+
+    for _ in range(3000):
+        op = rng.rand()
+        held = list(refs)
+        if op < 0.35 and a.free_blocks:
+            got = a.allocate(rng.randint(1, min(6, a.free_blocks) + 1))
+            assert not set(got.tolist()) & set(held), "allocated a held block"
+            for b in got.tolist():
+                refs[b] = 1
+        elif op < 0.55 and held:
+            b = held[rng.randint(len(held))]
+            a.share([b])
+            refs[b] += 1
+        elif op < 0.85 and held:
+            b = held[rng.randint(len(held))]
+            a.release([b])
+            refs[b] -= 1
+            if refs[b] == 0:
+                del refs[b]
+        elif held:
+            b = held[rng.randint(len(held))]
+            if refs[b] == 1:
+                a.free([b])
+                del refs[b]
+            else:  # free-while-shared must refuse and change nothing
+                with pytest.raises(ValueError):
+                    a.free([b])
+        check()
+    # double release of anything already free must refuse with rollback
+    if a.free_blocks == 0:  # fuzz may end fully held: release one fully
+        b0 = next(iter(refs))
+        a.release([b0] * refs.pop(b0))
+    free_block = next(b for b in range(64) if b not in refs)
+    with pytest.raises(ValueError):
+        a.release([free_block])
+    with pytest.raises(ValueError):  # ...also mid-batch, rolling back the rest
+        held = list(refs)[:2]
+        a.release(held + [free_block])
+    check()
+    for b in list(refs):
+        a.release([b] * refs.pop(b))
+    assert a.free_blocks == 64
+
+
 def test_staging_buffers_reused_not_reallocated():
     """Steady-state assembly reuses the per-bucket staging arrays."""
     m = StateManager(num_blocks=64, block_size=4, max_seqs=8)

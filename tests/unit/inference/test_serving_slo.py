@@ -107,6 +107,37 @@ def test_lifecycle_slo_miss_and_preemption_breaks_tpot_chain():
     assert reg.histogram("serving/queue_wait_ms").last == pytest.approx(5.0)
 
 
+def test_readmission_keeps_ttft_from_original_arrival():
+    """ISSUE 12 satellite: preempt-before-first-token must NOT restart the
+    TTFT clock — first-token latency stays measured from the ORIGINAL
+    arrival, and the re-admission wait lands in its own
+    serving/readmit_wait_ms histogram (anchored at the preemption stamp)."""
+    clk = FakeClock()
+    tr = Tracer(enabled=True)
+    t = LifecycleTracker(tr, slo=ServingSLOConfig(ttft_ms=500.0), clock=clk)
+
+    t.arrive(0, now=0.0)
+    t.admit(0, uid=1, now=0.010)
+    t.preempt(0, now=0.030)           # preempted BEFORE any token emitted
+    t.admit(0, uid=2, now=0.200)      # re-admitted 170 ms later
+    t.emitted(0, 1, now=0.260)        # first token
+    t.finish(0, now=0.260)
+
+    reg = tr.registry
+    # TTFT from the original arrival (260 ms), NOT from the re-admission
+    assert reg.histogram("serving/ttft_ms").last == pytest.approx(260.0)
+    assert t.get(0).ttft_s == pytest.approx(0.260)
+    # queue wait pinned to the FIRST admission; the 170 ms re-admission
+    # wait is its own histogram
+    assert reg.histogram("serving/queue_wait_ms").last == pytest.approx(10.0)
+    assert reg.histogram("serving/queue_wait_ms").count == 1
+    assert reg.histogram("serving/readmit_wait_ms").last == pytest.approx(170.0)
+    assert reg.histogram("serving/readmit_wait_ms").count == 1
+    assert reg.counter("serving/readmissions").value == 1
+    # 260 <= 500 -> the readmitted request still meets its TTFT SLO
+    assert reg.counter("serving/slo_met").value == 1
+
+
 def test_goodput_undefined_without_targets():
     tr = Tracer(enabled=True)
     t = LifecycleTracker(tr, slo=ServingSLOConfig(), clock=FakeClock())

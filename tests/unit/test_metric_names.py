@@ -35,6 +35,7 @@ ALLOWED_SUBSYSTEMS = {
     "moe",
     "program",
     "recompile",
+    "router",
     "serving",
     "span",
 }
@@ -112,7 +113,7 @@ def test_lint_scans_telemetry_and_serving_sources():
         for f in ("tracer.py", "registry.py", "exposition.py")
     } | {
         os.path.join("deepspeed_tpu", "inference", f)
-        for f in ("engine_v2.py", "lifecycle.py")
+        for f in ("engine_v2.py", "lifecycle.py", "router.py")
     } | {os.path.join("tools", "bench_serving.py")}
     missing = expected - scanned
     assert not missing, f"metric-minting files escaped the lint walk: {sorted(missing)}"
@@ -124,7 +125,11 @@ def test_known_names_pass_and_bad_names_fail():
                  "mem/device_bytes_in_use", "anomaly/step_straggler",
                  # quantized-serving capacity gauges (ISSUE 10)
                  "serving/kv_pool_dtype", "serving/kv_bytes_per_token",
-                 "serving/kv_pool_utilization"):
+                 "serving/kv_pool_utilization",
+                 # serving-tier metrics (ISSUE 12)
+                 "router/shed_requests", "router/replica_queue_depth",
+                 "serving/prefix_hit_rate", "serving/spec_accept_rate",
+                 "serving/readmit_wait_ms"):
         assert _check_name(good) is None, good
     for bad in ("ttft", "Serving/ttft", "serving ttft", "{x}/y", "bogus/name"):
         assert _check_name(bad) is not None, bad

@@ -69,6 +69,19 @@ JAX_PLATFORMS=cpu python -m pytest tests/unit/inference/test_quantized_serving.p
 quant_rc=${PIPESTATUS[0]}
 [ "${quant_rc}" -ne 0 ] && rc=1
 
+# Serving-router smoke (ISSUE 12): 2 CPU replicas under a shared-prefix
+# burst through the real router — exit-gates on prefix_hit_rate > 0 (the
+# content-hash cache actually served blocks) and ZERO dropped-but-admitted
+# requests (shedding happens strictly before admission; an admitted request
+# always finishes). The JSON line lands in the committed log.
+{
+  echo "# serving-router smoke: python tools/bench_serving.py --router-smoke"
+} >> "${OUT}"
+JAX_PLATFORMS=cpu python tools/bench_serving.py --router-smoke 2>/dev/null \
+  | sed 's/^/router-smoke: /' | tee -a "${OUT}"
+router_rc=${PIPESTATUS[0]}
+[ "${router_rc}" -ne 0 ] && rc=1
+
 # Compiled-program inventory (ISSUE 7): the registry must capture a real
 # train-step and v2 decode-chain program with nonzero flops/peak-HBM and a
 # computed hbm/estimate_ratio. Committed alongside this log as its own
@@ -104,7 +117,7 @@ coll_rc=${PIPESTATUS[0]}
 echo "# collective observatory: ${COLL_OUT} (exit ${coll_rc})" >> "${OUT}"
 
 {
-  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, program report: ${prog_rc}, coll report: ${coll_rc})"
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, program report: ${prog_rc}, coll report: ${coll_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
 echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT}"
