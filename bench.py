@@ -1007,6 +1007,90 @@ def bench_coll_observability():
     }
 
 
+def bench_fleet_overhead():
+    """Host overhead of fleet telemetry export (``telemetry/collector.py``)
+    — the <2% bound ISSUE 13 commits to, same paired-step discipline as the
+    PR-5/7/11 guards.
+
+    ONE telemetry-enabled engine steps in paired off/on alternation against
+    a live in-process :class:`FleetCollector`; every ``cadence``-th on-step
+    pays a ``FleetClient.push_async`` on the clock — the hot-path push API:
+    the registry dump + heartbeat snapshot happens synchronously (the cost
+    a step actually sees) and the HTTP round-trip rides the client's worker
+    thread, exactly like the production daemon-cadence wiring. Cadence 5
+    per STEP is far denser than the config default (a 5-second wall-clock
+    interval), so the bound holds with margin for any real deployment."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+    from deepspeed_tpu.telemetry.collector import FleetClient, FleetCollector
+
+    cfg = TransformerConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, max_seq_len=256,
+    )
+    seq, micro, cadence, pairs, warmup = 256, 4, 5, 60, 5
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+            "telemetry": {"enabled": True},
+        })
+    collector = FleetCollector().start()
+    client = FleetClient(collector.url, observatory=None)
+    client.register()
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    for _ in range(warmup):
+        m = engine.train_batch(batch)
+    np.asarray(m["loss"])
+    client.push(include_table=False)  # first push (lazy setup) off the clock
+
+    on_steps = [0]
+
+    def one_step(push):
+        t0 = time.perf_counter()
+        m = engine.train_batch(batch)
+        if push:
+            on_steps[0] += 1
+            if on_steps[0] % cadence == 0:
+                client.push_async(include_table=False)
+        np.asarray(m["loss"])  # paired timing needs the per-step sync
+        return time.perf_counter() - t0
+
+    try:
+        t_off = t_on = 0.0
+        for _ in range(pairs):  # pairs % cadence == 0: whole push cycles
+            t_off += one_step(False)
+            t_on += one_step(True)
+        client.flush()  # drain the async worker off the clock
+    finally:
+        collector.stop()
+
+    ms_off = t_off / pairs * 1e3
+    ms_on = t_on / pairs * 1e3
+    overhead_pct = (ms_on - ms_off) / ms_off * 100.0
+    return {
+        "model": "gpt2_cpu_bench_2L_128h_seq256_micro4",
+        "push_every_n_steps": cadence,
+        "ms_per_step_fleet_off": round(ms_off, 3),
+        "ms_per_step_fleet_on": round(ms_on, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "bound_pct": 2.0,
+        "within_bound": bool(overhead_pct < 2.0),
+        "pushes": client.pushes,
+        "push_failures": client.push_failures,
+        "federated_metric_children": collector.federated_registry().size(),
+    }
+
+
 # Confidence-ordered registry (safest first): a relay wedge mid-queue loses
 # everything after it, so known-good shapes go first and the big/novel
 # configs last. Each entry: name -> (fn(peak_flops)->dict, timeout_s).
@@ -1015,6 +1099,7 @@ EXTRA_BENCHES = {
     "elastic_snapshot_overhead": (lambda peak: bench_snapshot_overhead(), 420),
     "compile_observability": (lambda peak: bench_compile_observability(), 420),
     "coll_observability": (lambda peak: bench_coll_observability(), 420),
+    "fleet_export_overhead": (lambda peak: bench_fleet_overhead(), 420),
     "llama_550m_zero3_remat": (bench_train_llama_z3, 420),
     "mixtral_style_moe": (bench_train_moe, 420),
     "inference_v1_gpt2_125m": (lambda peak: bench_inference(), 420),
@@ -1251,6 +1336,13 @@ def main() -> None:
         extras["coll_observability"] = bench_coll_observability()
     except Exception as e:  # noqa: BLE001
         extras["coll_observability"] = {"error": str(e)[:200]}
+    # Fleet-export overhead (collector push + heartbeat around an unchanged
+    # step program) is pure host+localhost-HTTP work — CPU-measurable, same
+    # <2% bound as on chip (ISSUE 13).
+    try:
+        extras["fleet_export_overhead"] = bench_fleet_overhead()
+    except Exception as e:  # noqa: BLE001
+        extras["fleet_export_overhead"] = {"error": str(e)[:200]}
     result = {
         "metric": f"tokens_per_sec_per_chip_gpt2_125m_bf16_seq{seq}" if on_tpu
         else f"tokens_per_sec_cpu_smoke_seq{seq}",

@@ -665,12 +665,18 @@ class CollectiveObservatory:
                                       world, itemsize, block_size)
             except ValueError:
                 hops = max(world - 1, 1)
+        from deepspeed_tpu.telemetry.fleet import get_identity
+
         row = {
             "op": op, "world": int(world), "size_mb": round(size_mb, 4),
             "algorithm": algorithm, "codec": codec, "backend": backend,
             "latency_ms": round(latency_ms, 4),
             "busbw_gbps": round(busbw / 1e9, 3),
             "itemsize": int(itemsize), "samples": 1,
+            # process identity stamp (fleet federation provenance; not part
+            # of row_key — the same signature measured on two processes
+            # still EMA-merges into one row at the collector)
+            "proc": get_identity().key(),
         }
         self._publish_sample(row, hops, bucket)
         if check_drift:
@@ -861,9 +867,13 @@ class CollectiveObservatory:
         if not rows:
             return None
         path = path or self.table_path()
+        from deepspeed_tpu.telemetry.fleet import get_identity
+
         try:
-            return table_mod.write_table(path, rows, source="online",
-                                         extra={"calibration": calib})
+            return table_mod.write_table(
+                path, rows, source="online",
+                extra={"calibration": calib,
+                       "identity": get_identity().to_dict()})
         except OSError as e:
             self._warn_once("persist",
                             f"collectives observatory: cannot persist table "
@@ -934,10 +944,18 @@ def _bytes_bucket(nbytes: int) -> int:
 def default_table_path() -> str:
     """Where the online table lives when no explicit path is configured —
     a function of the telemetry output dir only, never of the (process-
-    global, possibly another engine's) observatory config."""
+    global, possibly another engine's) observatory config. On a multi-
+    process mesh each process gets its OWN file (``coll_table.p<N>.json``
+    for process_index > 0): N observatory instances sharing one path would
+    clobber each other's atomic writes; the fleet collector
+    (``telemetry/collector.py``) is the one place per-process tables merge
+    (``table.merge_rows``) into a mesh-wide view."""
     from deepspeed_tpu.telemetry import default_output_dir
+    from deepspeed_tpu.telemetry.fleet import get_identity
 
-    return os.path.join(default_output_dir(), "coll_table.json")
+    idx = get_identity().process_index
+    name = "coll_table.json" if idx == 0 else f"coll_table.p{idx}.json"
+    return os.path.join(default_output_dir(), name)
 
 
 # ------------------------------------------------------------- module API

@@ -19,8 +19,14 @@ On disk a table is a versioned envelope ``{"schema": 1, "source": ...,
 "rows": [...]}``. Loading accepts the envelope (schema checked,
 reject-with-warning on mismatch) AND the legacy bare-list format PR-3 sweep
 files used — an old table keeps working, a FUTURE schema never silently
-routes traffic. ``merge_rows`` is the one fold implementation behind both
-``--merge`` (sweep into an existing table) and the observatory's online EMA.
+routes traffic. ``merge_rows`` is the one fold implementation behind all
+three table producers: ``--merge`` (sweep into an existing table), the
+observatory's online EMA, and the fleet collector's read-time federation
+(``telemetry/collector.py`` folds each process's LATEST rows per read —
+``source: "fleet"`` envelopes, served at ``GET /coll_table``); rows may
+carry a ``proc`` identity stamp, which is provenance only — it is NOT part
+of :func:`row_key`, so the same signature measured on two processes merges
+into one row.
 """
 
 from __future__ import annotations

@@ -250,6 +250,18 @@ class TelemetryConfig(DeepSpeedConfigModel):
     # spans/metrics without program capture (skips the one-time per-compile
     # AOT analysis pass).
     programs: bool = True
+    # Fleet federation (telemetry/fleet.py + telemetry/collector.py): when
+    # set, this process registers with the FleetCollector at this URL
+    # (identity + clock handshake) and pushes mergeable registry snapshots,
+    # heartbeats (step rate, HBM watermark, anomaly flags) and observatory
+    # table rows on the cadence below, from a daemon thread. None = no
+    # fleet client (single-process runs pay nothing).
+    fleet_url: Optional[str] = None
+    fleet_push_interval_s: float = 5.0
+    # Identity override for this process's role in the fleet ledger
+    # (train | router | replica | collector | worker); None keeps the
+    # $DSTPU_ROLE / default resolution.
+    fleet_role: Optional[str] = None
 
 
 class HealthConfig(DeepSpeedConfigModel):

@@ -176,9 +176,15 @@ class FlightRecorder:
         if path:
             return path
         from deepspeed_tpu.telemetry import default_output_dir
+        from deepspeed_tpu.telemetry.fleet import get_identity
 
-        return os.path.join(self.dump_dir or default_output_dir(),
-                            "flight_record.jsonl")
+        # per-process default filename: N processes sharing a telemetry dir
+        # must not overwrite each other's post-mortems (process 0 keeps the
+        # historical name so single-process tooling is unchanged)
+        idx = get_identity().process_index
+        name = ("flight_record.jsonl" if idx == 0
+                else f"flight_record.p{idx}.jsonl")
+        return os.path.join(self.dump_dir or default_output_dir(), name)
 
     def dump(self, reason: str = "manual", path: Optional[str] = None) -> str:
         """Fetch the ring (one bulk transfer) and write the JSONL post-mortem.
@@ -193,11 +199,16 @@ class FlightRecorder:
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         with open(path, "w") as f:
+            from deepspeed_tpu.telemetry.fleet import get_identity
+
             header = {
                 "kind": "header",
                 "reason": reason,
                 "time_unix": time.time(),
                 "pid": os.getpid(),
+                # the fleet join key: run_id/process_index/host/role — two
+                # replicas' dumps were indistinguishable without it
+                "identity": get_identity().to_dict(),
                 "context": self._context,
                 "n_records": len(ring),
                 "n_requests": len(requests),

@@ -84,6 +84,7 @@ def run_resilient(
     snapshot_dir: Optional[str] = None,
     policy=None,
     on_rewind: Optional[Callable[[Dict[str, Any]], None]] = None,
+    fleet_client=None,
 ) -> RecoveryReport:
     """Train ``engine`` to ``num_steps`` optimizer steps, surviving health
     aborts and snapshot corruption by rewinding to the last-good snapshot.
@@ -96,9 +97,25 @@ def run_resilient(
     manager is installed on the engine so the cadence hook drives saves.
     ``policy`` defaults to the engine's ``recovery`` config block;
     ``on_rewind`` (if given) is called with each rewind-log entry — the test
-    seam, and the place to page a human.
+    seam, and the place to page a human. ``fleet_client`` (a
+    ``telemetry.collector.FleetClient``, or the engine's own when the
+    ``telemetry.fleet_url`` config key set one up) gets an out-of-cadence
+    push at every rewind and at give-up, stamped with the recovery state —
+    the cluster health ledger sees a rewinding/failed process the moment it
+    happens, not a heartbeat interval later.
     """
     pol = _policy(engine, policy)
+    if fleet_client is None:
+        fleet_client = getattr(engine, "_fleet_client", None)
+
+    def _fleet_push(phase: str, **extra):
+        if fleet_client is not None:
+            # never raises (FleetClient swallows transport failures) and
+            # carries only host floats — safe inside the recovery path
+            fleet_client.push(heartbeat_extra={
+                "phase": phase, "rewinds": report.rewinds,
+                "gave_up": report.gave_up, **extra})
+
     mgr: Optional[SnapshotManager] = getattr(engine, "snapshot_manager", None)
     if mgr is None:
         if snapshot_dir is None:
@@ -130,6 +147,7 @@ def run_resilient(
                                 or _dump_flight_record(engine, f"giveup:{reason}")
                                 or report.flight_record)
         exc.recovery_report = report
+        _fleet_push("failed", reason=reason)
         logger.error(
             f"run_resilient: giving up after {report.rewinds} rewind(s) — "
             f"{reason}"
@@ -193,6 +211,7 @@ def run_resilient(
                 ranks=[0])
             if on_rewind is not None:
                 on_rewind(entry)
+            _fleet_push("rewound", tag=tag, step=step)
             if backoff > 0:
                 time.sleep(backoff)
             continue

@@ -60,6 +60,7 @@ from deepspeed_tpu.inference.sampling import sample_logits
 from deepspeed_tpu.models.transformer import TransformerConfig, causal_lm_partition_rules
 from deepspeed_tpu.parallel.autotp import place_parameters
 from deepspeed_tpu.telemetry import get_tracer
+from deepspeed_tpu.telemetry.fleet import note_step as _fleet_note_step
 from deepspeed_tpu.topology.mesh import build_mesh, set_mesh
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -375,6 +376,7 @@ class InferenceEngineV2:
         self.dispatch_count = 0        # compiled programs dispatched
         self.host_sync_count = 0       # host blocking fetches
         self.tokens_decoded = 0        # decode tokens produced by generate()
+        self.chain_steps = 0           # decode-chain dispatches (fleet liveness)
         # prefix-cache + speculative accounting (plain int adds; the serving
         # benchmark and the router smoke read these)
         self.prefill_tokens_total = 0  # prompt tokens submitted for prefill
@@ -1140,6 +1142,10 @@ class InferenceEngineV2:
                     sample_kw=sample_kw, tracker=tracker, rids=chain_rids)
             n_emitted = int(emitted.sum())
             self.tokens_decoded += n_emitted
+            # serving liveness for /healthz + fleet heartbeats: a decode
+            # chain is this engine's "step" (two plain writes)
+            self.chain_steps += 1
+            _fleet_note_step(self.chain_steps)
             if tracker is not None:
                 # ONE stamp per chain boundary; TPOT = boundary delta / tokens
                 now = time.perf_counter()

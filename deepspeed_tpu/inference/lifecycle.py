@@ -62,11 +62,18 @@ class RequestRecord:
     __slots__ = ("rid", "uid", "arrival", "admit", "first_admit", "first_token",
                  "last_emit", "finish", "tokens", "chains", "preemptions",
                  "readmissions", "decode_s", "dispatch_stamps", "phase",
-                 "last_preempt", "replica")
+                 "last_preempt", "replica", "flow_id", "flow_name")
 
     def __init__(self, rid: int, arrival: float):
         self.rid = rid
         self.uid: Optional[int] = None
+        # cross-process Chrome flow identity (fleet.TraceContext): when
+        # set, the request's flow arrows use the context's (name, id)
+        # instead of the local rid — Chrome binds arrows on BOTH, so a
+        # replica in ANOTHER process emitting a flow step with the same
+        # context binds into this track in the merged trace
+        self.flow_id: Optional[int] = None
+        self.flow_name: Optional[str] = None
         self.arrival = arrival
         self.admit: Optional[float] = None  # most recent admission
         self.first_admit: Optional[float] = None
@@ -170,6 +177,16 @@ class LifecycleTracker:
 
     def get(self, rid: int) -> Optional[RequestRecord]:
         return self._records.get(rid)
+
+    def set_trace_context(self, rid: int, ctx) -> None:
+        """Attach a ``fleet.TraceContext`` to a request: its flow arrows
+        switch to the context's fleet-wide (name, id) — both sides of a
+        process boundary derive the same pair from (run_id, request_id),
+        and Chrome binds arrows on both fields."""
+        rec = self._records.get(rid)
+        if rec is not None:
+            rec.flow_id = ctx.flow_id
+            rec.flow_name = ctx.flow_name
 
     def records(self) -> Dict[int, RequestRecord]:
         return self._records
@@ -375,7 +392,10 @@ class LifecycleTracker:
         args = {"rid": rid, "tokens": rec.tokens, "chains": rec.chains,
                 "preemptions": rec.preemptions}
         fa, ft, fin = rec.first_admit, rec.first_token, rec.finish
-        flow_name = f"req-{rid}"
+        # fleet-wide flow (name, id) when a trace context was attached (the
+        # merged multi-process trace binds on BOTH); local rid otherwise
+        fid = rec.flow_id if rec.flow_id is not None else rid
+        flow_name = rec.flow_name if rec.flow_name is not None else f"req-{rid}"
         evs: List[Dict[str, Any]] = []
         if fa is not None:
             evs.append({"kind": "span", "name": "queue", "cat": "serve_req",
@@ -394,12 +414,12 @@ class LifecycleTracker:
         # dispatch span that carried the request, end back on the track
         if fa is not None:
             evs.append({"kind": "flow", "name": flow_name, "cat": "flow",
-                        "ph": "s", "id": rid, "ts": fa + 1e-7 - o, "tid": tid})
+                        "ph": "s", "id": fid, "ts": fa + 1e-7 - o, "tid": tid})
         dtid = self._dispatch_tid or tid
         for t in rec.dispatch_stamps:
             evs.append({"kind": "flow", "name": flow_name, "cat": "flow",
-                        "ph": "t", "id": rid, "ts": t - o, "tid": dtid})
+                        "ph": "t", "id": fid, "ts": t - o, "tid": dtid})
         if fin is not None:
             evs.append({"kind": "flow", "name": flow_name, "cat": "flow",
-                        "ph": "f", "id": rid, "ts": fin - 1e-7 - o, "tid": tid})
+                        "ph": "f", "id": fid, "ts": fin - 1e-7 - o, "tid": tid})
         tr.append_events(evs)

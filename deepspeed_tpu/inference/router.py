@@ -50,6 +50,7 @@ import numpy as np
 from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.inference.lifecycle import LifecycleTracker
 from deepspeed_tpu.telemetry import get_tracer
+from deepspeed_tpu.telemetry import fleet
 
 # virtual Perfetto track ids for replica tracks (request tracks live at
 # lifecycle.TRACK_BASE = 0x5E51_0000; replicas get their own range)
@@ -109,6 +110,21 @@ class ServingRouter:
         self.deferred_count = 0
         self.preemptions = 0
         self.affine_readmits = 0
+        # distributed-trace contexts minted per request (fleet.TraceContext):
+        # rid -> ctx; the wire form (`dispatch_context`) is what a real
+        # process-boundary replica receives with its dispatch, and the flow
+        # id is derived from (run_id, rid) so BOTH processes compute it —
+        # the in-process replicas consume it through the lifecycle trackers
+        self._trace_ctx: Dict[int, fleet.TraceContext] = {}
+        self._request_seq = 0
+        # multi-process crash forensics: a replica's flight-recorder dumps
+        # must name which replica (and which run) they came from
+        ident = fleet.get_identity()
+        for rep in self.replicas:
+            rec = getattr(rep.engine, "_recorder", None)
+            if rec is not None:
+                rec.set_context(replica=rep.index, run_id=ident.run_id,
+                                process_index=ident.process_index)
 
     @classmethod
     def build(cls, model_config, params, engine_config=None, replicas: int = 2,
@@ -205,6 +221,13 @@ class ServingRouter:
         arr = [float(a) for a in arrival_times] if arrival_times is not None \
             else [0.0] * n_req
         pending = deque(sorted(range(n_req), key=lambda i: arr[i]))
+        # one TraceContext per request, fleet-unique request ids (monotonic
+        # across serve() calls): the flow id both the admission arrow here
+        # and a remote replica's serve:dispatch step derive independently
+        seq0 = self._request_seq
+        self._request_seq += n_req
+        self._trace_ctx = {i: fleet.TraceContext.mint(seq0 + i)
+                           for i in range(n_req)}
         affinity: List[Optional[int]] = [None] * n_req
         admitted_once: set = set()  # rids that ever dispatched a prefill
         gen: Dict[int, List[int]] = {i: [] for i in range(n_req)}
@@ -350,6 +373,8 @@ class ServingRouter:
                     if rep.tracker is not None:
                         rep.tracker.arrive(idx, now=t_start + arr[idx])
                         rep.tracker.admit(idx, next_uid)
+                        rep.tracker.set_trace_context(
+                            idx, self._trace_ctx[idx])
                     rep.active[next_uid] = idx
                     rep.order[next_uid] = None
                     next_uid += 1
@@ -465,6 +490,17 @@ class ServingRouter:
                 g_depth[rep.index].set(0.0)
                 g_active[rep.index].set(0.0)
         return [outputs.get(i) for i in range(n_req)]
+
+    def dispatch_context(self, idx: int) -> Optional[Dict[str, Any]]:
+        """Wire-form trace context for request ``idx`` of the current/most
+        recent ``serve()`` — what a REAL process-boundary replica receives
+        alongside its dispatch payload. The receiver rebuilds it with
+        ``fleet.TraceContext.from_wire`` and wraps its work in
+        ``fleet.dispatch_span(ctx)``, which emits the ``serve:dispatch``
+        span + in-span flow step that binds into this router's admission
+        arrow once ``tools/trace_merge.py`` joins the streams."""
+        ctx = self._trace_ctx.get(idx)
+        return ctx.to_wire() if ctx is not None else None
 
     def reset_estimates(self) -> None:
         """Zero the per-replica latency EMAs. Call after a warmup pass: the

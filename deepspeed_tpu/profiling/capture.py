@@ -80,8 +80,14 @@ class ProfilerCapture:
         self.cooldown_steps = max(int(cooldown_steps), 0)
         if out_dir is None:
             from deepspeed_tpu.telemetry.exporters import default_output_dir
+            from deepspeed_tpu.telemetry.fleet import get_identity
 
-            out_dir = os.path.join(default_output_dir(), "profiler")
+            # per-process capture dir (proc 0 keeps the historical layout):
+            # two replicas' device traces must land in joinable, distinct
+            # directories, same policy as the flight-recorder dumps
+            idx = get_identity().process_index
+            sub = "profiler" if idx == 0 else f"profiler.p{idx}"
+            out_dir = os.path.join(default_output_dir(), sub)
         self.out_dir = out_dir
         self.captures: List[Dict[str, Any]] = []
         self._armed_reason: Optional[str] = None
@@ -157,6 +163,9 @@ class ProfilerCapture:
         except Exception as e:  # noqa: BLE001
             logger.warning(f"profiler capture failed to stop: {e}")
             return
+        from deepspeed_tpu.telemetry.fleet import get_identity
+
+        ident = get_identity()
         record = {
             "reason": act["reason"],
             "trace_dir": act["path"],
@@ -164,6 +173,8 @@ class ProfilerCapture:
             "last_step": step,
             "steps": self.steps,
             "wall_s": round(time.perf_counter() - act["t0"], 3),
+            "run_id": ident.run_id,
+            "process_index": ident.process_index,
         }
         self.captures.append(record)
         if self._tracer.enabled:

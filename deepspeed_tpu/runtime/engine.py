@@ -51,6 +51,7 @@ from deepspeed_tpu.topology.mesh import (
     get_data_parallel_world_size,
     set_mesh,
 )
+from deepspeed_tpu.telemetry.fleet import note_step as _fleet_note_step
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import ThroughputTimer
 
@@ -63,6 +64,24 @@ _MOE_METRIC_KEYS = ("moe/capacity_factor", "moe/token_drop_rate",
 # (daemon threads over the process-global registry — engines come and go,
 # the exposition endpoint stays; port 0 always binds a fresh free port).
 _METRICS_SERVERS: dict = {}
+
+
+# Fleet push clients, one per collector URL for the process lifetime (the
+# registry and identity they push are process-global — a second engine with
+# the same fleet_url must reuse the cadence thread, not double the traffic).
+_FLEET_CLIENTS: dict = {}
+
+
+def _get_fleet_client(url: str, interval_s: float):
+    """Start (or reuse) the process-global fleet push client for ``url``."""
+    from deepspeed_tpu.telemetry.collector import FleetClient
+
+    client = _FLEET_CLIENTS.get(url)
+    if client is not None:
+        return client
+    client = _FLEET_CLIENTS[url] = FleetClient(url)
+    client.start(interval_s=interval_s)
+    return client
 
 
 def _get_metrics_server(port: int):
@@ -334,6 +353,20 @@ class DeepSpeedTPUEngine:
                     log_dist(
                         f"telemetry: /metrics on port {self._metrics_server.port}",
                         ranks=[0])
+        self._fleet_client = None
+        if tcfg.fleet_url:
+            # fleet federation: register with the collector (identity +
+            # clock handshake) and push snapshots/heartbeats on a daemon
+            # cadence — push failures never reach the training step. The
+            # client is PROCESS-global per URL like the /metrics server:
+            # engines come and go, one cadence thread pushes the one
+            # process-global registry.
+            from deepspeed_tpu.telemetry import fleet as fleet_mod
+
+            if tcfg.fleet_role is not None:
+                fleet_mod.configure_identity(role=tcfg.fleet_role)
+            self._fleet_client = _get_fleet_client(
+                tcfg.fleet_url, tcfg.fleet_push_interval_s)
         self._tracer = telemetry_mod.get_tracer()
         # Collectives (collectives/): install the selector tunables so comm
         # facade calls with algorithm="auto" (and the zeropp overlap knob)
@@ -2073,6 +2106,8 @@ class DeepSpeedTPUEngine:
         self.losses = metrics["loss"]
         self._batch_count += 1
         step = self._batch_count
+        # /healthz + fleet-heartbeat liveness breadcrumb (two plain writes)
+        _fleet_note_step(step)
         if self.diagnostics is not None:
             # flight-recorder ring append (device refs, no fetch) + step-time
             # anomaly observe + the abort-policy check (which may raise)

@@ -26,6 +26,14 @@ probes, recompile detection, step-time anomaly flags, crash flight recorder)
 — it shares this registry, so its ``health/``, ``recompile/``, ``anomaly/``,
 and ``flops/`` metrics ride the same monitor/export paths. See
 ``docs/diagnostics.md``.
+
+The FLEET plane (``fleet.py`` + ``collector.py``) lifts all of this across
+process boundaries: a ``ProcessIdentity`` stamped on every artifact,
+bit-exact metric federation into a ``FleetCollector`` (counters sum,
+log-bucket histograms merge bucket-wise, gauges keep last-per-process
+under ``{proc=}``), cross-process trace contexts whose flow arrows join
+in ``tools/trace_merge.py``, and a cluster health ledger of per-process
+heartbeats. See docs/telemetry.md "Fleet telemetry".
 """
 
 from deepspeed_tpu.telemetry.exporters import (
@@ -41,6 +49,12 @@ from deepspeed_tpu.telemetry.exposition import (
     render_json_snapshot,
     render_prometheus,
     serve_metrics,
+)
+from deepspeed_tpu.telemetry.fleet import (
+    ProcessIdentity,
+    TraceContext,
+    configure_identity,
+    get_identity,
 )
 from deepspeed_tpu.telemetry.registry import (
     Counter,
@@ -65,9 +79,12 @@ __all__ = [
     "MetricsRegistry",
     "MetricsServer",
     "NOOP_SPAN",
+    "ProcessIdentity",
+    "TraceContext",
     "Tracer",
     "chrome_trace_events",
     "configure",
+    "configure_identity",
     "default_output_dir",
     "enabled",
     "env_enabled",
@@ -75,6 +92,7 @@ __all__ = [
     "export_json_snapshot",
     "export_jsonl",
     "export_prometheus",
+    "get_identity",
     "get_tracer",
     "render_json_snapshot",
     "render_prometheus",

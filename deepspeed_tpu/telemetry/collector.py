@@ -1,0 +1,527 @@
+"""FleetCollector: cross-process metric federation + cluster health ledger.
+
+One collector process (or thread) receives pushed snapshots from — or
+scrapes — every process of a run and merges them into ONE federated view:
+
+  - ``POST /register``   identity + clock handshake ({"time_unix", ...})
+                         → {"ok", "clock_offset_s"} — the offset the trace
+                         merger can apply to this process's stream
+  - ``POST /push``       full snapshot: identity, clock, ``registry`` (the
+                         :func:`fleet.registry_dump` wire form), optional
+                         ``heartbeat`` and observatory ``coll_rows``
+  - ``POST /heartbeat``  identity + heartbeat only (cheap liveness)
+  - ``GET  /metrics``    FEDERATED Prometheus exposition (counters summed,
+                         histograms merged bucket-wise, gauges
+                         last-per-process under ``{proc=}``, plus the
+                         ``fleet/*`` rollups)
+  - ``GET  /metrics.json`` federated JSON snapshot
+  - ``GET  /fleet``      the health ledger: per-process identity, last-seen
+                         age, heartbeat (step rate, HBM watermark, queue
+                         depth), clock offset, straggler verdict
+  - ``GET  /coll_table`` the federated observatory decision table
+                         (versioned envelope — a fresh selector warm-starts
+                         measured mode from the whole mesh's measurements)
+  - ``GET  /healthz``    the collector's own liveness
+
+Merging happens at READ time from the latest dump per process: pushes carry
+cumulative process-local snapshots, so the collector must replace a
+process's previous contribution, never add to it — re-merging from the
+stored dumps on each render is what makes a restarted worker's reset
+counters harmless (its new dump simply replaces the old one).
+
+The ledger (``ledger()`` / ``GET /fleet``) is the signal the elastic
+supervisor (ROADMAP item 5) and router drain/join (item 1) consume: a
+process whose heartbeat age exceeds ``stale_after_s`` is marked ``stale``;
+cross-process stragglers are flagged by the PR-2 median+MAD discipline over
+per-process step rates.
+
+Scrape mode: :meth:`FleetCollector.scrape` GETs a worker's
+``/metrics.fleet`` endpoint (``exposition.MetricsServer``) and ingests it —
+same merge path as push, for fleets where workers can't reach out.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.telemetry import fleet
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.utils.logging import logger
+
+
+class FleetCollector:
+    """Merge-at-read federation over the latest snapshot per process."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 stale_after_s: float = 60.0,
+                 straggler_mads: float = 6.0,
+                 table_path: Optional[str] = None):
+        self._host = host
+        self._requested_port = port
+        self.stale_after_s = float(stale_after_s)
+        self.straggler_mads = float(straggler_mads)
+        self.table_path = table_path
+        self._server = None  # exposition.RouteServer, built at start()
+        self._lock = threading.Lock()
+        # proc key -> {"identity", "dump", "heartbeat", "coll_rows",
+        #              "last_seen", "clock_offset_s", "origin_unix"}
+        self._procs: Dict[str, Dict[str, Any]] = {}
+
+    # ------------------------------------------------------------- ingest
+    def ingest(self, doc: Dict[str, Any],
+               recv_time: Optional[float] = None) -> Dict[str, Any]:
+        """Fold one pushed document (register/push/heartbeat all share this
+        shape) into the collector state; returns the ack the HTTP layer
+        sends back. In-process callers (tests, same-process supervisors)
+        use it directly — HTTP is transport, not semantics."""
+        now = recv_time if recv_time is not None else time.time()
+        ident = fleet.ProcessIdentity.from_dict(
+            doc.get("identity") or {"run_id": "?"})
+        clock = doc.get("clock") or {}
+        offset = None
+        if clock.get("time_unix") is not None:
+            # one-way handshake: includes transport latency, which is the
+            # honest bound for the localhost/LAN fleets this targets
+            offset = round(now - float(clock["time_unix"]), 6)
+        with self._lock:
+            entry = self._procs.setdefault(ident.key(), {})
+            entry["identity"] = ident
+            entry["last_seen"] = now
+            if offset is not None:
+                entry["clock_offset_s"] = offset
+            if clock.get("origin_unix") is not None:
+                entry["origin_unix"] = float(clock["origin_unix"])
+            if "registry" in doc:
+                entry["dump"] = doc["registry"]
+            if "heartbeat" in doc:
+                entry["heartbeat"] = dict(doc["heartbeat"])
+            if "coll_rows" in doc:
+                # REPLACE, like the registry dump: a push carries the
+                # process's full cumulative table, so re-folding it
+                # additively would inflate sample counts and re-apply the
+                # EMA to identical data on every cadence push — the
+                # cross-process fold happens once per READ (table_rows)
+                entry["coll_rows"] = list(doc["coll_rows"])
+        if doc.get("coll_rows") and self.table_path:
+            self.persist_table()
+        return {"ok": True, "proc": ident.key(),
+                **({"clock_offset_s": offset} if offset is not None else {})}
+
+    def scrape(self, url: str, timeout_s: float = 5.0) -> Dict[str, Any]:
+        """Pull one worker's ``/metrics.fleet`` dump and ingest it (the
+        collector-initiated alternative to push). ``url`` is the worker
+        MetricsServer base, e.g. ``http://127.0.0.1:9400``."""
+        import urllib.request
+
+        with urllib.request.urlopen(url.rstrip("/") + "/metrics.fleet",
+                                    timeout=timeout_s) as resp:
+            dump = json.loads(resp.read().decode())
+        return self.ingest({"identity": dump.get("identity"),
+                            "registry": dump,
+                            "clock": {"time_unix": dump.get("time_unix")}})
+
+    def persist_table(self) -> None:
+        from deepspeed_tpu.collectives import table as table_mod
+
+        try:
+            table_mod.write_table(self.table_path, self.table_rows(),
+                                  source="fleet")
+        except OSError as e:  # pragma: no cover - disk trouble
+            logger.warning(f"fleet collector: cannot persist federated "
+                           f"table to {self.table_path!r}: {e}")
+
+    # ------------------------------------------------------------- views
+    def processes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._procs)
+
+    def dumps(self) -> Dict[str, Dict[str, Any]]:
+        """proc key -> the latest registry dump that process pushed — the
+        raw inputs of the federated merge, for verifiers (the nightly
+        smoke's bit-exactness gate sums these independently)."""
+        with self._lock:
+            return {k: e["dump"] for k, e in self._procs.items()
+                    if e.get("dump") is not None}
+
+    @staticmethod
+    def _proc_labels(entries) -> Dict[str, str]:
+        """entry key -> ``{proc=}`` label: the short ``p<index>`` when it is
+        unique across the fleet, the run_id-qualified key otherwise — two
+        standalone workers that both defaulted to process_index 0 (distinct
+        minted run_ids) must not clobber each other's gauges, heartbeats,
+        or straggler math."""
+        shorts = [e["identity"].proc for _k, e in entries]
+        dupes = {p for p in shorts if shorts.count(p) > 1}
+        return {k: (e["identity"].key() if e["identity"].proc in dupes
+                    else e["identity"].proc)
+                for k, e in entries}
+
+    def table_rows(self) -> List[dict]:
+        """The federated observatory table: each process's LATEST rows,
+        folded at read time through the ONE table fold
+        (``collectives/table.py:merge_rows``, EMA mode — the online
+        semantics) in sorted-proc order, so repeated reads of the same
+        state are identical and a signature measured on several processes
+        lands in one row without per-push inflation."""
+        from deepspeed_tpu.collectives import table as table_mod
+
+        with self._lock:
+            per_proc = [(k, list(e["coll_rows"]))
+                        for k, e in sorted(self._procs.items())
+                        if e.get("coll_rows")]
+        rows: List[dict] = []
+        for _key, proc_rows in per_proc:
+            rows = table_mod.merge_rows(rows, proc_rows, ema=0.25)
+        return rows
+
+    def federated_registry(self) -> MetricsRegistry:
+        """Build the merged view from the latest dump per process —
+        deterministic merge order (sorted proc keys) so repeated renders of
+        the same state are bit-identical."""
+        with self._lock:
+            entries = [(k, dict(v)) for k, v in sorted(self._procs.items())]
+        labels = self._proc_labels(entries)
+        reg = MetricsRegistry()
+        heartbeats: Dict[str, Dict[str, Any]] = {}
+        now = time.time()
+        for key, entry in entries:
+            proc = labels[key]
+            dump = entry.get("dump")
+            if dump is not None:
+                fleet.merge_dump_into(reg, dump, proc_label=proc)
+            hb = entry.get("heartbeat")
+            if hb is not None:
+                heartbeats[proc] = hb
+                for field in ("queue_depth", "hbm_bytes_in_use"):
+                    if hb.get(field) is not None:
+                        reg.gauge(f"fleet/{field}", proc=proc).set(
+                            float(hb[field]))
+            reg.gauge("fleet/last_seen_age_s", proc=proc).set(
+                round(now - entry["last_seen"], 3))
+            if entry.get("clock_offset_s") is not None:
+                reg.gauge("fleet/clock_offset_s", proc=proc).set(
+                    entry["clock_offset_s"])
+        # the ONE definition of fleet/processes: every registered member,
+        # heartbeat or not — must always agree with the ledger's row count
+        reg.gauge("fleet/processes").set(float(len(entries)))
+        fleet.fleet_rollups(reg, heartbeats,
+                            straggler_mads=self.straggler_mads)
+        return reg
+
+    def render_prometheus(self) -> str:
+        from deepspeed_tpu.telemetry import exposition
+
+        # identity=False: the federated view spans processes — stamping the
+        # collector's own process_info on it would misattribute the fleet
+        return exposition.render_prometheus(self.federated_registry(),
+                                            identity=False)
+
+    def render_json(self) -> str:
+        from deepspeed_tpu.telemetry import exposition
+
+        return exposition.render_json_snapshot(self.federated_registry(),
+                                               identity=False)
+
+    def ledger(self) -> Dict[str, Any]:
+        """The cluster health ledger: one row per process — what the
+        elastic supervisor polls to decide drain/join/restart."""
+        with self._lock:
+            entries = [(k, dict(v)) for k, v in sorted(self._procs.items())]
+        labels = self._proc_labels(entries)
+        now = time.time()
+        rates = {labels[k]: float(e["heartbeat"]["step_rate"])
+                 for k, e in entries
+                 if e.get("heartbeat", {}).get("step_rate") is not None}
+        stragglers = fleet.straggler_flags(rates, mads=self.straggler_mads)
+        rows = []
+        for key, entry in entries:
+            ident: fleet.ProcessIdentity = entry["identity"]
+            age = now - entry["last_seen"]
+            rows.append({
+                "proc": key,
+                "identity": ident.to_dict(),
+                "last_seen_age_s": round(age, 3),
+                "stale": age > self.stale_after_s,
+                "clock_offset_s": entry.get("clock_offset_s"),
+                "origin_unix": entry.get("origin_unix"),
+                "heartbeat": entry.get("heartbeat"),
+                "straggler": bool(stragglers.get(labels[key], False)),
+            })
+        return {"time_unix": now, "processes": rows,
+                "coll_table_rows": len(self.table_rows())}
+
+    # -------------------------------------------------------------- serve
+    def _coll_table_doc(self) -> bytes:
+        from deepspeed_tpu.collectives.table import SCHEMA_VERSION
+
+        return json.dumps({"schema": SCHEMA_VERSION, "source": "fleet",
+                           "rows": self.table_rows()}).encode()
+
+    def _healthz_doc(self) -> bytes:
+        return json.dumps({
+            "ok": True, "role": "collector",
+            "identity": fleet.get_identity().to_dict(),
+            "processes": len(self.processes()),
+            "time_unix": time.time()}).encode()
+
+    def start(self) -> "FleetCollector":
+        if self._server is None:
+            from deepspeed_tpu.telemetry.exposition import RouteServer
+
+            js = "application/json"
+            self._server = RouteServer(
+                get_routes={
+                    "/metrics": lambda: (
+                        self.render_prometheus().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8"),
+                    "/metrics.json": lambda: (
+                        self.render_json().encode(), js),
+                    "/fleet": lambda: (
+                        json.dumps(self.ledger()).encode(), js),
+                    "/coll_table": lambda: (self._coll_table_doc(), js),
+                    "/healthz": lambda: (self._healthz_doc(), js),
+                },
+                # register/push/heartbeat all share the ingest shape — the
+                # paths differ only in what the sender chose to include
+                post_routes={p: self.ingest
+                             for p in ("/register", "/push", "/heartbeat")},
+                port=self._requested_port, host=self._host,
+                name="dstpu-fleet-collector")
+        self._server.start()
+        return self
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.port if self._server is not None else None
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop()
+
+    @property
+    def url(self) -> Optional[str]:
+        if self.port is None:
+            return None
+        return f"http://{self._host}:{self.port}"
+
+
+class FleetClient:
+    """One process's push side: registers (clock handshake), then pushes
+    registry dumps + heartbeats + observatory rows — on demand
+    (:meth:`push`) or on a background cadence (:meth:`start`).
+
+    Push failures NEVER raise into the caller (a dead collector must not
+    take the training step down with it): they count in ``push_failures``
+    and warn once."""
+
+    def __init__(self, url: str, identity: Optional[fleet.ProcessIdentity] = None,
+                 registry=None, observatory=None, timeout_s: float = 2.0):
+        self.url = url.rstrip("/")
+        self._identity = identity
+        self._registry = registry
+        self._observatory = observatory
+        self.timeout_s = float(timeout_s)
+        self.pushes = 0
+        self.push_failures = 0
+        self.clock_offset_s: Optional[float] = None
+        self._warned = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # async-push hand-off: hot-path callers snapshot (sub-ms) and the
+        # worker thread pays the HTTP round-trip. ONE pending slot, latest
+        # wins — snapshots are cumulative, so a newer one strictly
+        # supersedes an unsent older one (no queue to bound)
+        self._pending: Optional[Dict[str, Any]] = None
+        self._pending_lock = threading.Lock()
+        self._pending_event = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+
+    def _identity_dict(self) -> Dict[str, Any]:
+        ident = self._identity or fleet.get_identity()
+        return ident.to_dict()
+
+    def _post(self, path: str, doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        import urllib.request
+
+        body = json.dumps(doc).encode()
+        req = urllib.request.Request(
+            self.url + path, data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                return json.loads(resp.read().decode())
+        except Exception as e:  # noqa: BLE001 - collector may be down
+            self.push_failures += 1
+            if not self._warned:
+                self._warned = True
+                logger.warning(
+                    f"fleet: push to {self.url}{path} failed ({e}); further "
+                    "failures count silently in push_failures")
+            return None
+
+    def register(self) -> Optional[Dict[str, Any]]:
+        ack = self._post("/register", {
+            "identity": self._identity_dict(),
+            "clock": fleet.clock_sync_doc()})
+        if ack is not None and ack.get("clock_offset_s") is not None:
+            self.clock_offset_s = float(ack["clock_offset_s"])
+        return ack
+
+    def heartbeat_doc(self) -> Dict[str, Any]:
+        """The per-process health sample: last step + age, step wall time
+        (rate), HBM watermark, serving queue depth, anomaly flags — read
+        from the process registry so it costs a few dict lookups, never a
+        device fetch."""
+        from deepspeed_tpu.telemetry.tracer import get_tracer
+
+        registry = self._registry or get_tracer().registry
+        info = fleet.last_step_info()
+        hb: Dict[str, Any] = {"step": info["step"],
+                              "last_step_age_s": info["age_s"]}
+        h = registry.peek_histogram("span/train_batch")
+        if h is not None and h.count:
+            hb["step_time_ms"] = round(h.last * 1e3, 3)
+            if h.last > 0:
+                hb["step_rate"] = round(1.0 / h.last, 4)
+        gauges = registry.gauges()
+        for name, field in (
+                ("mem/device_bytes_in_use", "hbm_bytes_in_use"),
+                ("mem/device_peak_bytes_in_use", "hbm_peak_bytes"),
+                ("mem/live_array_bytes", "hbm_bytes_in_use"),
+                ("serving/queue_depth", "queue_depth"),
+                ("anomaly/step_straggler", "straggler"),
+                ("anomaly/step_regression", "regression")):
+            if name in gauges and field not in hb:
+                hb[field] = gauges[name]
+        return hb
+
+    def _build_doc(self, heartbeat_extra: Optional[Dict[str, Any]],
+                   include_registry: bool, include_table: bool,
+                   coll_rows: Optional[List[dict]] = None) -> Dict[str, Any]:
+        hb = self.heartbeat_doc()
+        if heartbeat_extra:
+            hb.update(heartbeat_extra)
+        doc: Dict[str, Any] = {
+            "identity": self._identity_dict(),
+            "clock": fleet.clock_sync_doc(),
+            "heartbeat": hb,
+        }
+        if include_registry:
+            doc["registry"] = fleet.registry_dump(
+                registry=self._registry,
+                identity=self._identity or fleet.get_identity())
+        if coll_rows is not None:
+            doc["coll_rows"] = list(coll_rows)
+        elif include_table:
+            obs = self._observatory
+            if obs is None:
+                from deepspeed_tpu.collectives import observatory as obs_mod
+
+                obs = obs_mod.get_observatory()
+                if not obs.enabled():
+                    obs = None
+            if obs is not None:
+                rows = obs.table_rows()
+                if rows:
+                    doc["coll_rows"] = rows
+        return doc
+
+    def push(self, heartbeat_extra: Optional[Dict[str, Any]] = None,
+             include_registry: bool = True,
+             include_table: bool = True,
+             coll_rows: Optional[List[dict]] = None
+             ) -> Optional[Dict[str, Any]]:
+        """One synchronous snapshot push (background-thread and shutdown
+        callers). ``heartbeat_extra`` merges caller facts into the
+        heartbeat (the resilience supervisor stamps rewind counts);
+        ``coll_rows`` ships an explicit observatory-row list instead of
+        pulling from the process observatory (tools/tests)."""
+        return self._send(self._build_doc(heartbeat_extra, include_registry,
+                                          include_table, coll_rows))
+
+    def push_async(self, heartbeat_extra: Optional[Dict[str, Any]] = None,
+                   include_registry: bool = True,
+                   include_table: bool = True) -> None:
+        """Hot-path push: snapshot NOW (sub-millisecond — dump + heartbeat
+        are dict walks), pay the HTTP round-trip on the client's worker
+        thread. One pending slot, latest-wins: snapshots are cumulative, so
+        an unsent older one is strictly superseded — a slow collector
+        back-pressures into dropped intermediate snapshots, never into the
+        caller's step."""
+        doc = self._build_doc(heartbeat_extra, include_registry,
+                              include_table)
+        self._ensure_worker()
+        with self._pending_lock:
+            self._pending = doc
+        self._pending_event.set()
+
+    def _send(self, doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        ack = self._post("/push", doc)
+        if ack is not None:
+            self.pushes += 1
+            if ack.get("clock_offset_s") is not None:
+                self.clock_offset_s = float(ack["clock_offset_s"])
+        return ack
+
+    def _ensure_worker(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+
+        def drain():
+            while True:
+                self._pending_event.wait()
+                with self._pending_lock:
+                    doc, self._pending = self._pending, None
+                    self._pending_event.clear()
+                    self._inflight = doc is not None
+                if doc is not None:
+                    try:
+                        self._send(doc)
+                    finally:
+                        self._inflight = False
+
+        self._inflight = False
+        self._worker = threading.Thread(
+            target=drain, name="dstpu-fleet-push-async", daemon=True)
+        self._worker.start()
+
+    def flush(self, timeout_s: float = 5.0) -> None:
+        """Wait until the async-pending slot drains (tests, shutdown)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._pending_lock:
+                idle = (self._pending is None
+                        and not self._pending_event.is_set()
+                        and not getattr(self, "_inflight", False))
+            if idle:
+                return
+            time.sleep(0.005)
+
+    # ------------------------------------------------------ background push
+    def start(self, interval_s: float = 5.0) -> "FleetClient":
+        """Register, then push on a daemon-thread cadence — the zero-touch
+        wiring the ``telemetry.fleet_url`` config key turns on."""
+        if self._thread is not None:
+            return self
+        self.register()
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                self.push()
+
+        self._thread = threading.Thread(
+            target=loop, name="dstpu-fleet-push", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, final_push: bool = True) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=self.timeout_s + 1.0)
+            self._thread = None
+        if final_push:
+            self.push()

@@ -36,14 +36,20 @@ def _resolve(tracer) -> Any:
 
 def chrome_trace_events(tracer=None) -> Dict[str, Any]:
     """Tracer buffer -> a Chrome trace-event JSON object (in memory)."""
+    from deepspeed_tpu.telemetry.fleet import get_identity
+
     tracer = _resolve(tracer)
+    ident = get_identity()
     pid = os.getpid()
     out: List[Dict[str, Any]] = [
         {
             "name": "process_name",
             "ph": "M",
             "pid": pid,
-            "args": {"name": "deepspeed_tpu"},
+            # identity in the Perfetto process label: two replicas' traces
+            # stop being indistinguishable "deepspeed_tpu" rows
+            "args": {"name": f"deepspeed_tpu {ident.proc} "
+                             f"{ident.role}@{ident.host}"},
         }
     ]
     # virtual-track labels (per-request serving tracks): thread_name metadata
@@ -111,6 +117,8 @@ def chrome_trace_events(tracer=None) -> Dict[str, Any]:
         "otherData": {
             "dropped_events": tracer.dropped_events,
             "metrics": tracer.registry.snapshot(),
+            "identity": ident.to_dict(),
+            "origin_unix": tracer.origin_unix(),
         },
     }
 
@@ -128,7 +136,16 @@ def export_chrome_trace(path: Optional[str] = None, tracer=None) -> str:
 
 
 def export_jsonl(path: Optional[str] = None, tracer=None) -> str:
-    """Write one JSON object per event; returns the path written."""
+    """Write one JSON object per event; returns the path written.
+
+    The stream opens with meta lines (``kind: process_meta`` — identity +
+    the wall-clock origin the event ``ts`` values are relative to — and one
+    ``kind: track_name`` per labelled virtual track), which is exactly what
+    ``tools/trace_merge.py`` needs to place this process's events on a
+    fleet-wide timeline with a distinct pid. Event lines are unchanged
+    (raw tracer schema plus ``pid``)."""
+    from deepspeed_tpu.telemetry.fleet import get_identity
+
     tracer = _resolve(tracer)
     path = path or tracer.jsonl_path or os.path.join(
         default_output_dir(), "events.jsonl")
@@ -136,6 +153,15 @@ def export_jsonl(path: Optional[str] = None, tracer=None) -> str:
     os.makedirs(d, exist_ok=True)
     pid = os.getpid()
     with open(path, "w") as f:
+        f.write(json.dumps({
+            "kind": "process_meta",
+            "identity": get_identity().to_dict(),
+            "origin_unix": tracer.origin_unix(),
+            "pid": pid,
+        }) + "\n")
+        for tid, tname in sorted(tracer.track_names().items()):
+            f.write(json.dumps({"kind": "track_name", "tid": tid,
+                                "track": tname, "pid": pid}) + "\n")
         for ev in tracer.events():
             f.write(json.dumps({"pid": pid, **ev}) + "\n")
     return path

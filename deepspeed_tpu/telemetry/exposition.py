@@ -74,8 +74,15 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
-def render_prometheus(registry=None) -> str:
-    """Render the whole registry in Prometheus text exposition format."""
+def render_prometheus(registry=None, identity=None) -> str:
+    """Render the whole registry in Prometheus text exposition format.
+
+    Every exposition carries a ``dstpu_process_info`` info-gauge stamped
+    with the process identity (run_id/proc/host/role — the Prometheus
+    "info metric" idiom), so a scrape is joinable across a fleet without
+    out-of-band bookkeeping. ``identity=False`` suppresses it (the fleet
+    collector's FEDERATED view is multi-process by construction — one
+    process_info row would be a lie)."""
     from deepspeed_tpu.telemetry.registry import bucket_upper_bound
 
     registry = _resolve_registry(registry)
@@ -108,6 +115,15 @@ def render_prometheus(registry=None) -> str:
                     f"{pname}_{q}{_labels_str(metric.labels)} {_fmt(s[q])}")
 
     lines: List[str] = []
+    if identity is not False:
+        if identity is None:
+            from deepspeed_tpu.telemetry.fleet import get_identity
+
+            identity = get_identity()
+        pname = PROM_PREFIX + "process_info"
+        lines.append(f"# HELP {pname} process identity (fleet join key)")
+        lines.append(f"# TYPE {pname} gauge")
+        lines.append(f"{pname}{_labels_str(identity.labels())} 1")
     for pname in sorted(families):
         fam = families[pname]
         lines.append(f"# HELP {pname} registry metric {fam['help']}")
@@ -123,11 +139,20 @@ def render_prometheus(registry=None) -> str:
     return "\n".join(lines) + "\n"
 
 
-def render_json_snapshot(registry=None, indent: Optional[int] = 2) -> str:
+def render_json_snapshot(registry=None, indent: Optional[int] = 2,
+                         identity=None) -> str:
     """The registry's flat snapshot as JSON (labelled keys preserved,
-    histogram summaries carry p50/p95/p99)."""
+    histogram summaries carry p50/p95/p99), stamped with the process
+    identity (``identity=False`` suppresses — the collector's federated
+    snapshot)."""
     registry = _resolve_registry(registry)
     doc = {"time_unix": time.time(), "metrics": registry.snapshot()}
+    if identity is not False:
+        if identity is None:
+            from deepspeed_tpu.telemetry.fleet import get_identity
+
+            identity = get_identity()
+        doc["identity"] = identity.to_dict()
     return json.dumps(doc, indent=indent, sort_keys=True)
 
 
@@ -155,56 +180,83 @@ def export_json_snapshot(path: Optional[str] = None, registry=None) -> str:
     return _write(path, render_json_snapshot(registry) + "\n")
 
 
-class MetricsServer:
-    """Opt-in ``/metrics`` HTTP endpoint (stdlib only, daemon thread).
+class RouteServer:
+    """Tiny stdlib HTTP server over a route table — THE one
+    daemon-thread/bind/handler implementation behind :class:`MetricsServer`
+    and the fleet :class:`~deepspeed_tpu.telemetry.collector.FleetCollector`.
 
-    ``GET /metrics`` serves the Prometheus text exposition (content type
-    ``text/plain; version=0.0.4``), ``GET /metrics.json`` the JSON snapshot.
-    ``port=0`` binds a free port (``server.port`` holds the real one) —
-    tests and multi-engine processes never collide. The handler renders at
-    request time, so a scraper always sees the live registry.
+    ``get_routes`` maps a path to ``fn() -> (body_bytes, content_type)``;
+    ``post_routes`` maps a path to ``fn(doc) -> ack_dict`` (body parsed as
+    JSON, ack serialized back; ``ValueError``/``KeyError`` from the handler
+    answer 400). ``port=0`` binds a free port (``.port`` holds the real
+    one). Handlers run per request, so every response reflects live state.
     """
 
-    def __init__(self, port: int = 0, host: str = "127.0.0.1", registry=None):
-        self._registry = _resolve_registry(registry)
+    def __init__(self, get_routes, post_routes=None, port: int = 0,
+                 host: str = "127.0.0.1", name: str = "dstpu-http"):
+        self._get_routes = dict(get_routes)
+        self._post_routes = dict(post_routes or {})
         self._host = host
         self._requested_port = port
+        self._name = name
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
         self.port: Optional[int] = None
 
-    def start(self) -> "MetricsServer":
+    def start(self) -> "RouteServer":
         if self._httpd is not None:
             return self
         import http.server
 
-        registry = self._registry
+        get_routes, post_routes = self._get_routes, self._post_routes
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 - stdlib handler contract
-                if self.path.split("?")[0] == "/metrics":
-                    body = render_prometheus(registry).encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif self.path.split("?")[0] == "/metrics.json":
-                    body = render_json_snapshot(registry).encode()
-                    ctype = "application/json"
-                else:
-                    self.send_error(404)
-                    return
-                self.send_response(200)
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
-            def log_message(self, *a):  # silence per-scrape stderr noise
+            def do_GET(self):  # noqa: N802 - stdlib handler contract
+                fn = get_routes.get(self.path.split("?")[0])
+                if fn is None:
+                    self.send_error(404)
+                    return
+                body, ctype = fn()
+                self._send(200, body, ctype)
+
+            def do_POST(self):  # noqa: N802 - stdlib handler contract
+                fn = post_routes.get(self.path.split("?")[0])
+                if fn is None:
+                    self.send_error(404)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    doc = json.loads(self.rfile.read(n).decode())
+                    if not isinstance(doc, dict):
+                        raise ValueError(
+                            f"body must be a JSON object, got "
+                            f"{type(doc).__name__}")
+                    ack = fn(doc)
+                # TypeError/AttributeError: a well-formed JSON object whose
+                # FIELDS have the wrong shape (e.g. a scalar heartbeat) must
+                # answer 400, not drop the connection with a stderr traceback
+                except (ValueError, KeyError, TypeError, AttributeError) as e:
+                    self._send(400, json.dumps(
+                        {"ok": False, "error": str(e)}).encode(),
+                        "application/json")
+                    return
+                self._send(200, json.dumps(ack).encode(), "application/json")
+
+            def log_message(self, *a):  # silence per-request stderr noise
                 pass
 
         self._httpd = http.server.ThreadingHTTPServer(
             (self._host, self._requested_port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="dstpu-metrics", daemon=True)
+            target=self._httpd.serve_forever, name=self._name, daemon=True)
         self._thread.start()
         return self
 
@@ -215,6 +267,68 @@ class MetricsServer:
             self._httpd = None
             self._thread = None
             self.port = None
+
+
+class MetricsServer:
+    """Opt-in ``/metrics`` HTTP endpoint (stdlib only, daemon thread).
+
+    ``GET /metrics`` serves the Prometheus text exposition (content type
+    ``text/plain; version=0.0.4``), ``GET /metrics.json`` the JSON snapshot.
+    ``port=0`` binds a free port (``server.port`` holds the real one) —
+    tests and multi-engine processes never collide. The handler renders at
+    request time, so a scraper always sees the live registry.
+
+    Fleet endpoints (``telemetry/fleet.py``):
+      - ``GET /healthz`` — liveness without parsing the full exposition:
+        process identity, last-step + age (``fleet.note_step``), registry
+        size. What the collector and the future elastic supervisor poll.
+      - ``GET /metrics.fleet`` — the MERGEABLE registry dump
+        (``fleet.registry_dump``: raw histogram buckets, not summaries) a
+        ``FleetCollector.scrape`` federates bit-exactly.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1", registry=None):
+        registry = _resolve_registry(registry)
+        self._registry = registry
+
+        def healthz():
+            from deepspeed_tpu.telemetry import fleet
+
+            doc = {
+                "ok": True,
+                "identity": fleet.get_identity().to_dict(),
+                **fleet.last_step_info(),
+                "registry_size": registry.size(),
+                "time_unix": time.time(),
+            }
+            return json.dumps(doc).encode(), "application/json"
+
+        def metrics_fleet():
+            from deepspeed_tpu.telemetry import fleet
+
+            return (json.dumps(fleet.registry_dump(registry)).encode(),
+                    "application/json")
+
+        self._server = RouteServer({
+            "/metrics": lambda: (
+                render_prometheus(registry).encode(),
+                "text/plain; version=0.0.4; charset=utf-8"),
+            "/metrics.json": lambda: (
+                render_json_snapshot(registry).encode(), "application/json"),
+            "/healthz": healthz,
+            "/metrics.fleet": metrics_fleet,
+        }, port=port, host=host, name="dstpu-metrics")
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.port
+
+    def start(self) -> "MetricsServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop()
 
 
 def serve_metrics(port: int = 0, host: str = "127.0.0.1", registry=None) -> MetricsServer:

@@ -61,6 +61,29 @@ def encode_labels(labels: Dict[str, object]) -> str:
         f'{k}="{labels[k]}"' for k in sorted(labels)) + "}"
 
 
+_KEY_LABEL_RE = None
+
+
+def decode_key(key: str) -> Tuple[str, Dict[str, str]]:
+    """Inverse of ``name + encode_labels(labels)``: split a flat registry
+    key back into (base name, label dict). The fleet collector re-labels
+    per-process gauges (``{proc=}``) from dump keys, so the parse must
+    round-trip exactly what :func:`encode_labels` writes — plain
+    ``k="v"`` pairs, no escaping (registry label values never contain
+    quotes; the Prometheus exposition escapes separately)."""
+    global _KEY_LABEL_RE
+    brace = key.find("{")
+    if brace < 0 or not key.endswith("}"):
+        return key, {}
+    if _KEY_LABEL_RE is None:
+        import re
+
+        _KEY_LABEL_RE = re.compile(r'([a-zA-Z0-9_]+)="([^"]*)"')
+    labels = {m.group(1): m.group(2)
+              for m in _KEY_LABEL_RE.finditer(key[brace + 1:-1])}
+    return key[:brace], labels
+
+
 class Counter:
     """Monotonic accumulator (e.g. ``comm/bytes``)."""
 
@@ -164,6 +187,48 @@ class Histogram:
         with self._lock:
             return sorted(self._buckets.items(),
                           key=lambda kv: -math.inf if kv[0] is None else kv[0])
+
+    def state(self) -> Dict[str, object]:
+        """Wire-portable full state (JSON-safe): summary scalars plus the
+        RAW sparse buckets — the piece a cross-process merge needs that
+        ``summary()`` drops. Bucket keys stringify (JSON objects can't key
+        on ints/None): ``"u"`` is the underflow bucket, ints are
+        ``str(idx)``."""
+        with self._lock:
+            return {
+                "count": self.count,
+                "total": self.total,
+                "min": self.min if self.count else 0.0,
+                "max": self.max if self.count else 0.0,
+                "last": self.last,
+                "buckets": {("u" if k is None else str(k)): v
+                            for k, v in self._buckets.items()},
+            }
+
+    def merge_state(self, state: Dict[str, object]) -> None:
+        """Fold another histogram's :meth:`state` into this one — EXACTLY
+        equivalent to having observed the other histogram's sample stream
+        here (bucket counts add, count/total add, min/max widen; ``last``
+        is taken from the incoming state, the per-process notion of
+        "latest" — label merged streams per process if that matters).
+        The log buckets make this exact by construction: a sample lands in
+        the same bucket no matter which process observed it."""
+        n = int(state.get("count", 0))
+        if n <= 0:
+            return
+        with self._lock:
+            self.count += n
+            self.total += float(state.get("total", 0.0))
+            self.last = float(state.get("last", 0.0))
+            s_min = float(state.get("min", 0.0))
+            s_max = float(state.get("max", 0.0))
+            if s_min < self.min:
+                self.min = s_min
+            if s_max > self.max:
+                self.max = s_max
+            for k, v in (state.get("buckets") or {}).items():
+                idx = None if k == "u" else int(k)
+                self._buckets[idx] = self._buckets.get(idx, 0) + int(v)
 
     def quantile(self, q: float) -> float:
         """Bounded-relative-error quantile estimate from the log buckets.
@@ -275,6 +340,13 @@ class MetricsRegistry:
             for n, h in self._histograms.items():
                 out[n] = h.summary()
             return out
+
+    def size(self) -> int:
+        """Number of registered metric children (labelled children count
+        individually) — the ``/healthz`` registry-size signal."""
+        with self._lock:
+            return (len(self._counters) + len(self._gauges)
+                    + len(self._histograms))
 
     def counters(self) -> Dict[str, float]:
         with self._lock:

@@ -128,6 +128,10 @@ class Tracer:
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
         self._origin = time.perf_counter()
+        # wall-clock stamp taken at the same instant as _origin: the anchor
+        # that lets tools/trace_merge.py place this process's origin-relative
+        # event stream on a fleet-wide timeline (telemetry/fleet.py)
+        self._origin_unix = time.time()
         self._last_counts: Dict[str, float] = {}
         # virtual-track names (e.g. per-request serving tracks): tid -> label,
         # exported as Chrome thread_name metadata so Perfetto shows the label
@@ -164,6 +168,7 @@ class Tracer:
             self._events = []
             self.dropped_events = 0
             self._origin = time.perf_counter()
+            self._origin_unix = time.time()
             self._last_counts = {}
             self._track_names = {}
             self._span_hists = {}
@@ -270,6 +275,12 @@ class Tracer:
         """The ``perf_counter`` stamp event ``ts`` values are relative to —
         for callers building deferred event batches (``append_events``)."""
         return self._origin
+
+    def origin_unix(self) -> float:
+        """Wall-clock time of the origin — the per-process anchor the trace
+        merger and the fleet collector's clock handshake align on. Every
+        event's absolute wall time is ``origin_unix() + ev["ts"]``."""
+        return self._origin_unix
 
     def append_events(self, evs: List[Dict[str, Any]]) -> None:
         """Append a pre-built event batch under ONE lock acquisition.

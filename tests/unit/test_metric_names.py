@@ -28,6 +28,7 @@ ALLOWED_SUBSYSTEMS = {
     "comm",
     "compile",
     "data",
+    "fleet",
     "flops",
     "hbm",
     "health",
@@ -110,11 +111,16 @@ def test_lint_scans_telemetry_and_serving_sources():
     scanned = {os.path.relpath(p, REPO_ROOT) for p in _python_files()}
     expected = {
         os.path.join("deepspeed_tpu", "telemetry", f)
-        for f in ("tracer.py", "registry.py", "exposition.py")
+        for f in ("tracer.py", "registry.py", "exposition.py",
+                  # fleet telemetry plane (ISSUE 13): the federation layer
+                  # mints the fleet/* rollup series
+                  "fleet.py", "collector.py")
     } | {
         os.path.join("deepspeed_tpu", "inference", f)
         for f in ("engine_v2.py", "lifecycle.py", "router.py")
-    } | {os.path.join("tools", "bench_serving.py")}
+    } | {os.path.join("tools", "bench_serving.py"),
+         os.path.join("tools", "fleet_smoke.py"),
+         os.path.join("tools", "trace_merge.py")}
     missing = expected - scanned
     assert not missing, f"metric-minting files escaped the lint walk: {sorted(missing)}"
 
@@ -129,7 +135,10 @@ def test_known_names_pass_and_bad_names_fail():
                  # serving-tier metrics (ISSUE 12)
                  "router/shed_requests", "router/replica_queue_depth",
                  "serving/prefix_hit_rate", "serving/spec_accept_rate",
-                 "serving/readmit_wait_ms"):
+                 "serving/readmit_wait_ms",
+                 # fleet telemetry plane (ISSUE 13)
+                 "fleet/goodput", "fleet/tokens_per_s", "fleet/step_rate_min",
+                 "fleet/straggler", "fleet/clock_offset_s"):
         assert _check_name(good) is None, good
     for bad in ("ttft", "Serving/ttft", "serving ttft", "{x}/y", "bogus/name"):
         assert _check_name(bad) is not None, bad

@@ -181,8 +181,13 @@ def test_jsonl_export_one_event_per_line(tmp_path):
     tr.instant("b", k=1)
     path = telemetry.export_jsonl(str(tmp_path / "events.jsonl"))
     lines = [json.loads(l) for l in open(path) if l.strip()]
-    assert {l["name"] for l in lines} == {"a", "b"}
-    assert all("pid" in l and "ts" in l for l in lines)
+    # stream opens with the fleet meta line (identity + origin anchor)
+    assert lines[0]["kind"] == "process_meta"
+    assert "run_id" in lines[0]["identity"] and "origin_unix" in lines[0]
+    evs = [l for l in lines
+           if l.get("kind") in ("span", "instant", "flow", "counter")]
+    assert {l["name"] for l in evs} == {"a", "b"}
+    assert all("pid" in l and "ts" in l for l in evs)
 
 
 # ------------------------------------------------------- engine + monitoring

@@ -116,9 +116,29 @@ coll_rc=${PIPESTATUS[0]}
 [ "${coll_rc}" -ne 0 ] && rc=1
 echo "# collective observatory: ${COLL_OUT} (exit ${coll_rc})" >> "${OUT}"
 
+# Fleet telemetry smoke (ISSUE 13): an in-process collector + 2 real CPU
+# worker processes — exit-gates on the federated counters BIT-EXACTLY
+# equaling the per-process sums, on a merged Perfetto trace containing
+# flow-linked spans from both worker processes (router admission arrow ->
+# remote serve:dispatch slice), on every worker landing in the health
+# ledger with a clock offset, and on the federated observatory table
+# round-tripping into a fresh selector's measured mode. Committed as its
+# own artifact so the fleet plane is auditable per round.
+FLEET_OUT="FLEET_${ROUND}.log"
 {
-  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, program report: ${prog_rc}, coll report: ${coll_rc})"
+  echo "# fleet telemetry smoke — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  echo "# HEAD: ${HEAD_SHA}"
+  echo "# uncommitted-diff sha256: ${DIFF_SHA}"
+  echo "# cmd: python tools/fleet_smoke.py"
+} > "${FLEET_OUT}"
+JAX_PLATFORMS=cpu python tools/fleet_smoke.py 2>/dev/null | tee -a "${FLEET_OUT}"
+fleet_rc=${PIPESTATUS[0]}
+[ "${fleet_rc}" -ne 0 ] && rc=1
+echo "# fleet smoke: ${FLEET_OUT} (exit ${fleet_rc})" >> "${OUT}"
+
+{
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
-echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT}"
+echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT}"
 exit "${rc}"
