@@ -13,7 +13,13 @@ from deepspeed_tpu.inference.engine_v2 import (
     RaggedInferenceConfig,
     build_hf_engine,
 )
+from deepspeed_tpu.inference.migrate import MigrationTicket, remote_copy_pages
 from deepspeed_tpu.inference.model import KVCache, decode_step, init_cache, prefill
+from deepspeed_tpu.inference.paged import (
+    MigrationBuffer,
+    export_pool_blocks,
+    import_pool_blocks,
+)
 from deepspeed_tpu.inference.ragged import BlockedAllocator, PrefixCache, StateManager
 from deepspeed_tpu.inference.router import ServingRouter
 from deepspeed_tpu.inference.sampling import greedy_tokens, sample_logits

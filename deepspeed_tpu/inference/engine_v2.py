@@ -42,8 +42,11 @@ from deepspeed_tpu.config.config_utils import DeepSpeedConfigModel
 from deepspeed_tpu.inference.config import QuantConfig, ServingSLOConfig
 from deepspeed_tpu.inference.lifecycle import LifecycleTracker
 from deepspeed_tpu.inference.paged import (
+    MigrationBuffer,
     PagedKVPool,
     copy_pool_blocks,
+    export_pool_blocks,
+    import_pool_blocks,
     init_pool,
     ragged_decode_chain,
     ragged_forward,
@@ -117,6 +120,18 @@ class RaggedInferenceConfig(DeepSpeedConfigModel):
     # Lookups key on token-chain hashes either way, so latency-critical
     # deployments can turn the fetch off without changing cache behavior.
     prefix_cache_hash_bytes: bool = True
+    # Disaggregated serving role (ISSUE 14): which phase this replica serves
+    # under a phase-aware ServingRouter. "mixed" (default) serves both —
+    # the engine-only behavior, byte-identical to before. "prefill" replicas
+    # take fresh admissions and hand finished prefills to the decode pool
+    # via KV-block migration; "decode" replicas never take fresh admissions,
+    # they re-admit migrated requests and run their decode chains. The role
+    # only steers the router's placement — every engine can run every
+    # program (that is what the mixed-mode fallback relies on).
+    role: str = "mixed"
+    # In-flight post-prefill export cap per replica (double-buffered page
+    # streaming: the export of request N overlaps the prefill of N+1).
+    migration_depth: int = 2
     # Speculative decoding (ISSUE 12): number of draft tokens verified per
     # model forward inside the decode chain (0 = off). Drafts come from an
     # on-device n-gram (prompt-lookup) proposer over the row's history;
@@ -142,6 +157,13 @@ class RaggedInferenceConfig(DeepSpeedConfigModel):
         from deepspeed_tpu.inference.config import _DTYPES
 
         return _DTYPES[self.dtype.lower()]
+
+    @property
+    def validated_role(self) -> str:
+        if self.role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role must be prefill|decode|mixed, got {self.role!r}")
+        return self.role
 
     @property
     def kv_quant(self) -> Optional[str]:
@@ -202,6 +224,7 @@ class InferenceEngineV2:
             config = {}
         if isinstance(config, dict):
             config = RaggedInferenceConfig(**config)
+        config.validated_role  # raise on a bad disagg role before any work
         self.model_config = model_config
         self.config = config
         if mesh is None:
@@ -673,6 +696,132 @@ class InferenceEngineV2:
         self.prefill_tokens_total += len(cand)
         self.prefill_tokens_cached += reuse
         return cand[reuse:]
+
+    # ------------------------------------------------------------- migration
+    def _export_fn(self, pages: int):
+        """Block-export gather program (paged.export_pool_blocks): block ids
+        ride as traced values, so one compiled program per page bucket
+        serves every migration. NOT donated — the source pool stays live
+        (the source keeps serving while the pages stream out)."""
+        key = ("export", pages)
+        if key not in self._step_cache:
+            bs = self.config.kv_block_size
+
+            @jax.jit
+            def export(pool, blocks):
+                return export_pool_blocks(pool, blocks, bs)
+
+            self._step_cache[key] = self._watch(export, "export", f"p{pages}")
+        return self._step_cache[key]
+
+    def _import_fn(self, pages: int):
+        """Block-import scatter program (paged.import_pool_blocks): the
+        destination pool is donated like every other pool-mutating step."""
+        key = ("import", pages)
+        if key not in self._step_cache:
+            bs = self.config.kv_block_size
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def imp(pool, buf, blocks, n_valid):
+                return import_pool_blocks(pool, buf, blocks, n_valid, bs)
+
+            self._step_cache[key] = self._watch(imp, "import", f"p{pages}")
+        return self._step_cache[key]
+
+    @staticmethod
+    def _page_bucket(n: int) -> int:
+        """Round a migration's page count up to the next power of two so a
+        handful of compiled export/import programs serve every request
+        length (the same static-shape discipline as the step buckets)."""
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def export_request(self, uid: int) -> Dict[str, Any]:
+        """Export ``uid``'s KV blocks as a contiguous migration buffer
+        (ISSUE 14): a read-only gather in block-table order — quantized
+        bytes verbatim, scale pages riding along, refcounts untouched (a
+        block the prefix cache shares is exported without disturbing its
+        holders; the source releases its OWN reference only at ``flush``
+        after the import commits). The dispatch is asynchronous: the pages
+        stream out while the host assembles the next prefill."""
+        seq = self.state.get(uid)
+        if seq is None or seq.n_blocks == 0:
+            raise ValueError(f"uid {uid} has no KV blocks to export")
+        n = seq.n_blocks
+        pages = self._page_bucket(n)
+        padded = np.zeros((pages,), np.int32)
+        padded[:n] = seq.blocks
+        with self._tracer.span("serve:export", uid=uid, blocks=n):
+            buf = self._export_fn(pages)(self.pool, jnp.asarray(padded))
+        self.dispatch_count += 1
+        return {"buffer": buf, "n_blocks": n, "pages": pages,
+                "seen_tokens": seq.seen_tokens,
+                "kv_dtype": str(jnp.dtype(self.pool.k.dtype)),
+                "quant": self.pool.quant,
+                "block_size": self.config.kv_block_size}
+
+    def can_import(self, n_blocks: int) -> bool:
+        """Whether an ``n_blocks`` migration could be admitted right now
+        (seq slot + free blocks after LRU cache eviction) — the refusal
+        path the router consults so a rejected import leaves the request
+        on its source instead of dropping it."""
+        if self.state.n_active >= self.config.max_seqs:
+            return False
+        pc = self.prefix_cache
+        while self.state.free_blocks < n_blocks and pc is not None \
+                and pc.evict_one():
+            pass
+        return self.state.free_blocks >= n_blocks
+
+    def import_request(self, uid: int, export: Dict[str, Any]) -> bool:
+        """Import an ``export_request`` ticket as a fresh sequence ``uid``:
+        allocate destination blocks (any fragmentation — the scatter IS the
+        block-table rewrite), scatter the buffer verbatim, and register the
+        descriptor with the source's ``seen_tokens``. Returns False —
+        destination state unchanged — when capacity refuses; raises on a
+        layout mismatch (pools that disagree on dtype/geometry are a
+        deployment error, not a capacity condition)."""
+        if export["block_size"] != self.config.kv_block_size or \
+                export["quant"] != self.pool.quant or \
+                export["kv_dtype"] != str(jnp.dtype(self.pool.k.dtype)):
+            raise ValueError(
+                f"migration layout mismatch: source "
+                f"(bs={export['block_size']}, quant={export['quant']}, "
+                f"dtype={export['kv_dtype']}) vs destination "
+                f"(bs={self.config.kv_block_size}, quant={self.pool.quant}, "
+                f"dtype={jnp.dtype(self.pool.k.dtype)})")
+        buf: MigrationBuffer = export["buffer"]
+        if buf.k.shape[0] != self.pool.k.shape[0] or \
+                buf.k.shape[2:] != self.pool.k.shape[2:]:
+            raise ValueError(
+                f"migration layout mismatch: buffer pages {buf.k.shape} vs "
+                f"pool {self.pool.k.shape}")
+        n = export["n_blocks"]
+        if not self.can_import(n):
+            return False
+        dst_blocks = self.state.allocator.allocate(n)
+        pages = export["pages"]
+        padded = np.zeros((pages,), np.int32)
+        padded[:n] = dst_blocks
+        try:
+            with self._tracer.span("serve:import", uid=uid, blocks=n):
+                self.pool = self._import_fn(pages)(
+                    self.pool, buf, jnp.asarray(padded), jnp.int32(n))
+        except BaseException:
+            # the scatter never committed (self.pool rebinds only on
+            # success): return the allocation so a failed import — which
+            # the router degrades, not drops — cannot leak destination
+            # capacity attempt over attempt
+            self.state.allocator.free(dst_blocks)
+            raise
+        self.dispatch_count += 1
+        seq = self.state.get_or_create(uid)
+        assert seq.seen_tokens == 0 and seq.n_blocks == 0
+        seq.append_blocks(dst_blocks)
+        seq.seen_tokens = export["seen_tokens"]
+        return True
 
     def chain_window(self, budgets: Sequence[int], k: int) -> List[int]:
         """KV tokens one K-step chain may consume per row: each of the K

@@ -62,7 +62,8 @@ class RequestRecord:
     __slots__ = ("rid", "uid", "arrival", "admit", "first_admit", "first_token",
                  "last_emit", "finish", "tokens", "chains", "preemptions",
                  "readmissions", "decode_s", "dispatch_stamps", "phase",
-                 "last_preempt", "replica", "flow_id", "flow_name")
+                 "last_preempt", "last_migrate", "migrations", "replica",
+                 "flow_id", "flow_name")
 
     def __init__(self, rid: int, arrival: float):
         self.rid = rid
@@ -78,6 +79,8 @@ class RequestRecord:
         self.admit: Optional[float] = None  # most recent admission
         self.first_admit: Optional[float] = None
         self.last_preempt: Optional[float] = None  # readmit-wait anchor
+        self.last_migrate: Optional[float] = None  # migration-wait anchor
+        self.migrations = 0  # completed prefill->decode pool migrations
         self.replica: Optional[int] = None  # router affinity (None = local)
         self.first_token: Optional[float] = None
         self.last_emit: Optional[float] = None  # previous boundary stamp
@@ -122,6 +125,7 @@ class RequestRecord:
             "first_token": self.first_token, "finish": self.finish,
             "tokens": self.tokens, "chains": self.chains,
             "preemptions": self.preemptions, "readmissions": self.readmissions,
+            "migrations": self.migrations,
         }
 
 
@@ -165,6 +169,10 @@ class LifecycleTracker:
             self._c_finished = reg.counter("serving/requests_finished", **lb)
             self._c_readmit = reg.counter("serving/readmissions", **lb)
             self._h_readmit = reg.histogram("serving/readmit_wait_ms", **lb)
+            # disaggregated serving (ISSUE 14): KV-block migration stamps
+            self._h_migration = reg.histogram("serving/migration_ms", **lb)
+            self._c_mig_blocks = reg.counter("serving/migrated_blocks", **lb)
+            self._c_mig_fail = reg.counter("serving/migration_failures", **lb)
             self._c_slo_met = reg.counter("serving/slo_met", **lb)
             self._c_slo_missed = reg.counter("serving/slo_missed", **lb)
             self._g_goodput = reg.gauge("serving/goodput", **lb)
@@ -218,14 +226,21 @@ class LifecycleTracker:
             if self._emit:
                 self._h_queue.observe((now - rec.arrival) * 1e3)
         else:
-            # re-admission after preemption: the wait lands in its OWN
-            # histogram; queue_wait stays pinned to the first admission and
-            # TTFT stays measured from the ORIGINAL arrival (never restarted
-            # — the fake-clock test pins both)
+            # re-admission after preemption or migration: the wait lands in
+            # its OWN histogram; queue_wait stays pinned to the first
+            # admission and TTFT stays measured from the ORIGINAL arrival
+            # (never restarted — the fake-clock tests pin both). The anchor
+            # is the LATEST hand-off stamp (preempt or migrate-start), never
+            # the arrival when one exists: anchoring at arrival would
+            # re-count the queue/defer window a deferred-then-migrated
+            # request already spent before its first admission (ISSUE 14
+            # small fix — defer and migration waits are disjoint intervals).
             rec.readmissions += 1
             if self._emit:
                 self._c_readmit.add(1.0)
-                anchor = rec.last_preempt if rec.last_preempt is not None else rec.arrival
+                stamps = [s for s in (rec.last_preempt, rec.last_migrate)
+                          if s is not None]
+                anchor = max(stamps) if stamps else rec.arrival
                 self._h_readmit.observe((now - anchor) * 1e3)
         self._record_to_recorder(rec)
 
@@ -312,6 +327,72 @@ class LifecycleTracker:
         if self._emit:
             self._win_preempts.append(now)
         self._record_to_recorder(rec)
+
+    # ------------------------------------------------------------ migration
+    def migrate_start(self, rid: int, now: Optional[float] = None) -> None:
+        """Stamp the start of a post-prefill KV-block migration (the export
+        dispatch). The TPOT chain breaks here — decode pauses while the
+        pages stream, and that pause is charged to ``serving/migration_ms``
+        / ``serving/readmit_wait_ms`` (anchored at this stamp), never to
+        per-token latency."""
+        now = self._now(now)
+        rec = self._records.get(rid)
+        if rec is None:
+            return
+        rec.phase = "migrating"
+        rec.last_migrate = now
+        rec.last_emit = None  # TPOT chain restarts on the decode replica
+        self._record_to_recorder(rec)
+
+    def transfer(self, rid: int, dst: "LifecycleTracker"
+                 ) -> Optional[RequestRecord]:
+        """Hand a request's record to the destination replica's tracker (the
+        in-process analog of the trace context crossing a process boundary):
+        TTFT/queue-wait history travels with it — finish-side metrics land
+        under the DESTINATION's labels, arrival-side ones already landed
+        under the source's."""
+        rec = self._records.pop(rid, None)
+        if rec is None:
+            return None
+        dst._records[rid] = rec
+        dst._record_to_recorder(rec)
+        return rec
+
+    def migrated(self, rid: int, n_blocks: int,
+                 now: Optional[float] = None) -> None:
+        """Record a COMPLETED migration on the destination tracker:
+        ``serving/migration_ms`` = export-dispatch -> import-committed,
+        ``serving/migrated_blocks`` counts the pages moved."""
+        now = self._now(now)
+        rec = self._records.get(rid)
+        if rec is None:
+            return
+        rec.migrations += 1
+        rec.phase = "decoding" if rec.first_token is not None else "prefill"
+        if self._emit:
+            anchor = rec.last_migrate if rec.last_migrate is not None else now
+            self._h_migration.observe((now - anchor) * 1e3)
+            self._c_mig_blocks.add(float(n_blocks))
+        self._record_to_recorder(rec)
+
+    def migrate_retry(self, rid: int) -> None:
+        """A failed import attempt whose migration will be RETRIED (the
+        source pool cannot host the request's decode window): counts in
+        ``serving/migration_failures`` — one per attempt, matching the
+        router's attempt-level accounting — with the request's phase
+        staying ``migrating``."""
+        if self._emit:
+            self._c_mig_fail.add(1.0)
+
+    def migrate_failed(self, rid: int) -> None:
+        """A migration that could not import: the request resumes decoding
+        on its SOURCE replica (mixed-mode fallback — never dropped)."""
+        rec = self._records.get(rid)
+        if rec is not None:
+            rec.phase = "decoding" if rec.first_token is not None else "prefill"
+            self._record_to_recorder(rec)
+        if self._emit:
+            self._c_mig_fail.add(1.0)
 
     def _meets_slo_counted(self, rec: RequestRecord, now: float) -> None:
         met = self._meets_slo(rec)

@@ -350,6 +350,66 @@ def ragged_decode_chain(
     return outs.T, emitted, active, rng, pool
 
 
+class MigrationBuffer(NamedTuple):
+    """Contiguous, block-table-ordered page buffer for KV-block migration
+    (ISSUE 14): one request's pool pages — values AND scale pages, the PR-10
+    layout travelling as a unit — gathered in block-table order so the
+    destination can scatter them into an arbitrarily fragmented allocation
+    with the block table rewritten. The bytes are the pool's bytes verbatim
+    (int8/fp8 values stay int8/fp8, fp32 scales stay fp32): migration never
+    re-quantizes, so the blake2b content identity of every block survives
+    and prefix-cache entries stay valid at the destination."""
+
+    k: jax.Array  # [L, pages*bs, kvH, hd], pool value dtype
+    v: jax.Array
+    k_scale: Optional[jax.Array] = None  # [L, pages*bs, kvH, 1] fp32
+    v_scale: Optional[jax.Array] = None
+
+
+def export_pool_blocks(pool: PagedKVPool, blocks: jax.Array,
+                       block_size: int) -> MigrationBuffer:
+    """Gather ``blocks`` (block ids, block-table order, [B] int32 traced) out
+    of the pool into one contiguous :class:`MigrationBuffer`. A pure gather —
+    the quantized bytes move verbatim; block ids ride as traced values so ONE
+    compiled program serves every migration of the same page bucket. Pad
+    entries (callers bucket B) may repeat any valid block; the host slices
+    the valid prefix by ``n_blocks``."""
+    slots = (blocks[:, None] * block_size
+             + jnp.arange(block_size)[None, :]).reshape(-1)
+
+    def g(a):
+        return None if a is None else a[:, slots]
+
+    return MigrationBuffer(k=g(pool.k), v=g(pool.v),
+                           k_scale=g(pool.k_scale), v_scale=g(pool.v_scale))
+
+
+def import_pool_blocks(pool: PagedKVPool, buf: MigrationBuffer,
+                       blocks: jax.Array, n_valid: jax.Array,
+                       block_size: int) -> PagedKVPool:
+    """Scatter a :class:`MigrationBuffer` into ``blocks`` of the destination
+    pool — the block-table rewrite made physical. ``blocks`` is the
+    DESTINATION allocation (any fragmentation; ids need not be contiguous or
+    ordered), ``n_valid`` masks the bucket's pad entries (their writes index
+    out of bounds and drop). Dtypes must match the destination pool exactly:
+    the scatter is verbatim bytes, never a convert — the caller validates
+    layout compatibility so quantized pages are never re-quantized."""
+    B = blocks.shape[0]
+    slots = blocks[:, None] * block_size + jnp.arange(block_size)[None, :]
+    valid = jnp.arange(B)[:, None] < n_valid
+    oob = pool.k.shape[1]  # one past the trash slot: dropped by the scatter
+    slots = jnp.where(valid, slots, oob).reshape(-1)
+
+    def s(dst, src):
+        if dst is None:
+            return None
+        return dst.at[:, slots].set(src, mode="drop")
+
+    return PagedKVPool(k=s(pool.k, buf.k), v=s(pool.v, buf.v),
+                       k_scale=s(pool.k_scale, buf.k_scale),
+                       v_scale=s(pool.v_scale, buf.v_scale))
+
+
 def copy_pool_blocks(pool: PagedKVPool, src: jax.Array, dst: jax.Array,
                      block_size: int) -> PagedKVPool:
     """Copy one block's slots (values + scale pages together — the PR-10

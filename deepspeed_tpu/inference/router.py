@@ -1,6 +1,6 @@
 """SLO-aware continuous-batching router over N engine_v2 replicas.
 
-The serving tier's front end (ROADMAP open item 1a): one process-level
+The serving tier's front end (ROADMAP open items 1a/2): one process-level
 scheduler dispatching requests over N :class:`InferenceEngineV2` replicas.
 The engines' serving loop (``generate``) stays the single-replica path; the
 router drives the same primitives directly — ``can_schedule`` admission,
@@ -9,39 +9,64 @@ every fast-path invariant (one dispatch + one host sync per K tokens,
 on-device sampling, prefix-cache reuse, speculative chains) holds per
 replica unchanged.
 
-Scheduling model (single-threaded, chain-granular):
+Scheduling model (thread-per-replica, chain-granular):
 
-  - **Assignment**: an arrived request is bound to the least-loaded replica.
-    The load signal is the same per-replica ``serving/queue_depth`` /
-    ``serving/goodput`` state the PR-5 gauges expose — assigned-but-waiting
-    plus active rows, discounted by the replica's rolling goodput (a replica
-    missing its SLO window attracts less new load).
-  - **SLO-aware admission** (``serving_slo`` config block): before a prefill
-    is dispatched, the request's projected TTFT — wait so far plus the
-    replica's EMA time-to-first-token — is checked against
-    ``ttft_ms * admission_ttft_factor``. ``admission="shed"`` rejects a
-    request that can no longer make its budget (it returns ``None`` and
-    stops consuming queue capacity that on-budget requests could use);
-    ``"defer"`` holds it queued while any replica could still make the
-    budget and sheds only when none can. Shedding happens strictly BEFORE
-    admission: an admitted request is never dropped (the nightly router
-    smoke gates on exactly that).
+  - **Dispatch loop**: every replica runs its own round loop on its own
+    thread (``dispatch="threads"``, the default for >1 replica): host
+    bookkeeping serializes under one router lock, but the DISPATCHES — the
+    part that blocks on the device — run concurrently, so a prefill-pool
+    replica's long prefill no longer delays a decode-pool replica's chain
+    boundaries (ROADMAP #1 "one thread per replica"). ``dispatch="serial"``
+    keeps the single-threaded walk (deterministic round ordering for
+    debugging). Each replica threads its own committed PRNG key
+    (``fold_in(seed, replica)``) — greedy output is unaffected.
+  - **Assignment**: an arrived request is bound to the least-loaded replica
+    among those that serve prefills. The load signal is the same
+    per-replica ``serving/queue_depth`` / ``serving/goodput`` state the
+    PR-5 gauges expose.
+  - **SLO-aware admission** (``serving_slo`` config block): unchanged from
+    PR 12 — projected TTFT judged BEFORE the prefill dispatch;
+    ``admission="shed"`` rejects, ``"defer"`` holds/rebinds while any
+    prefill-capable replica could still make the budget. Shedding happens
+    strictly BEFORE admission: an admitted request is never dropped.
+  - **Phase-aware placement** (ISSUE 14): replicas declare a role —
+    ``prefill`` | ``decode`` | ``mixed`` (``RaggedInferenceConfig.role``).
+    Fresh admissions route to the prefill pool; when a prefill-role
+    replica finishes a request's prefill, the router enqueues a KV-block
+    **migration**: the source exports the request's (values + scale) pages
+    as one contiguous buffer (``engine.export_request`` — quantized bytes
+    verbatim, asynchronous dispatch double-buffered against the next
+    prefill), and the destination decode replica imports it at its next
+    round (``engine.import_request`` — allocate + scatter, block table
+    rewritten), re-admits the request, and continues its decode chains.
+    TTFT stays pinned to the ORIGINAL arrival (the first token was served
+    by the prefill replica); the TPOT chain restarts cleanly on the decode
+    replica; ``serving/migration_ms|migrated_blocks|migration_failures``
+    stamp the data plane. A migration that cannot import (destination
+    capacity, any failure) leaves the request live on its SOURCE replica,
+    which degrades to mixed-mode serving for it — and an empty prefill or
+    decode pool degrades the whole roster to mixed placement. Admitted
+    requests are never dropped, migrated or not.
   - **Replica-affine re-admission**: a preemption at a chain boundary
-    re-queues the request pinned to its replica, so its prefix-cache
-    blocks there (PR-12 content-hash reuse) make the re-prefill nearly
-    free — the preempted context re-enters through the cache instead of
-    recomputing.
+    re-queues the request pinned to its replica (where its prefix-cache
+    blocks live) — under disagg that is the decode replica, which then
+    re-prefills locally (mixed-mode for that request).
 
 Observability: per-replica ``LifecycleTracker``s (labels ``{"replica": i}``)
 feed the standard ``serving/*`` SLO metrics per replica, ``router/*``
-counters/gauges cover the router's own decisions, and each replica gets its
-own Perfetto track with one slice per dispatched program.
+counters/gauges cover the router's own decisions, each replica gets its own
+Perfetto track, and every migration emits a ``serve:migrate`` span on the
+destination with an in-span flow step bound to the request's fleet
+``TraceContext`` — in a multi-process deployment ``tools/trace_merge.py``
+joins the prefill-replica arrow onto the decode-replica slice.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
+from contextlib import nullcontext
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -49,8 +74,13 @@ import numpy as np
 
 from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.inference.lifecycle import LifecycleTracker
+from deepspeed_tpu.inference.migrate import (
+    DEFAULT_MIGRATION_DEPTH,
+    MigrationTicket,
+)
 from deepspeed_tpu.telemetry import get_tracer
 from deepspeed_tpu.telemetry import fleet
+from deepspeed_tpu.utils.logging import logger
 
 # virtual Perfetto track ids for replica tracks (request tracks live at
 # lifecycle.TRACK_BASE = 0x5E51_0000; replicas get their own range)
@@ -60,13 +90,21 @@ REPLICA_TRACK_BASE = 0x5E52_0000
 class _Replica:
     """Router-side view of one engine replica."""
 
-    def __init__(self, index: int, engine: InferenceEngineV2):
+    def __init__(self, index: int, engine: InferenceEngineV2,
+                 role: Optional[str] = None):
         self.index = index
         self.engine = engine
+        self.role = role if role is not None else engine.config.validated_role
         self.active: Dict[int, int] = {}  # uid -> rid
         self.order: Dict[int, None] = {}  # admission order (insertion-ordered)
         self.assigned: deque = deque()  # rids bound here, not yet admitted
         self.tracker: Optional[LifecycleTracker] = None
+        # migration plumbing (ISSUE 14)
+        self.migrate_in: deque = deque()   # inbound MigrationTickets
+        self.await_export: deque = deque()  # rids awaiting an export slot
+        self.migrating: set = set()        # rids in limbo (skip decode here)
+        self.tickets: List[MigrationTicket] = []  # outbound, in flight
+        self.rng: Optional[jax.Array] = None  # per-replica committed key
         # host-observed EMAs (seconds): the admission gate's TTFT projection
         self.prefill_ema = 0.0
         self.chain_ema = 0.0
@@ -87,29 +125,116 @@ class _Replica:
         cur = getattr(self, attr)
         setattr(self, attr, value if cur == 0.0 else (1 - alpha) * cur + alpha * value)
 
+    def has_work(self) -> bool:
+        return bool(self.assigned or self.active or self.migrate_in
+                    or self.await_export or self.tickets)
+
+
+class _Serve:
+    """Mutable state of one ``serve()`` call, shared across replica threads
+    (every mutation happens under the router lock)."""
+
+    def __init__(self, prompts, arr, t_start, max_new_tokens, eos_token_id,
+                 sample_kw, spec):
+        self.prompts = prompts
+        self.arr = arr
+        self.t_start = t_start
+        self.max_new_tokens = max_new_tokens
+        self.eos = eos_token_id
+        self.sample_kw = sample_kw
+        self.spec = spec
+        n = len(prompts)
+        self.pending: deque = deque(sorted(range(n), key=lambda i: arr[i]))
+        self.gen: Dict[int, List[int]] = {i: [] for i in range(n)}
+        self.outputs: Dict[int, Optional[np.ndarray]] = {}
+        self.affinity: List[Optional[int]] = [None] * n
+        self.admitted_once: set = set()
+        self.next_uid = 0
+        self.abort: Optional[BaseException] = None
+
+    def context(self, idx: int) -> np.ndarray:
+        return np.concatenate(
+            [self.prompts[idx], np.asarray(self.gen[idx], np.int32)])
+
 
 class ServingRouter:
     """Continuous-batching front end over N engine replicas.
 
-    ``engines`` must share model/config semantics (the router assumes any
-    replica can serve any request). ``slo`` defaults to the first engine's
-    ``serving_slo`` block; ``clock`` is injectable so the admission gate is
-    testable against a fake clock.
+    ``engines`` must share model/config semantics AND — when roles are
+    specialized — an identical KV-pool layout (block size, storage dtype,
+    quantization mode): migration moves pool bytes verbatim. ``slo``
+    defaults to the first engine's ``serving_slo`` block; ``clock`` is
+    injectable so the admission gate is testable against a fake clock;
+    ``roles`` overrides the engines' ``config.role``; ``dispatch`` picks
+    the replica-round execution: ``"threads"`` (default for >1 replica)
+    runs one loop thread per replica, ``"serial"`` keeps the
+    single-threaded walk.
     """
 
     def __init__(self, engines: Sequence[InferenceEngineV2], slo=None,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, roles: Optional[Sequence[str]] = None,
+                 dispatch: str = "auto"):
         if not engines:
             raise ValueError("ServingRouter needs at least one engine replica")
-        self.replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        if roles is not None and len(roles) != len(engines):
+            raise ValueError(
+                f"{len(roles)} roles for {len(engines)} engines")
+        self.replicas = [
+            _Replica(i, e, role=None if roles is None else roles[i])
+            for i, e in enumerate(engines)]
+        for rep in self.replicas:
+            if rep.role not in ("prefill", "decode", "mixed"):
+                raise ValueError(
+                    f"replica {rep.index}: role must be prefill|decode|mixed, "
+                    f"got {rep.role!r}")
+        if dispatch not in ("auto", "threads", "serial"):
+            raise ValueError(
+                f"dispatch must be auto|threads|serial, got {dispatch!r}")
+        self.dispatch = ("threads" if len(engines) > 1 else "serial") \
+            if dispatch == "auto" else dispatch
+        # disagg placement is live only when BOTH phases have a home:
+        # an empty prefill or decode pool degrades to mixed placement
+        specialized = any(r.role != "mixed" for r in self.replicas)
+        prefill_ok = any(r.role in ("prefill", "mixed") for r in self.replicas)
+        decode_ok = any(r.role in ("decode", "mixed") for r in self.replicas)
+        self.disagg = specialized and prefill_ok and decode_ok
+        if specialized and not self.disagg:
+            logger.warning(
+                "ServingRouter: specialized roles "
+                f"{[r.role for r in self.replicas]} leave a phase without a "
+                "pool — degrading to mixed placement (no migration)")
+            for rep in self.replicas:
+                rep.role = "mixed"
+        if self.disagg:
+            # migration moves pool bytes verbatim: layouts must agree
+            ref = self.replicas[0].engine
+            for rep in self.replicas[1:]:
+                e = rep.engine
+                if (e.config.kv_block_size != ref.config.kv_block_size
+                        or e.pool.quant != ref.pool.quant
+                        or e.pool.k.dtype != ref.pool.k.dtype):
+                    raise ValueError(
+                        "disaggregated replicas must share the KV-pool "
+                        f"layout: replica {rep.index} has (bs="
+                        f"{e.config.kv_block_size}, quant={e.pool.quant}, "
+                        f"dtype={e.pool.k.dtype}) vs replica 0 (bs="
+                        f"{ref.config.kv_block_size}, quant={ref.pool.quant}, "
+                        f"dtype={ref.pool.k.dtype})")
+        self.migration_depth = max(
+            int(getattr(engines[0].config, "migration_depth",
+                        DEFAULT_MIGRATION_DEPTH)), 1)
         self.slo = slo if slo is not None else engines[0].config.serving_slo
         self._clock = clock
         self._tracer = get_tracer()
+        self._lock = threading.Lock()
         # decision accounting (always on — the smoke and tests read these)
         self.shed_count = 0
         self.deferred_count = 0
         self.preemptions = 0
         self.affine_readmits = 0
+        self.migrations = 0
+        self.migrated_blocks = 0
+        self.migration_failures = 0
         # distributed-trace contexts minted per request (fleet.TraceContext):
         # rid -> ctx; the wire form (`dispatch_context`) is what a real
         # process-boundary replica receives with its dispatch, and the flow
@@ -123,17 +248,71 @@ class ServingRouter:
         for rep in self.replicas:
             rec = getattr(rep.engine, "_recorder", None)
             if rec is not None:
-                rec.set_context(replica=rep.index, run_id=ident.run_id,
+                rec.set_context(replica=rep.index, role=rep.role,
+                                run_id=ident.run_id,
                                 process_index=ident.process_index)
 
     @classmethod
     def build(cls, model_config, params, engine_config=None, replicas: int = 2,
-              **kw) -> "ServingRouter":
+              roles: Optional[Sequence[str]] = None,
+              prefill_share: float = 0.25, **kw) -> "ServingRouter":
         """N replicas from one (config, params) — each gets its own KV pool
-        and scheduler state; params are shared (same host arrays)."""
-        engines = [InferenceEngineV2(model_config, params, dict(engine_config or {}))
-                   for _ in range(replicas)]
-        return cls(engines, **kw)
+        and scheduler state; params are shared (same host arrays).
+
+        ``roles`` specializes the roster (e.g. ``["prefill", "decode"]``).
+        With specialized roles AND a ``kv_pool_bytes`` budget in
+        ``engine_config``, the budget is read as the TIER total and split
+        per role through ``utils/hbm.disagg_pool_bytes`` (prefill pools
+        hold KV transiently, so the decode side gets the bulk); a
+        mixed roster keeps the budget per replica, unchanged."""
+        base = dict(engine_config or {})
+        role_list = list(roles) if roles is not None \
+            else [base.get("role", "mixed")] * replicas
+        if len(role_list) != replicas:
+            raise ValueError(f"{len(role_list)} roles for {replicas} replicas")
+        total = base.get("kv_pool_bytes")
+        if total and any(r != "mixed" for r in role_list):
+            from deepspeed_tpu.utils.hbm import disagg_pool_bytes
+
+            budgets = disagg_pool_bytes(total, role_list,
+                                        prefill_share=prefill_share)
+        else:
+            budgets = [total] * replicas
+        engines = []
+        for i in range(replicas):
+            cfg = dict(base, role=role_list[i])
+            if budgets[i] is not None:
+                cfg["kv_pool_bytes"] = budgets[i]
+            engines.append(InferenceEngineV2(model_config, params, cfg))
+        return cls(engines, roles=role_list, **kw)
+
+    # ------------------------------------------------------------ placement
+    def _prefill_candidates(self) -> List[_Replica]:
+        """Replicas that take FRESH admissions: the prefill pool under
+        disagg, everyone otherwise (mixed replicas serve both phases)."""
+        if self.disagg:
+            pre = [r for r in self.replicas if r.role == "prefill"]
+            if pre:
+                return pre
+            return [r for r in self.replicas if r.role == "mixed"]
+        return list(self.replicas)
+
+    def _migration_target(self, src: _Replica) -> Optional[_Replica]:
+        """Least-loaded decode-pool replica (mixed as fallback) to receive a
+        finished prefill's KV blocks; None = no target, serve mixed."""
+        cands = [r for r in self.replicas
+                 if r is not src and r.role == "decode"]
+        if not cands:
+            cands = [r for r in self.replicas
+                     if r is not src and r.role == "mixed"]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (r.load(), r.index))
+
+    def _least_loaded(self, candidates: Optional[List[_Replica]] = None
+                      ) -> _Replica:
+        cands = candidates if candidates is not None else self.replicas
+        return min(cands, key=lambda r: (r.load(), r.index))
 
     # ------------------------------------------------------------ admission
     def _projected_ttft_s(self, waited_s: float, rep: _Replica) -> float:
@@ -159,16 +338,13 @@ class ServingRouter:
         if self._projected_ttft_s(waited_s, rep) <= budget_s:
             return "admit"
         if mode == "defer":
-            # hold while ANY replica could still make the budget; shed only
-            # when the wait alone has already blown it everywhere
+            # hold while ANY prefill-capable replica could still make the
+            # budget; shed only when the wait alone has blown it everywhere
             if any(self._projected_ttft_s(waited_s, r) <= budget_s
-                   for r in self.replicas):
+                   for r in self._prefill_candidates()):
                 return "defer"
             return "shed" if waited_s > budget_s else "defer"
         return "shed"
-
-    def _least_loaded(self) -> _Replica:
-        return min(self.replicas, key=lambda r: (r.load(), r.index))
 
     # ---------------------------------------------------------------- serve
     def serve(
@@ -186,8 +362,10 @@ class ServingRouter:
         """Route ``prompts`` across the replicas; returns one output per
         prompt, ``None`` for requests the admission gate shed. The loop is
         the engine's ``generate`` lifted one level: assignment + SLO gate,
-        then per replica the admit/prefill/chain round — each replica's
-        device work is still one fused program per phase."""
+        then per replica the migrate/admit/prefill/chain round — each
+        replica's device work is still one fused program per phase, and
+        with ``dispatch="threads"`` those programs run concurrently across
+        replicas."""
         prompts = [np.asarray(p, np.int32) for p in prompts]
         n_req = len(prompts)
         spec = self.replicas[0].engine.config.spec_decode > 0
@@ -196,21 +374,26 @@ class ServingRouter:
                 "spec_decode is greedy-only (verify-and-accept compares "
                 "argmax targets); disable do_sample or set spec_decode=0")
         # the same feasibility guards engine.generate applies — a prompt no
-        # replica can ever serve must raise here, not stall the router loop
+        # replica can ever serve must raise here, not stall the router loop.
+        # A prefill-role replica only ever holds a request's PROMPT KV (the
+        # decode window lives on its migration destination), so its pool is
+        # guarded for the prompt alone; mixed/decode replicas need the full
+        # prompt + generation window like a standalone engine.
         for rep in self.replicas:
             eng = rep.engine
             pool_tokens = eng.num_kv_blocks * eng.config.kv_block_size
             margin = eng.config.spec_decode
+            decode_here = 0 if rep.role == "prefill" else max_new_tokens + margin
             for i, p in enumerate(prompts):
                 if len(p) + max_new_tokens + margin > eng.max_seq_len:
                     raise ValueError(
                         f"prompt {i} ({len(p)} tokens) + max_new_tokens="
                         f"{max_new_tokens} (+{margin} speculative slack) "
                         f"exceeds replica {rep.index} max_seq_len={eng.max_seq_len}")
-                if len(p) + max_new_tokens + margin > pool_tokens:
+                if len(p) + decode_here > pool_tokens:
                     raise ValueError(
-                        f"prompt {i} ({len(p)} tokens) + max_new_tokens="
-                        f"{max_new_tokens} cannot ever fit replica "
+                        f"prompt {i} ({len(p)} tokens) + {decode_here} "
+                        f"decode-window tokens cannot ever fit replica "
                         f"{rep.index}'s KV pool ({pool_tokens} slots)")
         sample_kw = (("do_sample", do_sample), ("temperature", temperature),
                      ("top_k", top_k), ("top_p", top_p))
@@ -220,7 +403,8 @@ class ServingRouter:
                 f"arrival_times has {len(arrival_times)} entries for {n_req} prompts")
         arr = [float(a) for a in arrival_times] if arrival_times is not None \
             else [0.0] * n_req
-        pending = deque(sorted(range(n_req), key=lambda i: arr[i]))
+        S = _Serve(prompts, arr, t_start, max_new_tokens, eos_token_id,
+                   sample_kw, spec)
         # one TraceContext per request, fleet-unique request ids (monotonic
         # across serve() calls): the flow id both the admission arrow here
         # and a remote replica's serve:dispatch step derive independently
@@ -228,36 +412,45 @@ class ServingRouter:
         self._request_seq += n_req
         self._trace_ctx = {i: fleet.TraceContext.mint(seq0 + i)
                            for i in range(n_req)}
-        affinity: List[Optional[int]] = [None] * n_req
-        admitted_once: set = set()  # rids that ever dispatched a prefill
-        gen: Dict[int, List[int]] = {i: [] for i in range(n_req)}
-        outputs: Dict[int, Optional[np.ndarray]] = {}
-        # committed replicated key, like engine.generate: an uncommitted
-        # PRNGKey makes every replica's second admission wave recompile its
-        # prefill program mid-burst (jit caches on committed-ness)
+        # per-replica committed replicated keys, like engine.generate: an
+        # uncommitted PRNGKey makes every replica's second admission wave
+        # recompile its prefill program mid-burst (jit caches on
+        # committed-ness); one key PER replica so concurrent dispatch never
+        # races a shared key (greedy output is key-independent)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        rng = jax.device_put(jax.random.PRNGKey(seed),
-                             NamedSharding(self.replicas[0].engine.mesh, P()))
-        next_uid = 0
+        for rep in self.replicas:
+            rep.rng = jax.device_put(
+                jax.random.fold_in(jax.random.PRNGKey(seed), rep.index),
+                NamedSharding(rep.engine.mesh, P()))
         tr = self._tracer
         registry = tr.registry if tr.enabled else None
-
+        handles = None
         if registry is not None:
-            c_requests = registry.counter("router/requests")
-            c_shed = registry.counter("router/shed_requests")
-            c_defer = registry.counter("router/deferred")
-            c_preempt = registry.counter("router/preemptions")
-            c_affine = registry.counter("router/affine_readmissions")
-            g_depth = [registry.gauge("router/replica_queue_depth",
-                                      replica=r.index) for r in self.replicas]
-            g_active = [registry.gauge("router/replica_active", replica=r.index)
-                        for r in self.replicas]
-            c_disp = [registry.counter("router/dispatches", replica=r.index)
-                      for r in self.replicas]
-            c_requests.add(float(n_req))
+            handles = {
+                "c_requests": registry.counter("router/requests"),
+                "c_shed": registry.counter("router/shed_requests"),
+                "c_defer": registry.counter("router/deferred"),
+                "c_preempt": registry.counter("router/preemptions"),
+                "c_affine": registry.counter("router/affine_readmissions"),
+                "c_migrations": registry.counter("router/migrations"),
+                "g_depth": [registry.gauge("router/replica_queue_depth",
+                                           replica=r.index)
+                            for r in self.replicas],
+                "g_active": [registry.gauge("router/replica_active",
+                                            replica=r.index)
+                             for r in self.replicas],
+                "c_disp": [registry.counter("router/dispatches",
+                                            replica=r.index)
+                           for r in self.replicas],
+            }
+            handles["c_requests"].add(float(n_req))
             for r in self.replicas:
-                tr.name_track(REPLICA_TRACK_BASE + r.index, f"replica {r.index}")
+                # role-suffixed only under disagg: the plain name is a
+                # pinned contract for mixed rosters
+                suffix = f" [{r.role}]" if r.role != "mixed" else ""
+                tr.name_track(REPLICA_TRACK_BASE + r.index,
+                              f"replica {r.index}{suffix}")
         for r in self.replicas:
             if tr.enabled or r.engine._recorder is not None:
                 r.tracker = LifecycleTracker(
@@ -265,240 +458,505 @@ class ServingRouter:
                     labels={"k": r.engine.config.decode_chain,
                             "replica": r.index},
                     recorder=r.engine._recorder)
+        self._handles = handles
 
-        def context(idx: int) -> np.ndarray:
-            return np.concatenate([prompts[idx], np.asarray(gen[idx], np.int32)])
-
-        def replica_span(rep: _Replica, name: str, t0: float, t1: float) -> None:
-            if registry is None:
-                return
-            tr.append_events([{
-                "kind": "span", "name": name, "cat": "router",
-                "ts": t0 - tr.origin(), "dur": max(t1 - t0, 0.0),
-                "tid": REPLICA_TRACK_BASE + rep.index,
-                "args": {"replica": rep.index}}])
-
-        def accept(rep: _Replica, u: int, t: int) -> None:
-            idx = rep.active[u]
-            gen[idx].append(int(t))
-            if len(gen[idx]) >= max_new_tokens or (
-                    eos_token_id is not None and int(t) == eos_token_id):
-                outputs[idx] = np.asarray(gen[idx], np.int32)
-                rep.active.pop(u)
-                rep.order.pop(u)
-                rep.engine.flush(u)
-                if rep.tracker is not None:
-                    rep.tracker.finish(idx)
-
-        def shed(idx: int, rep: Optional[_Replica]) -> None:
-            outputs[idx] = None
-            self.shed_count += 1
-            if registry is not None:
-                c_shed.add(1.0)
-            if rep is not None and rep.tracker is not None:
-                # an arrived-but-never-served request still counts against
-                # the replica's request totals (goodput's denominator is
-                # finished requests only; shed ones are reported separately)
-                rep.tracker.arrive(idx, now=t_start + arr[idx])
-
-        while pending or any(r.assigned or r.active for r in self.replicas):
-            now = self._clock()
-            did_work = False
-
-            # ---- phase 1: bind arrived requests to the least-loaded
-            # replica (preempted requests keep their affinity — their cached
-            # prefix blocks live there)
-            while pending and now - t_start >= arr[pending[0]]:
-                idx = pending.popleft()
-                if affinity[idx] is not None:
-                    rep = self.replicas[affinity[idx]]
-                    self.affine_readmits += 1
-                    if registry is not None:
-                        c_affine.add(1.0)
-                else:
-                    rep = self._least_loaded()
-                    affinity[idx] = rep.index
-                rep.assigned.append(idx)
-
-            # ---- phase 2: per replica, SLO-gated admission + fused prefill
-            for rep in self.replicas:
-                eng = rep.engine
-                adm_uids: List[int] = []
-                adm_tokens: List[np.ndarray] = []
-                adm_counts: List[int] = []
-                adm_full: List[np.ndarray] = []
-                decoding = list(rep.active.keys())
-                deferred: List[int] = []
-                while rep.assigned and len(rep.active) < eng.config.max_seqs:
-                    idx = rep.assigned[0]
-                    waited = now - (t_start + arr[idx])
-                    # the SLO gate applies to FIRST admissions only: a
-                    # preempted request was already admitted and holds
-                    # generated tokens — dropping it now would violate the
-                    # "an admitted request is never dropped" invariant (it
-                    # re-admits unconditionally, on its affine replica)
-                    decision = ("admit" if idx in admitted_once
-                                else self._admission_decision(waited, rep))
-                    if decision == "shed":
-                        rep.assigned.popleft()
-                        shed(idx, rep)
-                        continue
-                    if decision == "defer":
-                        # migrate toward the replica the decision says could
-                        # still make the budget — a never-admitted request
-                        # has no KV and no cached prefix to lose by rebinding
-                        rep.assigned.popleft()
-                        best = min(self.replicas,
-                                   key=lambda r: self._projected_ttft_s(waited, r))
-                        if best is not rep:
-                            affinity[idx] = best.index
-                            best.assigned.append(idx)
-                        else:
-                            deferred.append(idx)
-                        self.deferred_count += 1
-                        if registry is not None:
-                            c_defer.add(1.0)
-                        continue
-                    cand = context(idx)
-                    suffix = eng.try_admit(next_uid, cand, decoding + adm_uids,
-                                           [1] * len(decoding) + adm_counts)
-                    if suffix is None:
-                        break
-                    rep.assigned.popleft()
-                    admitted_once.add(idx)
-                    adm_uids.append(next_uid)
-                    adm_tokens.append(suffix)
-                    adm_counts.append(len(suffix))
-                    adm_full.append(cand)
-                    if rep.tracker is not None:
-                        rep.tracker.arrive(idx, now=t_start + arr[idx])
-                        rep.tracker.admit(idx, next_uid)
-                        rep.tracker.set_trace_context(
-                            idx, self._trace_ctx[idx])
-                    rep.active[next_uid] = idx
-                    rep.order[next_uid] = None
-                    next_uid += 1
-                rep.assigned.extend(deferred)
-                if adm_uids:
-                    did_work = True
-                    adm_rids = [rep.active[u] for u in adm_uids]
-                    t0 = self._clock()
-                    toks, rng = eng._put_sample(
-                        adm_uids, adm_tokens, rng, sample_kw,
-                        tracker=rep.tracker, rids=adm_rids)
-                    t1 = self._clock()
-                    rep.ema("prefill_ema", t1 - t0)
-                    rep.dispatches += 1
-                    replica_span(rep, "prefill", t0, t1)
-                    if registry is not None:
-                        c_disp[rep.index].add(1.0)
-                    if eng.prefix_cache is not None:
-                        for u, full in zip(adm_uids, adm_full):
-                            eng._insert_prefix(u, full)
-                    if rep.tracker is not None:
-                        rep.tracker.emitted_batch(adm_rids, (1,) * len(adm_rids))
-                    for u, t in zip(adm_uids, toks):
-                        accept(rep, u, t)
-
-            # ---- phase 3: per replica, one chained decode over its rows
-            for rep in self.replicas:
-                if not rep.active:
-                    continue
-                eng = rep.engine
-                did_work = True
-                uids = list(rep.active.keys())
-                budgets = [max_new_tokens - len(gen[rep.active[u]]) for u in uids]
-                k = eng.config.decode_chain
-                while True:
-                    while k > 1 and not eng._can_schedule_evicting(
-                            uids, eng.chain_window(budgets, k)):
-                        k -= 1
-                    if eng._can_schedule_evicting(uids, eng.chain_window(budgets, k)):
-                        break
-                    # preempt the youngest row; it re-queues pinned to THIS
-                    # replica so its cached prefix blocks stay useful
-                    victim = next(reversed(rep.order))
-                    del rep.order[victim]
-                    i = uids.index(victim)
-                    uids.pop(i)
-                    budgets.pop(i)
-                    idx = rep.active.pop(victim)
-                    eng.flush(victim)
-                    pending.appendleft(idx)
-                    self.preemptions += 1
-                    if rep.tracker is not None:
-                        rep.tracker.preempt(idx)
-                    if registry is not None:
-                        c_preempt.add(1.0)
-                    if not uids:
-                        raise RuntimeError(
-                            f"replica {rep.index}: KV pool too small for a "
-                            f"single sequence ({eng.num_kv_blocks} blocks)")
-                    k = eng.config.decode_chain
-                last = [gen[rep.active[u]][-1] for u in uids]
-                chain_rids = [rep.active[u] for u in uids]
-                t0 = self._clock()
-                if spec:
-                    histories = [context(rep.active[u]) for u in uids]
-                    out, emitted, rng = eng.decode_spec_chain(
-                        uids, last, budgets, k, rng, histories,
-                        eos_id=eos_token_id, tracker=rep.tracker,
-                        rids=chain_rids)
-                else:
-                    out, emitted, rng = eng.decode_chain(
-                        uids, last, budgets, k, rng, eos_id=eos_token_id,
-                        sample_kw=sample_kw, tracker=rep.tracker,
-                        rids=chain_rids)
-                t1 = self._clock()
-                rep.ema("chain_ema", t1 - t0)
-                rep.dispatches += 1
-                replica_span(rep, "chain", t0, t1)
-                eng.tokens_decoded += int(emitted.sum())
-                if rep.tracker is not None:
-                    rep.tracker.emitted_batch(chain_rids, emitted, now=t1)
-                    rep.tracker.sample_gauges(now=t1)
-                if registry is not None:
-                    c_disp[rep.index].add(1.0)
-                    g_depth[rep.index].set(float(len(rep.assigned)))
-                    g_active[rep.index].set(float(len(rep.active)))
-                for i, u in enumerate(uids):
-                    for t in out[i, : emitted[i]]:
-                        if u in rep.active:
-                            accept(rep, u, t)
-
-            if not did_work:
-                if pending:
-                    wait = t_start + arr[pending[0]] - self._clock()
-                    if wait > 0:  # open-loop: idle until the next arrival
-                        time.sleep(min(wait, 0.02))
-                    continue
-                if any(r.assigned for r in self.replicas):
-                    if not any(r.active for r in self.replicas):
-                        # nothing decoding anywhere, yet the assigned
-                        # requests could not be admitted: with the serve()
-                        # feasibility guards above this means deferred
-                        # requests waiting out their admission gate — let
-                        # wall time advance instead of spinning hot (they
-                        # admit or shed as `waited` grows)
-                        time.sleep(0.001)
-                    continue  # active rows elsewhere will free capacity
+        if self.dispatch == "threads" and len(self.replicas) > 1:
+            self._serve_threaded(S)
+        else:
+            self._serve_serial(S)
+        if S.abort is not None:
+            raise S.abort
         for rep in self.replicas:
             if rep.tracker is not None:
                 rep.tracker.sample_gauges()
-        if registry is not None:
+        if handles is not None:
             for rep in self.replicas:
-                g_depth[rep.index].set(0.0)
-                g_active[rep.index].set(0.0)
-        return [outputs.get(i) for i in range(n_req)]
+                handles["g_depth"][rep.index].set(0.0)
+                handles["g_active"][rep.index].set(0.0)
+        return [S.outputs.get(i) for i in range(n_req)]
+
+    # ------------------------------------------------------------ loop drivers
+    def _work_left(self, S: _Serve) -> bool:
+        return bool(S.pending) or any(r.has_work() for r in self.replicas)
+
+    def _serve_serial(self, S: _Serve) -> None:
+        while True:
+            with self._lock:
+                if S.abort is not None or not self._work_left(S):
+                    return
+                self._bind_arrivals(S)
+            did_work = False
+            for rep in self.replicas:
+                try:
+                    did_work |= self._replica_round(rep, S)
+                except BaseException as e:  # noqa: BLE001 — propagate to caller
+                    with self._lock:
+                        S.abort = e
+                    return
+            if not did_work:
+                self._idle_wait(S)
+
+    def _serve_threaded(self, S: _Serve) -> None:
+        """One loop thread per replica: each replica rounds independently,
+        so one replica's blocking dispatch never delays another's chain
+        boundary. The coordinator thread only binds arrivals (the shared
+        clock-driven part) and watches for termination."""
+
+        def run(rep: _Replica) -> None:
+            try:
+                while True:
+                    with self._lock:
+                        if S.abort is not None or not self._work_left(S):
+                            return
+                        # tight-poll only while a sibling might hand work
+                        # over any moment; a drained roster waiting out an
+                        # open-loop arrival gap (or a deferred request's
+                        # admission window) sleeps toward it instead of
+                        # burning a core per replica on the shared lock
+                        busy = any(r.active or r.migrate_in or r.await_export
+                                   or r.tickets for r in self.replicas)
+                    if self._replica_round(rep, S):
+                        continue
+                    if busy:
+                        time.sleep(0.0002)
+                    else:
+                        self._idle_wait(S)
+            except BaseException as e:  # noqa: BLE001 — surface on the caller
+                with self._lock:
+                    if S.abort is None:
+                        S.abort = e
+
+        threads = [threading.Thread(target=run, args=(rep,), daemon=True,
+                                    name=f"dstpu-replica-{rep.index}")
+                   for rep in self.replicas]
+        for t in threads:
+            t.start()
+        try:
+            while True:
+                with self._lock:
+                    if S.abort is not None or not self._work_left(S):
+                        break
+                    self._bind_arrivals(S)
+                time.sleep(0.0005)
+        finally:
+            for t in threads:
+                t.join()
+
+    def _idle_wait(self, S: _Serve) -> None:
+        """Serial-mode idle: advance wall time toward the next arrival or an
+        admission-gate decision instead of spinning hot."""
+        with self._lock:
+            nxt = S.pending[0] if S.pending else None
+            active_any = any(r.active for r in self.replicas)
+            assigned_any = any(r.assigned for r in self.replicas)
+        if nxt is not None:
+            wait = S.t_start + S.arr[nxt] - self._clock()
+            if wait > 0:  # open-loop: idle until the next arrival
+                time.sleep(min(wait, 0.02))
+            return
+        if assigned_any and not active_any:
+            # deferred requests waiting out their admission gate — let wall
+            # time advance (they admit or shed as `waited` grows)
+            time.sleep(0.001)
+
+    # ------------------------------------------------------- shared bookkeeping
+    def _bind_arrivals(self, S: _Serve) -> None:
+        """Phase 1 (lock held): bind arrived requests to the least-loaded
+        prefill-capable replica (preempted requests keep their affinity —
+        their cached prefix blocks live there)."""
+        handles = self._handles
+        now = self._clock()
+        while S.pending and now - S.t_start >= S.arr[S.pending[0]]:
+            idx = S.pending.popleft()
+            if S.affinity[idx] is not None:
+                rep = self.replicas[S.affinity[idx]]
+                self.affine_readmits += 1
+                if handles is not None:
+                    handles["c_affine"].add(1.0)
+            else:
+                rep = self._least_loaded(self._prefill_candidates())
+                S.affinity[idx] = rep.index
+            rep.assigned.append(idx)
+
+    def _accept(self, rep: _Replica, S: _Serve, u: int, t: int) -> None:
+        """Record token t for uid u on rep; retire the row if done. Lock
+        held by the caller."""
+        idx = rep.active[u]
+        S.gen[idx].append(int(t))
+        if len(S.gen[idx]) >= S.max_new_tokens or (
+                S.eos is not None and int(t) == S.eos):
+            S.outputs[idx] = np.asarray(S.gen[idx], np.int32)
+            rep.active.pop(u)
+            rep.order.pop(u)
+            rep.migrating.discard(idx)
+            rep.engine.flush(u)
+            if rep.tracker is not None:
+                rep.tracker.finish(idx)
+
+    def _shed(self, idx: int, rep: Optional[_Replica], S: _Serve) -> None:
+        """Lock held by the caller."""
+        S.outputs[idx] = None
+        self.shed_count += 1
+        if self._handles is not None:
+            self._handles["c_shed"].add(1.0)
+        if rep is not None and rep.tracker is not None:
+            # an arrived-but-never-served request still counts against
+            # the replica's request totals (goodput's denominator is
+            # finished requests only; shed ones are reported separately)
+            rep.tracker.arrive(idx, now=S.t_start + S.arr[idx])
+
+    def _replica_span(self, rep: _Replica, name: str, t0: float,
+                      t1: float) -> None:
+        if self._handles is None:
+            return
+        tr = self._tracer
+        tr.append_events([{
+            "kind": "span", "name": name, "cat": "router",
+            "ts": t0 - tr.origin(), "dur": max(t1 - t0, 0.0),
+            "tid": REPLICA_TRACK_BASE + rep.index,
+            "args": {"replica": rep.index}}])
+
+    # ---------------------------------------------------------- replica round
+    def _replica_round(self, rep: _Replica, S: _Serve) -> bool:
+        """One scheduling round of ONE replica: drain inbound migrations,
+        reap outbound tickets, pump exports, admit + prefill, then one
+        chained decode. Host bookkeeping under the router lock; every
+        device dispatch outside it."""
+        did = self._drain_migrations(rep, S)
+        did |= self._reap_outbound(rep, S)
+        did |= self._pump_exports(rep, S)
+        did |= self._prefill_phase(rep, S)
+        did |= self._pump_exports(rep, S)
+        did |= self._chain_phase(rep, S)
+        return did
+
+    # ---------------------------------------------------------- migration plane
+    def _pump_exports(self, rep: _Replica, S: _Serve) -> bool:
+        """Source side: export awaiting requests up to the double-buffer
+        depth. The export dispatch is asynchronous — request N's pages
+        stream while this replica assembles request N+1's prefill."""
+        did = False
+        while True:
+            with self._lock:
+                inflight = sum(1 for t in rep.tickets
+                               if t.status == "inflight")
+                if not rep.await_export or inflight >= self.migration_depth:
+                    return did
+                idx = rep.await_export.popleft()
+                uid = next((u for u, i in rep.active.items() if i == idx),
+                           None)
+                if uid is None:  # finished/retired while awaiting export
+                    rep.migrating.discard(idx)
+                    continue
+                dst = self._migration_target(rep)
+                if dst is None:  # decode pool vanished: serve mixed
+                    rep.migrating.discard(idx)
+                    continue
+                tokens = S.context(idx)
+            t0 = self._clock()
+            if rep.tracker is not None:
+                rep.tracker.migrate_start(idx, now=t0)
+            export = rep.engine.export_request(uid)
+            ticket = MigrationTicket(idx=idx, uid=uid, src=rep.index,
+                                     dst=dst.index, export=export,
+                                     tokens=tokens, t_start=t0)
+            with self._lock:
+                rep.tickets.append(ticket)
+                dst.migrate_in.append(ticket)
+            did = True
+
+    def _drain_migrations(self, rep: _Replica, S: _Serve) -> bool:
+        """Destination side: import inbound tickets and re-admit their
+        requests — the decode pool's arrival path."""
+        did = False
+        while True:
+            with self._lock:
+                if not rep.migrate_in:
+                    return did
+                ticket = rep.migrate_in.popleft()
+                new_uid = S.next_uid
+                S.next_uid += 1
+            ctx = self._trace_ctx.get(ticket.idx)
+            span = fleet.dispatch_span(ctx, name="serve:migrate",
+                                       replica=rep.index) \
+                if (self._tracer.enabled and ctx is not None) else nullcontext()
+            ok = False
+            try:
+                with span:
+                    ok = rep.engine.import_request(new_uid, ticket.export)
+            except Exception:  # noqa: BLE001 — failure degrades, never drops
+                logger.warning(
+                    f"migration of request {ticket.idx} to replica "
+                    f"{rep.index} failed; serving mixed on replica "
+                    f"{ticket.src}", exc_info=True)
+            now = self._clock()
+            with self._lock:
+                src_rep = self.replicas[ticket.src]
+                if ok:
+                    ticket.new_uid = new_uid
+                    ticket.status = "done"
+                    rep.active[new_uid] = ticket.idx
+                    rep.order[new_uid] = None
+                    S.affinity[ticket.idx] = rep.index
+                    self.migrations += 1
+                    self.migrated_blocks += ticket.export["n_blocks"]
+                    if self._handles is not None:
+                        self._handles["c_migrations"].add(1.0)
+                    if src_rep.tracker is not None and rep.tracker is not None:
+                        src_rep.tracker.transfer(ticket.idx, rep.tracker)
+                else:
+                    ticket.status = "failed"
+                    self.migration_failures += 1
+            if ok:
+                if rep.tracker is not None:
+                    rep.tracker.admit(ticket.idx, new_uid, now=now)
+                    rep.tracker.migrated(
+                        ticket.idx, ticket.export["n_blocks"], now=now)
+                if rep.engine.prefix_cache is not None:
+                    # the imported blocks carry the SAME quantized bytes —
+                    # index them here so later prompts sharing the prefix
+                    # hit on the decode replica too (content hashes match
+                    # the source's insert-time digests bit-for-bit). Only
+                    # tokens whose KV the pool actually HOLDS are indexed:
+                    # the context's trailing sampled token has no KV yet
+                    # (its write happens when it feeds the next decode
+                    # step), and a digest over its still-unwritten slot
+                    # would go stale the moment that write lands.
+                    seen = ticket.export["seen_tokens"]
+                    rep.engine._insert_prefix(new_uid, ticket.tokens[:seen])
+            did = True
+
+    def _reap_outbound(self, rep: _Replica, S: _Serve) -> bool:
+        """Source side: finalize tickets the destination resolved — release
+        the migrated request's blocks on success (its own thread owns the
+        allocator), or resume serving it here on failure (mixed fallback)."""
+        with self._lock:
+            resolved = [t for t in rep.tickets if t.status != "inflight"]
+            if not resolved:
+                return False
+            rep.tickets = [t for t in rep.tickets if t.status == "inflight"]
+        for t in resolved:
+            if t.status == "done":
+                with self._lock:
+                    rep.active.pop(t.uid, None)
+                    rep.order.pop(t.uid, None)
+                    rep.migrating.discard(t.idx)
+                rep.engine.flush(t.uid)
+            else:
+                eng = rep.engine
+                pool_tokens = eng.num_kv_blocks * eng.config.kv_block_size
+                window = (len(S.prompts[t.idx]) + S.max_new_tokens
+                          + eng.config.spec_decode)
+                if window > pool_tokens:
+                    # failed import on a source whose pool can never host
+                    # the request's full decode window (prefill pools are
+                    # guarded for the PROMPT alone): mixed fallback here
+                    # would wedge the chain phase, so RETRY the ticket —
+                    # refused or errored alike — the serve() guard pinned
+                    # that the destination fits the window, and its
+                    # capacity frees as its chains finish. The exported
+                    # buffer is still the live bytes: rows in
+                    # ``migrating`` never decode on the source.
+                    if rep.tracker is not None:
+                        rep.tracker.migrate_retry(t.idx)
+                    with self._lock:
+                        t.status = "inflight"
+                        rep.tickets.append(t)
+                        self.replicas[t.dst].migrate_in.append(t)
+                    continue
+                with self._lock:
+                    rep.migrating.discard(t.idx)
+                if rep.tracker is not None:
+                    rep.tracker.migrate_failed(t.idx)
+        return True
+
+    # ------------------------------------------------------------- prefill phase
+    def _prefill_phase(self, rep: _Replica, S: _Serve) -> bool:
+        eng = rep.engine
+        now = self._clock()
+        with self._lock:
+            adm_uids: List[int] = []
+            adm_tokens: List[np.ndarray] = []
+            adm_counts: List[int] = []
+            adm_full: List[np.ndarray] = []
+            decoding = list(rep.active.keys())
+            deferred: List[int] = []
+            while rep.assigned and len(rep.active) < eng.config.max_seqs:
+                idx = rep.assigned[0]
+                waited = now - (S.t_start + S.arr[idx])
+                # the SLO gate applies to FIRST admissions only: a
+                # preempted request was already admitted and holds
+                # generated tokens — dropping it now would violate the
+                # "an admitted request is never dropped" invariant (it
+                # re-admits unconditionally, on its affine replica)
+                decision = ("admit" if idx in S.admitted_once
+                            else self._admission_decision(waited, rep))
+                if decision == "shed":
+                    rep.assigned.popleft()
+                    self._shed(idx, rep, S)
+                    continue
+                if decision == "defer":
+                    # migrate toward the replica the decision says could
+                    # still make the budget — a never-admitted request
+                    # has no KV and no cached prefix to lose by rebinding
+                    rep.assigned.popleft()
+                    best = min(self._prefill_candidates(),
+                               key=lambda r: self._projected_ttft_s(waited, r))
+                    if best is not rep:
+                        S.affinity[idx] = best.index
+                        best.assigned.append(idx)
+                    else:
+                        deferred.append(idx)
+                    self.deferred_count += 1
+                    if self._handles is not None:
+                        self._handles["c_defer"].add(1.0)
+                    continue
+                cand = S.context(idx)
+                suffix = eng.try_admit(S.next_uid, cand, decoding + adm_uids,
+                                       [1] * len(decoding) + adm_counts)
+                if suffix is None:
+                    break
+                rep.assigned.popleft()
+                S.admitted_once.add(idx)
+                adm_uids.append(S.next_uid)
+                adm_tokens.append(suffix)
+                adm_counts.append(len(suffix))
+                adm_full.append(cand)
+                if rep.tracker is not None:
+                    rep.tracker.arrive(idx, now=S.t_start + S.arr[idx])
+                    rep.tracker.admit(idx, S.next_uid)
+                    rep.tracker.set_trace_context(idx, self._trace_ctx[idx])
+                rep.active[S.next_uid] = idx
+                rep.order[S.next_uid] = None
+                S.next_uid += 1
+            rep.assigned.extend(deferred)
+            if adm_uids:
+                adm_rids = [rep.active[u] for u in adm_uids]
+        if not adm_uids:
+            return False
+        t0 = self._clock()
+        toks, rep.rng = eng._put_sample(
+            adm_uids, adm_tokens, rep.rng, S.sample_kw,
+            tracker=rep.tracker, rids=adm_rids)
+        t1 = self._clock()
+        rep.ema("prefill_ema", t1 - t0)
+        rep.dispatches += 1
+        self._replica_span(rep, "prefill", t0, t1)
+        if self._handles is not None:
+            self._handles["c_disp"][rep.index].add(1.0)
+        if eng.prefix_cache is not None:
+            for u, full in zip(adm_uids, adm_full):
+                eng._insert_prefix(u, full)
+        if rep.tracker is not None:
+            rep.tracker.emitted_batch(adm_rids, (1,) * len(adm_rids))
+        with self._lock:
+            for u, t in zip(adm_uids, toks):
+                self._accept(rep, S, u, t)
+            # disagg hand-off: a prefill-pool replica's finished prefills
+            # queue for migration to the decode pool (unless the request
+            # already finished at its first token)
+            if self.disagg and rep.role == "prefill":
+                for u in adm_uids:
+                    if u in rep.active:
+                        idx = rep.active[u]
+                        rep.migrating.add(idx)
+                        rep.await_export.append(idx)
+        return True
+
+    # --------------------------------------------------------------- chain phase
+    def _chain_phase(self, rep: _Replica, S: _Serve) -> bool:
+        eng = rep.engine
+        with self._lock:
+            # rows in migration limbo decode on their DESTINATION once the
+            # import commits — never here (their exported pages must stay
+            # the bytes the destination receives)
+            uids = [u for u in rep.active
+                    if rep.active[u] not in rep.migrating]
+            if not uids:
+                return False
+            budgets = [S.max_new_tokens - len(S.gen[rep.active[u]])
+                       for u in uids]
+            k = eng.config.decode_chain
+            while True:
+                while k > 1 and not eng._can_schedule_evicting(
+                        uids, eng.chain_window(budgets, k)):
+                    k -= 1
+                if eng._can_schedule_evicting(uids, eng.chain_window(budgets, k)):
+                    break
+                # preempt the youngest non-migrating row; it re-queues
+                # pinned to THIS replica so its cached prefix blocks stay
+                # useful
+                uid_set = set(uids)
+                victim = next((u for u in reversed(rep.order)
+                               if u in uid_set), None)
+                if victim is None:
+                    raise RuntimeError(
+                        f"replica {rep.index}: KV pool cannot host its "
+                        "non-migrating rows and none are preemptible")
+                del rep.order[victim]
+                i = uids.index(victim)
+                uids.pop(i)
+                budgets.pop(i)
+                idx = rep.active.pop(victim)
+                eng.flush(victim)
+                S.pending.appendleft(idx)
+                self.preemptions += 1
+                if rep.tracker is not None:
+                    rep.tracker.preempt(idx)
+                if self._handles is not None:
+                    self._handles["c_preempt"].add(1.0)
+                if not uids:
+                    if rep.migrating or rep.tickets:
+                        # transient pressure: in-limbo rows hold their
+                        # blocks only until their exports land; the row
+                        # preempted above re-queued and re-admits when
+                        # the limbo drains — skip this chain round
+                        return False
+                    raise RuntimeError(
+                        f"replica {rep.index}: KV pool too small for a "
+                        f"single sequence ({eng.num_kv_blocks} blocks)")
+                k = eng.config.decode_chain
+            last = [S.gen[rep.active[u]][-1] for u in uids]
+            chain_rids = [rep.active[u] for u in uids]
+            histories = [S.context(rep.active[u]) for u in uids] \
+                if S.spec else None
+        t0 = self._clock()
+        if S.spec:
+            out, emitted, rep.rng = eng.decode_spec_chain(
+                uids, last, budgets, k, rep.rng, histories,
+                eos_id=S.eos, tracker=rep.tracker, rids=chain_rids)
+        else:
+            out, emitted, rep.rng = eng.decode_chain(
+                uids, last, budgets, k, rep.rng, eos_id=S.eos,
+                sample_kw=S.sample_kw, tracker=rep.tracker, rids=chain_rids)
+        t1 = self._clock()
+        rep.ema("chain_ema", t1 - t0)
+        rep.dispatches += 1
+        self._replica_span(rep, "chain", t0, t1)
+        eng.tokens_decoded += int(emitted.sum())
+        if rep.tracker is not None:
+            rep.tracker.emitted_batch(chain_rids, emitted, now=t1)
+            rep.tracker.sample_gauges(now=t1)
+        if self._handles is not None:
+            self._handles["c_disp"][rep.index].add(1.0)
+            self._handles["g_depth"][rep.index].set(float(len(rep.assigned)))
+            self._handles["g_active"][rep.index].set(float(len(rep.active)))
+        with self._lock:
+            for i, u in enumerate(uids):
+                for t in out[i, : emitted[i]]:
+                    if u in rep.active:
+                        self._accept(rep, S, u, t)
+        return True
 
     def dispatch_context(self, idx: int) -> Optional[Dict[str, Any]]:
         """Wire-form trace context for request ``idx`` of the current/most
         recent ``serve()`` — what a REAL process-boundary replica receives
         alongside its dispatch payload. The receiver rebuilds it with
         ``fleet.TraceContext.from_wire`` and wraps its work in
-        ``fleet.dispatch_span(ctx)``, which emits the ``serve:dispatch``
-        span + in-span flow step that binds into this router's admission
-        arrow once ``tools/trace_merge.py`` joins the streams."""
+        ``fleet.dispatch_span(ctx)`` (name ``serve:dispatch`` for a decode
+        hand-off's chain, ``serve:migrate`` for the KV import), which emits
+        the span + in-span flow step that binds into this router's
+        admission arrow once ``tools/trace_merge.py`` joins the streams."""
         ctx = self._trace_ctx.get(idx)
         return ctx.to_wire() if ctx is not None else None
 
@@ -526,9 +984,22 @@ class ServingRouter:
     def stats(self) -> Dict[str, Any]:
         return {
             "replicas": len(self.replicas),
+            "roles": [r.role for r in self.replicas],
+            "dispatch": self.dispatch,
             "shed": self.shed_count,
             "deferred": self.deferred_count,
             "preemptions": self.preemptions,
             "affine_readmissions": self.affine_readmits,
+            "migrations": self.migrations,
+            "migrated_blocks": self.migrated_blocks,
+            "migration_failures": self.migration_failures,
             "dispatches": [r.dispatches for r in self.replicas],
         }
+
+    def reset_stats(self) -> None:
+        """Zero the router-lifetime decision counters ``stats()`` reports —
+        benches call this after warmup so the reported shed/migration
+        counts cover only the measured window."""
+        self.shed_count = self.deferred_count = 0
+        self.preemptions = self.affine_readmits = 0
+        self.migrations = self.migrated_blocks = self.migration_failures = 0

@@ -207,8 +207,17 @@ class FleetCollector:
         # the ONE definition of fleet/processes: every registered member,
         # heartbeat or not — must always agree with the ledger's row count
         reg.gauge("fleet/processes").set(float(len(entries)))
+        # disagg topology rollups (ISSUE 14): membership per declared role
+        # (prefill/decode/...) plus role-summed serving rates inside
+        # fleet_rollups — the phase pools read as two series
+        roles = {labels[k]: e["identity"].role for k, e in entries}
+        role_counts: Dict[str, int] = {}
+        for r in roles.values():
+            role_counts[r] = role_counts.get(r, 0) + 1
+        for r, n in role_counts.items():
+            reg.gauge("fleet/role_processes", role=r).set(float(n))
         fleet.fleet_rollups(reg, heartbeats,
-                            straggler_mads=self.straggler_mads)
+                            straggler_mads=self.straggler_mads, roles=roles)
         return reg
 
     def render_prometheus(self) -> str:
@@ -421,7 +430,10 @@ class FleetClient:
                 from deepspeed_tpu.collectives import observatory as obs_mod
 
                 obs = obs_mod.get_observatory()
-                if not obs.enabled():
+                # CollectiveObservatory.enabled is a PROPERTY — calling it
+                # raised TypeError on the push worker thread whenever a
+                # live observatory existed, silently killing fleet pushes
+                if not obs.enabled:
                     obs = None
             if obs is not None:
                 rows = obs.table_rows()

@@ -50,8 +50,12 @@ from deepspeed_tpu.telemetry.registry import (
 )
 
 # roles a process can declare; free-form strings are accepted (the ledger
-# just displays them) but these are the ones the runtime stamps itself
-ROLES = ("train", "router", "replica", "collector", "worker")
+# just displays them) but these are the ones the runtime stamps itself.
+# "prefill"/"decode" are the disaggregated serving pools (ISSUE 14): a
+# phase-specialized replica process exports its role so the collector's
+# per-role rollups and the merged traces read the topology directly.
+ROLES = ("train", "router", "replica", "prefill", "decode", "collector",
+         "worker")
 
 
 @dataclasses.dataclass
@@ -350,7 +354,8 @@ def clock_sync_doc() -> Dict[str, float]:
 
 def fleet_rollups(registry: MetricsRegistry,
                   heartbeats: Optional[Dict[str, Dict[str, Any]]] = None,
-                  straggler_mads: float = 6.0) -> None:
+                  straggler_mads: float = 6.0,
+                  roles: Optional[Dict[str, str]] = None) -> None:
     """Compute the ``fleet/*`` rollup series into a federated registry:
 
       fleet/goodput        summed slo_met / (slo_met + slo_missed) counters
@@ -360,12 +365,19 @@ def fleet_rollups(registry: MetricsRegistry,
                              (the PR-2 in-process detector's math, lifted)
 
     ``heartbeats`` maps proc label -> latest heartbeat dict (collector
-    state); step-rate rollups are skipped without it. ``fleet/processes``
-    is NOT set here: its one definition (all registered members, heartbeat
-    or not) belongs to the collector, which knows the membership."""
+    state); step-rate rollups are skipped without it. ``roles`` maps proc
+    label -> declared role: when given, the disagg topology (ISSUE 14)
+    gets per-role rollups — ``fleet/tokens_per_s{role=}`` (summed over the
+    role's processes) and ``fleet/step_rate_min{role=}`` — so a dashboard
+    reads the prefill pool and the decode pool as two series without
+    re-deriving membership. ``fleet/processes`` is NOT set here: its one
+    definition (all registered members, heartbeat or not) belongs to the
+    collector, which knows the membership."""
     met = missed = 0.0
     tps = 0.0
     saw_tps = False
+    role_tps: Dict[str, float] = {}
+    roles = roles or {}
     for kind, name, metric in registry.iter_metrics():
         if kind == "counter" and name == "serving/slo_met":
             met += metric.value
@@ -374,18 +386,30 @@ def fleet_rollups(registry: MetricsRegistry,
         elif kind == "gauge" and name == "serving/tokens_per_s":
             tps += metric.value
             saw_tps = True
+            role = roles.get(metric.labels.get("proc", ""))
+            if role is not None:
+                role_tps[role] = role_tps.get(role, 0.0) + metric.value
     if met + missed > 0:
         registry.gauge("fleet/goodput").set(met / (met + missed))
     if saw_tps:
         # a summed rate of 0 during a fleet-wide stall is exactly when the
         # series matters — report 0, never drop it (an == 0 alert must fire)
         registry.gauge("fleet/tokens_per_s").set(tps)
+    for role, v in role_tps.items():
+        registry.gauge("fleet/tokens_per_s", role=role).set(v)
     if not heartbeats:
         return
     rates = {p: float(hb["step_rate"]) for p, hb in heartbeats.items()
              if hb.get("step_rate") is not None}
     if rates:
         registry.gauge("fleet/step_rate_min").set(min(rates.values()))
+        role_rates: Dict[str, list] = {}
+        for p, v in rates.items():
+            role = roles.get(p)
+            if role is not None:
+                role_rates.setdefault(role, []).append(v)
+        for role, vals in role_rates.items():
+            registry.gauge("fleet/step_rate_min", role=role).set(min(vals))
     # same threshold the caller's ledger uses — the Prometheus gauge and
     # GET /fleet must never disagree on who is straggling
     for proc, flagged in straggler_flags(rates, mads=straggler_mads).items():

@@ -68,6 +68,36 @@ def kv_blocks_for_bytes(pool_bytes: int, num_layers: int, block_size: int,
     return max(int(pool_bytes) // per_block, 1)
 
 
+def disagg_pool_bytes(total_bytes: int, roles, prefill_share: float = 0.25):
+    """Split one serving tier's KV byte budget across phase-specialized
+    replica pools (ISSUE 14 capacity math).
+
+    Prefill pools hold a request's KV only TRANSIENTLY — from the prefill
+    dispatch until its migration commits, bounded by ``migration_depth``
+    concurrent exports times the longest prompt — while the decode pool
+    holds EVERY in-flight request's full context for its whole generation.
+    So the decode side gets the bulk: the prefill replicas share
+    ``prefill_share`` of the budget evenly, decode (and mixed, which also
+    decode) replicas share the rest. A roster with no specialized role
+    splits evenly — the mixed baseline at equal hardware.
+
+    Returns one byte budget per entry of ``roles``, summing to
+    ``total_bytes`` (modulo integer division).
+    """
+    roles = list(roles)
+    if not roles:
+        raise ValueError("disagg_pool_bytes needs at least one role")
+    if not 0.0 < prefill_share < 1.0:
+        raise ValueError(f"prefill_share must be in (0, 1), got {prefill_share}")
+    n_pre = sum(1 for r in roles if r == "prefill")
+    n_rest = len(roles) - n_pre
+    if n_pre == 0 or n_rest == 0:
+        return [int(total_bytes) // len(roles)] * len(roles)
+    pre_each = int(total_bytes * prefill_share) // n_pre
+    rest_each = int(total_bytes - pre_each * n_pre) // n_rest
+    return [pre_each if r == "prefill" else rest_each for r in roles]
+
+
 def prefix_cache_capacity_blocks(num_blocks: int, fraction: float) -> int:
     """Cache-aware pool sizing (ISSUE 12): how many pool blocks the prefix
     cache may hold references to. The cap guarantees live sequences always

@@ -138,6 +138,73 @@ def test_readmission_keeps_ttft_from_original_arrival():
     assert reg.counter("serving/slo_met").value == 1
 
 
+def test_migration_wait_disjoint_from_defer_window():
+    """ISSUE 14 small fix: a deferred-then-migrated request re-admitted on
+    a DIFFERENT replica must not re-count its pre-admission defer window in
+    ``serving/readmit_wait_ms`` — the readmission anchors at the LATEST
+    hand-off stamp (here the migration start), so queue/defer wait and
+    migration wait are disjoint intervals: queue_wait covers
+    [arrival, first admit], readmit/migration wait covers
+    [migrate start, re-admit]."""
+    clk = FakeClock()
+    tr = Tracer(enabled=True)
+    slo = ServingSLOConfig(ttft_ms=500.0)
+    t_pre = LifecycleTracker(tr, slo=slo, labels={"replica": 0}, clock=clk)
+    t_dec = LifecycleTracker(tr, slo=slo, labels={"replica": 1}, clock=clk)
+
+    t_pre.arrive(0, now=0.0)
+    # deferred by the admission gate for 100 ms, then first-admitted
+    t_pre.admit(0, uid=1, now=0.100)      # queue wait = 100 ms (defer incl.)
+    t_pre.emitted(0, 1, now=0.150)        # first token on the prefill pool
+    t_pre.migrate_start(0, now=0.200)     # export dispatched
+    rec = t_pre.transfer(0, t_dec)
+    assert rec is not None and t_pre.get(0) is None
+    t_dec.admit(0, uid=9, now=0.260)      # re-admitted on the decode pool
+    t_dec.migrated(0, n_blocks=3, now=0.260)
+    t_dec.emitted(0, 2, now=0.300)
+    t_dec.emitted(0, 2, now=0.320)        # clean chain: 10 ms/token
+    t_dec.finish(0, now=0.320)
+
+    reg = tr.registry
+    # the readmit wait is the 60 ms migration window, NOT 260 ms from
+    # arrival (which would double-count the 100 ms defer window already in
+    # queue_wait) and NOT anchored anywhere before the hand-off
+    assert reg.histogram("serving/readmit_wait_ms",
+                         replica=1).last == pytest.approx(60.0)
+    assert reg.histogram("serving/migration_ms",
+                         replica=1).last == pytest.approx(60.0)
+    assert reg.counter("serving/migrated_blocks", replica=1).value == 3
+    assert reg.histogram("serving/queue_wait_ms",
+                         replica=0).last == pytest.approx(100.0)
+    # TTFT from the ORIGINAL arrival, stamped on the prefill replica
+    assert reg.histogram("serving/ttft_ms",
+                         replica=0).last == pytest.approx(150.0)
+    # the TPOT chain restarted cleanly on the decode replica: the 140 ms
+    # arrival->decode-pool gap never becomes a TPOT sample
+    h = reg.histogram("serving/tpot_ms", replica=1)
+    assert h.count == 1 and h.last == pytest.approx(10.0)
+    assert reg.histogram("serving/tpot_ms", replica=0).count == 0
+    assert rec.migrations == 1 and rec.readmissions == 1
+    # finish-side accounting landed on the destination's labels
+    assert reg.counter("serving/requests_finished", replica=1).value == 1
+    assert reg.counter("serving/requests", replica=0).value == 1
+
+
+def test_migrate_failed_resumes_on_source():
+    clk = FakeClock()
+    tr = Tracer(enabled=True)
+    t = LifecycleTracker(tr, slo=ServingSLOConfig(), clock=clk)
+    t.arrive(0, now=0.0)
+    t.admit(0, uid=1, now=0.01)
+    t.emitted(0, 1, now=0.02)
+    t.migrate_start(0, now=0.03)
+    t.migrate_failed(0)
+    rec = t.get(0)
+    assert rec.phase == "decoding" and rec.migrations == 0
+    assert tr.registry.counter("serving/migration_failures").value == 1
+    assert tr.registry.histogram("serving/migration_ms").count == 0
+
+
 def test_goodput_undefined_without_targets():
     tr = Tracer(enabled=True)
     t = LifecycleTracker(tr, slo=ServingSLOConfig(), clock=FakeClock())

@@ -210,6 +210,37 @@ def test_collector_federates_and_rolls_up():
         col.stop()
 
 
+def test_collector_per_role_rollups():
+    """Disagg topology rollups (ISSUE 14): role membership and role-summed
+    serving rates read as labelled children of the federated view."""
+    col = FleetCollector().start()
+    try:
+        for k, role in enumerate(("prefill", "decode", "decode")):
+            reg = MetricsRegistry()
+            reg.gauge("serving/tokens_per_s").set(100.0 * (k + 1))
+            ident = fleet.ProcessIdentity("testrun", k, host="h", role=role)
+            client = FleetClient(col.url, identity=ident, registry=reg,
+                                 observatory=None)
+            assert client.register()["ok"]
+            assert client.push(heartbeat_extra={"step_rate": 10.0 * (k + 1)},
+                               include_table=False)["ok"]
+        fed = col.federated_registry()
+        assert fed.gauge("fleet/role_processes", role="prefill").value == 1.0
+        assert fed.gauge("fleet/role_processes", role="decode").value == 2.0
+        # role-summed tokens/s: decode pool = procs 1+2 = 200+300
+        assert fed.gauge("fleet/tokens_per_s", role="decode").value == 500.0
+        assert fed.gauge("fleet/tokens_per_s", role="prefill").value == 100.0
+        # unlabelled rollup unchanged (the whole fleet)
+        assert fed.gauge("fleet/tokens_per_s").value == 600.0
+        assert fed.gauge("fleet/step_rate_min", role="decode").value == 20.0
+        assert fed.gauge("fleet/step_rate_min").value == 10.0
+        ledger = col.ledger()
+        assert {r["identity"]["role"] for r in ledger["processes"]} == \
+            {"prefill", "decode"}
+    finally:
+        col.stop()
+
+
 def test_collector_http_endpoints_and_ledger():
     col = FleetCollector(stale_after_s=30.0).start()
     try:

@@ -117,7 +117,10 @@ def test_lint_scans_telemetry_and_serving_sources():
                   "fleet.py", "collector.py")
     } | {
         os.path.join("deepspeed_tpu", "inference", f)
-        for f in ("engine_v2.py", "lifecycle.py", "router.py")
+        for f in ("engine_v2.py", "lifecycle.py", "router.py",
+                  # disagg serving (ISSUE 14): migration transport rides the
+                  # serving metric families minted in router/lifecycle
+                  "migrate.py")
     } | {os.path.join("tools", "bench_serving.py"),
          os.path.join("tools", "fleet_smoke.py"),
          os.path.join("tools", "trace_merge.py")}
@@ -138,7 +141,11 @@ def test_known_names_pass_and_bad_names_fail():
                  "serving/readmit_wait_ms",
                  # fleet telemetry plane (ISSUE 13)
                  "fleet/goodput", "fleet/tokens_per_s", "fleet/step_rate_min",
-                 "fleet/straggler", "fleet/clock_offset_s"):
+                 "fleet/straggler", "fleet/clock_offset_s",
+                 # disaggregated serving (ISSUE 14)
+                 "serving/migration_ms", "serving/migrated_blocks",
+                 "serving/migration_failures", "router/migrations",
+                 "fleet/role_processes"):
         assert _check_name(good) is None, good
     for bad in ("ttft", "Serving/ttft", "serving ttft", "{x}/y", "bogus/name"):
         assert _check_name(bad) is not None, bad

@@ -601,7 +601,7 @@ def bench_router(replicas=2, n_requests=48, rate=300.0, n_new=48, chain=8,
             router.serve(prompts[:2 * replicas], max_new_tokens=chain + 1)
         tr.reset()
         router.reset_estimates()  # drop compile-time-poisoned latency EMAs
-        router.shed_count = 0
+        router.reset_stats()
         t0 = time.perf_counter()
         outs = router.serve(prompts, max_new_tokens=n_new,
                             arrival_times=arrivals)
@@ -725,6 +725,192 @@ def bench_spec(n_new=24, chain=8, n_spec=3, rows=4, seed=1) -> Dict:
     }
 
 
+def _merged_quantiles(reg, name: str) -> Dict:
+    """Merge every labelled child of a histogram family (one per replica)
+    bucket-wise — the PR-13 federation fold — and answer percentiles over
+    the combined stream."""
+    from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+    tmp = MetricsRegistry().histogram("serving/tmp_merge")
+    n = 0
+    for kind, base, metric in reg.iter_metrics():
+        if kind == "histogram" and base == name and metric.count:
+            tmp.merge_state(metric.state())
+            n += 1
+    if not tmp.count:
+        return {"count": 0}
+    return {"count": tmp.count,
+            "p50": round(tmp.quantile(0.50), 3),
+            "p95": round(tmp.quantile(0.95), 3),
+            "p99": round(tmp.quantile(0.99), 3),
+            "mean": round(tmp.summary()["mean"], 3),
+            "families": n}
+
+
+def bench_disagg(n_requests=24, rate=200.0, n_new=24, chain=8, prompt_len=96,
+                 pool_blocks_per_replica=96, block_size=16, kv_dtype="bf16",
+                 seed=0, parity_dtypes=("bf16", "int8")) -> Dict:
+    """Disaggregated vs mixed serving at EQUAL hardware (ISSUE 14).
+
+    The workload is the exact tail ROADMAP #2 names: a prefill-heavy open
+    loop (long prompts, Poisson arrivals fast enough that prefills keep
+    landing while decodes are in flight), where a mixed replica's long
+    prefill dispatch sits between its own decode-chain boundaries and blows
+    TPOT. Both rosters get the same total KV bytes and the same engine
+    configs; the disagg side splits the byte budget per role
+    (``utils/hbm.disagg_pool_bytes``) and migrates every finished prefill
+    to the decode pool. Reported: TTFT/TPOT percentile tables per side
+    (histograms merged bucket-wise across replicas), the migration-latency
+    histogram, decode TPOT p99 ratio — plus greedy token parity of the
+    migrated requests against a never-migrated single engine on every
+    ``parity_dtypes`` pool (the acceptance pin)."""
+    from deepspeed_tpu.inference import InferenceEngineV2, ServingRouter
+    from deepspeed_tpu.telemetry import get_tracer
+    from deepspeed_tpu.utils.hbm import kv_slot_bytes
+
+    cfg, params = _kv_bench_model()
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, cfg.vocab_size, (prompt_len,))
+               for _ in range(n_requests)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n_requests)).tolist()
+    # the tier budget is fixed at what 2x pool_blocks_per_replica bf16
+    # blocks cost — both rosters split the SAME bytes (equal hardware)
+    slot_b = kv_slot_bytes(cfg.num_layers, cfg.num_kv_heads,
+                           cfg.hidden_size // cfg.num_heads, 2, None)
+    total_bytes = 2 * pool_blocks_per_replica * block_size * slot_b
+    eng_cfg = {"dtype": "fp32", "kv_block_size": block_size,
+               "kv_cache_dtype": kv_dtype, "max_seqs": 8, "row_bucket": 4,
+               "decode_chain": chain, "hbm_check": "off",
+               "kv_pool_bytes": total_bytes // 2}
+
+    tr = get_tracer()
+    was_enabled = tr.enabled
+    tr.configure(enabled=True)
+    try:
+        def run_side(roles):
+            tr.reset()
+            kw = {"replicas": 2, "dispatch": "threads"}
+            if roles is not None:
+                kw["roles"] = roles
+                cfg_side = dict(eng_cfg, kv_pool_bytes=total_bytes)
+            else:
+                cfg_side = dict(eng_cfg)
+            router = ServingRouter.build(cfg, params, cfg_side, **kw)
+            for _ in range(2):  # compile both program generations off-clock
+                router.serve(prompts[:4], max_new_tokens=chain + 1)
+            tr.reset()
+            router.reset_estimates()
+            router.reset_stats()  # measured window only, not warmup
+            t0 = time.perf_counter()
+            outs = router.serve(prompts, max_new_tokens=n_new,
+                                arrival_times=arrivals)
+            wall = time.perf_counter() - t0
+            reg = tr.registry
+            side = {
+                "wall_s": round(wall, 3),
+                "served": sum(1 for o in outs if o is not None),
+                "tokens_per_sec": round(
+                    sum(len(o) for o in outs if o is not None) / wall, 1),
+                "ttft_ms": _merged_quantiles(reg, "serving/ttft_ms"),
+                "tpot_ms": _merged_quantiles(reg, "serving/tpot_ms"),
+                "queue_wait_ms": _merged_quantiles(reg,
+                                                   "serving/queue_wait_ms"),
+                "kv_blocks": [r.engine.num_kv_blocks for r in router.replicas],
+                "stats": router.stats(),
+            }
+            if roles is not None:
+                side["migration_ms"] = _merged_quantiles(
+                    reg, "serving/migration_ms")
+            return side, outs
+
+        mixed, _ = run_side(None)
+        disagg, _ = run_side(["prefill", "decode"])
+
+        # greedy parity of MIGRATED output vs a never-migrated single
+        # engine, per pool storage dtype (the acceptance criterion)
+        parity = {}
+        par_prompts = prompts[:6]
+        for pd in parity_dtypes:
+            pcfg = dict(eng_cfg, kv_cache_dtype=pd,
+                        kv_pool_bytes=total_bytes)
+            ref = InferenceEngineV2(
+                cfg, params, dict(pcfg, kv_pool_bytes=total_bytes // 2)
+            ).generate(par_prompts, max_new_tokens=n_new)
+            r = ServingRouter.build(cfg, params, pcfg, replicas=2,
+                                    roles=["prefill", "decode"])
+            outs = r.serve(par_prompts, max_new_tokens=n_new)
+            parity[pd] = {
+                "migrations": r.migrations,
+                "token_identical": bool(all(
+                    o is not None and len(o) == len(rf) and (o == rf).all()
+                    for o, rf in zip(outs, ref))),
+            }
+
+        tpot_ratio = None
+        if mixed["tpot_ms"].get("p99") and disagg["tpot_ms"].get("p99"):
+            tpot_ratio = round(
+                mixed["tpot_ms"]["p99"] / disagg["tpot_ms"]["p99"], 3)
+        return {
+            "requests": n_requests, "rate_req_s": rate,
+            "prompt_tokens": prompt_len, "new_tokens": n_new,
+            "decode_chain": chain, "kv_dtype": kv_dtype,
+            "total_pool_bytes": total_bytes,
+            "mixed_2_replicas": mixed,
+            "disagg_1p_1d": disagg,
+            "decode_tpot_p99_improvement": tpot_ratio,
+            "migrated_output_parity": parity,
+        }
+    finally:
+        tr.configure(enabled=was_enabled)
+        if not was_enabled:
+            tr.reset()
+
+
+def disagg_smoke() -> Dict:
+    """Nightly disagg smoke (ISSUE 14): a 2-pool CPU run exit-gated on
+    (1) zero dropped-but-admitted requests, (2) >= 1 successful migration,
+    and (3) migrated output token-identical to a never-migrated run — on a
+    bf16 AND an int8 pool."""
+    from deepspeed_tpu.inference import InferenceEngineV2, ServingRouter
+
+    cfg, params = _tiny_model()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (12 + i % 5,))
+               for i in range(10)]
+    out: Dict[str, Dict] = {"pools": {}}
+    ok = True
+    for kvd in ("bf16", "int8"):
+        eng_cfg = {"dtype": "fp32", "kv_block_size": 16, "num_kv_blocks": 96,
+                   "kv_cache_dtype": kvd, "max_seqs": 6, "decode_chain": 4,
+                   "hbm_check": "off"}
+        ref = InferenceEngineV2(cfg, params, eng_cfg).generate(
+            prompts, max_new_tokens=8)
+        router = ServingRouter.build(cfg, params, eng_cfg, replicas=2,
+                                     roles=["prefill", "decode"])
+        outs = router.serve(
+            prompts, max_new_tokens=8,
+            arrival_times=[0.002 * i for i in range(len(prompts))])
+        finished = sum(1 for o in outs if o is not None and len(o) == 8)
+        dropped = len(prompts) - finished - router.shed_count
+        identical = bool(all(
+            o is not None and (o == r).all() for o, r in zip(outs, ref)))
+        row = {
+            "requests": len(prompts), "finished": finished,
+            "shed": router.shed_count,
+            "dropped_after_admission": dropped,
+            "migrations": router.migrations,
+            "migrated_blocks": router.migrated_blocks,
+            "migration_failures": router.migration_failures,
+            "output_identical_to_never_migrated": identical,
+        }
+        row_ok = (dropped == 0 and router.migrations >= 1 and identical)
+        row["pass"] = bool(row_ok)
+        ok = ok and row_ok
+        out["pools"][kvd] = row
+    out["pass"] = bool(ok)
+    return out
+
+
 def router_smoke(replicas=2) -> Dict:
     """Nightly serving-router smoke: N CPU replicas under a shared-prefix
     burst. Exit-gates (run_nightly.sh): prefix_hit_rate > 0 and ZERO
@@ -797,8 +983,35 @@ def main() -> None:
                     help="nightly smoke: 2 CPU replicas + shared-prefix "
                          "burst; exits nonzero unless prefix_hit_rate > 0 "
                          "and zero dropped-but-admitted requests")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the disaggregated-vs-mixed bench: 1 prefill + "
+                         "1 decode replica vs 2 mixed at equal hardware "
+                         "under a prefill-heavy Poisson burst (TTFT/TPOT "
+                         "percentiles + migration histogram + parity)")
+    ap.add_argument("--disagg-smoke", action="store_true",
+                    help="nightly smoke: 2-pool disagg CPU run; exits "
+                         "nonzero unless zero dropped-but-admitted, >=1 "
+                         "migration, and migrated output token-identical "
+                         "to a never-migrated run on bf16 AND int8 pools")
     ap.add_argument("--output", type=str, default=None)
     args = ap.parse_args()
+
+    if args.disagg_smoke:
+        res = disagg_smoke()
+        print(json.dumps(res, indent=2))
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump(res, f, indent=2)
+        sys.exit(0 if res["pass"] else 1)
+
+    if args.disagg:
+        res = {"disagg": bench_disagg(chain=args.chain)}
+        text = json.dumps(res, indent=2)
+        print(text)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text + "\n")
+        sys.exit(0)
 
     if args.router_smoke:
         res = router_smoke(replicas=max(args.replicas, 2))

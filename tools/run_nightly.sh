@@ -82,6 +82,25 @@ JAX_PLATFORMS=cpu python tools/bench_serving.py --router-smoke 2>/dev/null \
 router_rc=${PIPESTATUS[0]}
 [ "${router_rc}" -ne 0 ] && rc=1
 
+# Disaggregated-serving smoke (ISSUE 14): a 2-pool CPU run (1 prefill + 1
+# decode replica) exit-gated on zero dropped-but-admitted requests, >= 1
+# successful KV-block migration, and migrated output token-identical to a
+# never-migrated run — on a bf16 AND an int8 pool (quantized bytes move
+# verbatim). Committed as its own artifact so the migration data plane is
+# auditable per round.
+DISAGG_OUT="DISAGG_${ROUND}.log"
+{
+  echo "# disaggregated-serving smoke — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  echo "# HEAD: ${HEAD_SHA}"
+  echo "# uncommitted-diff sha256: ${DIFF_SHA}"
+  echo "# cmd: python tools/bench_serving.py --disagg-smoke"
+} > "${DISAGG_OUT}"
+JAX_PLATFORMS=cpu python tools/bench_serving.py --disagg-smoke 2>/dev/null \
+  | tee -a "${DISAGG_OUT}"
+disagg_rc=${PIPESTATUS[0]}
+[ "${disagg_rc}" -ne 0 ] && rc=1
+echo "# disagg smoke: ${DISAGG_OUT} (exit ${disagg_rc})" >> "${OUT}"
+
 # Compiled-program inventory (ISSUE 7): the registry must capture a real
 # train-step and v2 decode-chain program with nonzero flops/peak-HBM and a
 # computed hbm/estimate_ratio. Committed alongside this log as its own
@@ -137,8 +156,8 @@ fleet_rc=${PIPESTATUS[0]}
 echo "# fleet smoke: ${FLEET_OUT} (exit ${fleet_rc})" >> "${OUT}"
 
 {
-  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc})"
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, disagg smoke: ${disagg_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
-echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT}"
+echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT} ${DISAGG_OUT}"
 exit "${rc}"

@@ -168,6 +168,34 @@ def linked_flow_pids(trace: Dict[str, Any]) -> Dict[int, List[int]]:
     return out
 
 
+def migration_links(trace: Dict[str, Any]) -> Dict[int, List[int]]:
+    """flow id -> sorted pids, for flows that STEP inside a
+    ``serve:migrate`` slice — the disaggregated-serving hand-off arrow
+    (ISSUE 14): a request's admission flow starts on the prefill replica's
+    stream and steps inside the decode replica's ``serve:migrate`` import
+    slice, so the merged trace draws the prefill->decode migration arrow.
+    The disagg smoke gates on at least one such link."""
+    # one pass each over slices and flow events (a nightly merge can carry
+    # thousands of migrations — no per-step rescans of the whole stream)
+    slices: Dict[Any, List[Any]] = {}
+    for e in trace["traceEvents"]:
+        if e.get("ph") == "X" and e.get("name") == "serve:migrate":
+            slices.setdefault((e["pid"], e.get("tid")), []).append(
+                (e["ts"], e["ts"] + e.get("dur", 0.0)))
+    flow_pids: Dict[int, set] = {}  # fid -> pids of EVERY bindable event of
+    migrated: set = set()           # the flow — the arrow spans src and dst
+    for ev in trace["traceEvents"]:
+        if ev.get("ph") not in ("s", "t", "f") or "id" not in ev:
+            continue
+        fid = ev["id"]
+        flow_pids.setdefault(fid, set()).add(ev["pid"])
+        if ev["ph"] == "t":
+            spans = slices.get((ev["pid"], ev.get("tid")), ())
+            if any(a <= ev["ts"] <= b for a, b in spans):
+                migrated.add(fid)
+    return {f: sorted(flow_pids[f]) for f in migrated}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("inputs", nargs="+", help="per-process events.jsonl files")
@@ -179,9 +207,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     with open(args.output, "w") as f:
         json.dump(trace, f)
     links = {f: p for f, p in linked_flow_pids(trace).items() if len(p) > 1}
+    n_mig = len(migration_links(trace))
     n_ev = len(trace["traceEvents"])
     print(f"wrote {args.output}: {n_ev} events from {len(args.inputs)} "
-          f"stream(s); {len(links)} cross-process flow link(s)")
+          f"stream(s); {len(links)} cross-process flow link(s); "
+          f"{n_mig} migration flow link(s)")
     return 0
 
 
