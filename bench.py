@@ -908,6 +908,73 @@ def bench_compile_observability():
     }
 
 
+def bench_moe_ep_tp():
+    """MoE ep x tp composition micro-bench (ISSUE 15): per-step time of the
+    collective token dispatch on a dp2 x ep2 x tp2 CPU mesh, exact wire vs
+    the int8 quantized dispatch wire, plus the loss parity between them.
+
+    CPU numbers measure DISPATCH/SCHEDULE structure, not interconnect (the
+    wire win only exists on a real fabric) — the value here is trend
+    evidence that the quantized path's program stays step-shaped (no
+    per-hop host sync, no recompile churn) and numerically bounded. Needs
+    >= 8 devices; records skipped otherwise."""
+    import time as _time
+
+    import numpy as np
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+
+    if len(jax.devices()) < 8:
+        return {"skipped": f"needs 8 devices, have {len(jax.devices())}"}
+
+    def build(codec):
+        # both arms force the SAME ring schedule so the reported ratio is
+        # purely the wire codec's cost, never lax-vs-ring schedule delta
+        cfg = TransformerConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, max_seq_len=128, num_experts=4,
+            moe_top_k=2, moe_capacity_factor=2.0,
+            moe_dispatch_algorithm="ring",
+            moe_wire_codec=codec)
+        eng, *_ = deepspeed_tpu.initialize(
+            model=causal_lm_spec(cfg, example_seq_len=64), config={
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 1},
+                "mesh": {"dp": 2, "ep": 2, "tp": 2},
+                "steps_per_print": 10_000,
+            }, seed=5)
+        return eng
+
+    def clock(eng, steps=8, warmup=2):
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(
+            0, 512, (eng.train_batch_size, 64), dtype=np.int32)}
+        losses = []
+        for _ in range(warmup):
+            eng.train_batch(batch)["loss"].block_until_ready()
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            losses.append(eng.train_batch(batch)["loss"])
+        losses[-1].block_until_ready()
+        dt = (_time.perf_counter() - t0) / steps
+        return dt * 1e3, float(losses[-1])
+
+    exact_ms, exact_loss = clock(build(None))
+    int8_ms, int8_loss = clock(build("int8"))
+    return {
+        "mesh": "dp2xep2xtp2",
+        "step_ms_exact_wire": round(exact_ms, 2),
+        "step_ms_int8_wire": round(int8_ms, 2),
+        "int8_over_exact": round(int8_ms / exact_ms, 3) if exact_ms else None,
+        "loss_rel_gap": round(abs(int8_loss - exact_loss)
+                              / max(abs(exact_loss), 1e-9), 6),
+        "degraded": True,  # CPU: structure evidence, not interconnect perf
+    }
+
+
 def bench_coll_observability():
     """Host overhead of the collective observatory's timing mode
     (``collectives/observatory.py``) — the <2% bound ISSUE 11 commits to,
@@ -1343,6 +1410,12 @@ def main() -> None:
         extras["fleet_export_overhead"] = bench_fleet_overhead()
     except Exception as e:  # noqa: BLE001
         extras["fleet_export_overhead"] = {"error": str(e)[:200]}
+    # MoE ep x tp collective dispatch: step-shape + numeric-bound evidence
+    # for the quantized token wire (ISSUE 15); needs the 8-device CPU mesh.
+    try:
+        extras["moe_ep_tp"] = bench_moe_ep_tp()
+    except Exception as e:  # noqa: BLE001
+        extras["moe_ep_tp"] = {"error": str(e)[:200]}
     result = {
         "metric": f"tokens_per_sec_per_chip_gpt2_125m_bf16_seq{seq}" if on_tpu
         else f"tokens_per_sec_cpu_smoke_seq{seq}",

@@ -25,6 +25,7 @@ from deepspeed_tpu.collectives.algorithms import (
     ALGORITHMS,
     all_gather,
     all_reduce,
+    all_to_all,
     reduce_scatter,
 )
 from deepspeed_tpu.collectives.pallas_backend import (

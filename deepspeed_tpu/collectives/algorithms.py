@@ -440,6 +440,195 @@ def _hier_all_reduce_axes(x: jax.Array, axes: Sequence[str], codec: Codec) -> ja
     return gathered.reshape(-1)[:N].reshape(x.shape)
 
 
+# ---------------------------------------------------------------- all-to-all
+#
+# Schedules (The Big Send-off, arxiv 2504.18658): the payload is [n]
+# destination rows (row d = this rank's block for rank d, flattened).
+#
+# - ``ring``   — the shift schedule: phase k moves the row destined k ranks
+#   ahead DIRECTLY via a distance-k permutation (n-1 serial phases, each a
+#   single facade ppermute / remote-DMA kernel carrying one row's wire).
+# - ``bidir``  — phases paired with their mirror distance: phase k also
+#   moves the row destined k ranks BEHIND on the counter-rotating ring, so
+#   full-duplex links finish in ceil((n-1)/2) serial phases.
+# - ``ring2d`` — the Big-Send-off sub-ring factorization: the axis factored
+#   a x b (rank = u*b + v), destination rows bundled by target column and
+#   exchanged on the intra sub-ring (b-1 phases of a-row bundles), then
+#   re-bundled by target row and exchanged on the inter sub-ring (a-1
+#   phases of b-row bundles) — (a-1)+(b-1) hops instead of n-1, at
+#   S*((b-1)/b + (a-1)/a) wire volume instead of S*(n-1)/n.
+#
+# Codec semantics: every destination row is encoded ONCE at the source and
+# decoded once at its destination — relays (ring2d's middle hop) forward
+# the WIRE, never re-quantizing. The own row never crosses a link and stays
+# raw. There is no reduction, so no error feedback applies.
+
+
+def _wire_take(wire: "Wire", idx) -> "Wire":
+    """Rows ``idx`` of a blocked-rows wire (zero-size scale placeholders of
+    passthrough codecs pass through untouched)."""
+    take = lambda a: a if a.size == 0 else jnp.take(a, idx, axis=0)
+    return type(wire)(*(take(leaf) for leaf in wire))
+
+
+def _wire_update(wire: "Wire", rows: "Wire", idx) -> "Wire":
+    """Write ``rows`` into ``wire`` at leading index ``idx`` (traced ok)."""
+    upd = lambda a, r: a if a.size == 0 else jnp.asarray(a).at[idx].set(r)
+    return type(wire)(*(upd(leaf, r) for leaf, r in zip(wire, rows)))
+
+
+def _shift_perm(n: int, k: int):
+    """Distance-k right-shift permutation of the whole axis."""
+    return [(s, (s + k) % n) for s in range(n)]
+
+
+def _ring_all_to_all_rows(rows: jax.Array, axis, codec: Codec, *,
+                          bidir: bool = False) -> jax.Array:
+    """All-to-all of ``[n, L]`` destination rows -> ``[n, L]`` rows ordered
+    by source rank (shift schedule: phase k permutes the row destined k
+    ranks ahead directly at distance k)."""
+    n = axis_size(axis)
+    i = jax.lax.axis_index(axis) if n > 1 else 0
+    perm_k = lambda k: _shift_perm(n, k)
+    label = f"all_to_all:{'bidir' if bidir else 'ring'}"
+    L = rows.shape[1]
+    if n == 1:
+        return rows
+    if (pallas_backend.hops_active() and not bidir
+            and pallas_backend.fusable(codec, rows.dtype)
+            and pallas_backend.remote_dma_supported()):
+        # EQuARX transport minus the accumulate: each phase is ONE kernel
+        # requantizing the outgoing row in VMEM, remote-DMAing the wire and
+        # dequantizing at the receiver (bidir/exact wires take the generic
+        # unfused loop below, whose hops remote-DMA the encoded wire)
+        return pallas_backend.fused_ring_all_to_all_rows(
+            rows, axis, codec, n=n, i=i, perm_k=perm_k, label=label)
+    wire = codec.encode_rows(rows)  # encode once per destination row
+    out = jnp.zeros((n, L), rows.dtype).at[i].set(
+        jax.lax.dynamic_index_in_dim(rows, i, axis=0)[0])  # own row: raw
+    phases = range(1, (n // 2) + 1) if bidir else range(1, n)
+    hop = 0
+    for k in phases:
+        sends = [k] if (not bidir or (2 * k == n)) else [k, n - k]
+        with _hop_span(label, axis, hop, codec):
+            for d in sends:
+                send = _wire_take(wire, (i + d) % n)
+                recv = _permute_wire(send, axis, perm_k(d))
+                dec = codec.decode_rows(
+                    type(wire)(*(leaf if leaf.size == 0 else leaf[None]
+                                 for leaf in recv)), L, rows.dtype)[0]
+                out = jax.lax.dynamic_update_index_in_dim(
+                    out, dec[None], (i - d) % n, axis=0)
+        hop += 1
+    return out
+
+
+def _ring2d_all_to_all_rows(rows: jax.Array, axis, codec: Codec) -> jax.Array:
+    """Sub-ring factored 2D all-to-all of ``[n, L]`` destination rows
+    (rank = u*b + v). Phase 1 exchanges a-row bundles on the intra (v)
+    sub-ring grouped by target column; phase 2 exchanges b-row bundles on
+    the inter (u) sub-ring grouped by target row. The wire is encoded once
+    at the source and relayed through the middle hop in WIRE form."""
+    n = axis_size(axis)
+    L = rows.shape[1]
+    if n == 1:
+        return rows
+    a, b = _factor_near_square(n)
+    if a == 1 or b == 1:
+        return _ring_all_to_all_rows(rows, axis, codec)
+    i = jax.lax.axis_index(axis)
+    u, v = i // b, i % b
+    intra_k = lambda k: [(s, (s // b) * b + ((s % b) + k) % b) for s in range(n)]
+    inter_k = lambda k: [(s, (((s // b) + k) % a) * b + (s % b)) for s in range(n)]
+
+    wire = codec.encode_rows(rows)  # [n, ...] encoded once per destination
+    zero_like = lambda leaf, lead: (leaf if leaf.size == 0
+                                    else jnp.zeros((lead,) + leaf.shape[1:], leaf.dtype))
+    # buf1[w] = the a-row bundle from intra peer (u, w): rows destined to
+    # the ranks of column v, ordered by target row u'
+    buf1 = type(wire)(*(zero_like(leaf, b * a) for leaf in wire))
+    own_idx = jnp.arange(a) * b + v
+    buf1 = _wire_update(buf1, _wire_take(wire, own_idx), v * a + jnp.arange(a))
+    for k in range(1, b):
+        dest_col = (v + k) % b
+        bundle = _wire_take(wire, jnp.arange(a) * b + dest_col)
+        with _hop_span("all_to_all:ring2d/intra", axis, k - 1, codec):
+            recv = _permute_wire(bundle, axis, intra_k(k))
+        src_col = (v - k) % b
+        buf1 = _wire_update(buf1, recv, src_col * a + jnp.arange(a))
+    # buf1 leaves are [b*a, ...]: index w*a + u' = (source (u, w)) -> (u', v)
+
+    # phase 2: bundle by target row u' (for each source column w) and
+    # exchange on the inter sub-ring; out rows ordered by global source rank
+    out_wire = type(wire)(*(zero_like(leaf, n) for leaf in wire))
+    own_rows = _wire_take(buf1, jnp.arange(b) * a + u)
+    out_wire = _wire_update(out_wire, own_rows, u * b + jnp.arange(b))
+    for k in range(1, a):
+        dest_row = (u + k) % a
+        bundle = _wire_take(buf1, jnp.arange(b) * a + dest_row)  # [b, ...]
+        with _hop_span("all_to_all:ring2d/inter", axis, k - 1, codec):
+            recv = _permute_wire(bundle, axis, inter_k(k))
+        src_row = (u - k) % a
+        out_wire = _wire_update(out_wire, recv, src_row * b + jnp.arange(b))
+    out = codec.decode_rows(out_wire, L, rows.dtype)
+    # the own row never crossed a link: keep it raw (lossless)
+    return jax.lax.dynamic_update_index_in_dim(
+        out, jax.lax.dynamic_index_in_dim(rows, i, axis=0), i, axis=0)
+
+
+def all_to_all(x: jax.Array, axis, *, split_axis: int, concat_axis: int,
+               algorithm: str = "ring", codec="none",
+               block_size: Optional[int] = None) -> jax.Array:
+    """Algorithmic all-to-all with ``lax.all_to_all(tiled=True)`` semantics:
+    the ``split_axis`` dim divides into n blocks (block d to rank d) and the
+    received blocks concatenate along ``concat_axis`` ordered by source
+    rank. Must run inside full-manual shard_map.
+    """
+    if isinstance(axis, (tuple, list)):
+        if len(axis) != 1:
+            raise ValueError(f"algorithmic all_to_all takes one axis, got {axis}")
+        axis = axis[0]
+    if algorithm == "rhd":
+        raise ValueError(
+            "all_to_all has no recursive-halving schedule (every block has "
+            "exactly one destination); use ring / bidir / ring2d")
+    known = tuple(a for a in ALGORITHMS if a != "rhd") + PALLAS_ALGORITHMS
+    if algorithm not in known:
+        raise ValueError(f"unknown algorithm {algorithm!r} (one of {known})")
+    c = get_codec(codec, block_size)
+    n = axis_size(axis)
+    if x.shape[split_axis] % n:
+        raise ValueError(
+            f"all_to_all split dim {x.shape[split_axis]} not divisible by "
+            f"axis size {n}")
+    m = x.shape[split_axis] // n
+    moved = jnp.moveaxis(x, split_axis, 0)  # [n*m, *rest]
+    rest = moved.shape[1:]
+    rows = moved.reshape(n, -1)  # [n, L]: row d = the block destined to rank d
+
+    if algorithm in PALLAS_ALGORITHMS:
+        with pallas_backend.hop_scope():
+            if algorithm == "pallas_ring":
+                out_rows = _ring_all_to_all_rows(rows, axis, c)
+            else:  # pallas_ring2d: the same a x b factorization
+                out_rows = _ring2d_all_to_all_rows(rows, axis, c)
+    elif algorithm == "ring":
+        out_rows = _ring_all_to_all_rows(rows, axis, c)
+    elif algorithm == "bidir":
+        out_rows = _ring_all_to_all_rows(rows, axis, c, bidir=True)
+    else:  # ring2d (names validated above)
+        out_rows = _ring2d_all_to_all_rows(rows, axis, c)
+
+    # assemble with tiled semantics: out_rows[s] = block from source s
+    blocks = out_rows.reshape((n, m) + rest)      # [n, m, *rest] (moved order)
+    blocks = jnp.moveaxis(blocks, 1, split_axis + 1)  # m back to split slot
+    blocks = jnp.moveaxis(blocks, 0, concat_axis)     # n in front of concat dim
+    shape = list(x.shape)
+    shape[split_axis] = m
+    shape[concat_axis] = shape[concat_axis] * n if concat_axis != split_axis else n * m
+    return blocks.reshape(shape)
+
+
 # ------------------------------------------------------------------ dispatch
 
 

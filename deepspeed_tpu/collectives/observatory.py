@@ -399,7 +399,8 @@ class CollectiveObservatory:
         from deepspeed_tpu.comm.benchmark import candidate_pairs
 
         for pair in candidate_pairs(info.world,
-                                    tuple(dict.fromkeys((info.codec, "none")))):
+                                    tuple(dict.fromkeys((info.codec, "none"))),
+                                    op=info.op):
             if pair not in out:
                 out.append(pair)
         return out

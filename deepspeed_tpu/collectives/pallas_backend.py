@@ -322,7 +322,7 @@ def _fused_hop_kernel(idx_ref, send_blk, recv_blk, out_blk,
                       send_q, send_s, recv_q, recv_s,
                       sq_sem, ss_sem, rq_sem, rs_sem, cap_sem,
                       *, C: int, B: int, qb: int, encode, decode,
-                      interpret: bool):
+                      interpret: bool, accumulate: bool = True):
     """One ring hop, fused: grid step ``j`` requantizes chunk ``j`` of the
     accumulated send row into a VMEM wire slot and launches its remote DMA,
     then dequant-accumulates chunk ``j-1`` (whose DMA was launched last
@@ -375,7 +375,12 @@ def _fused_hop_kernel(idx_ref, send_blk, recv_blk, out_blk,
         q_copy(prev).wait_recv()
         s_copy(prev).wait_recv()
         deq = decode(recv_q[prev].reshape(nb, qb), recv_s[prev].reshape(nb, 1))
-        out_blk[0] = recv_blk[0] + deq.reshape(B).astype(jnp.float32)
+        if accumulate:
+            out_blk[0] = recv_blk[0] + deq.reshape(B).astype(jnp.float32)
+        else:
+            # the all-to-all hop: the PR-8 fused reduce hop MINUS the
+            # accumulate — the dequantized wire IS the received row
+            out_blk[0] = deq.reshape(B).astype(jnp.float32)
         if not interpret:
             # grant the sender upstream one wire-slot credit
             pltpu.semaphore_signal(cap_sem, 1, device_id=src,
@@ -402,10 +407,14 @@ def _fused_hop_kernel(idx_ref, send_blk, recv_blk, out_blk,
 
 
 def _fused_hop(acc: jax.Array, send_idx, recv_idx, dst, src, *,
-               C: int, B: int, qb: int, codec: Codec) -> jax.Array:
+               C: int, B: int, qb: int, codec: Codec,
+               accumulate: bool = True) -> jax.Array:
     """acc ``[n, Lp]`` fp32 (``Lp == C*B``) -> the updated receive row
     ``[Lp]``: ``acc[recv_idx] + dequant(wire(acc[send_idx]))`` where the
-    wire crossed the interconnect quantized. ONE program."""
+    wire crossed the interconnect quantized. ONE program.
+    ``accumulate=False`` drops the add (the all-to-all dispatch hop): the
+    returned row is ``dequant(wire(acc[send_idx]))`` from the upstream
+    neighbor."""
     encode, decode, wdtype = _block_math(codec)
     interpret = _interpret()
     nb = B // qb
@@ -433,7 +442,8 @@ def _fused_hop(acc: jax.Array, send_idx, recv_idx, dst, src, *,
     )
     out = pl.pallas_call(
         functools.partial(_fused_hop_kernel, C=C, B=B, qb=qb,
-                          encode=encode, decode=decode, interpret=interpret),
+                          encode=encode, decode=decode, interpret=interpret,
+                          accumulate=accumulate),
         out_shape=jax.ShapeDtypeStruct((1, C * B), jnp.float32),
         grid_spec=grid_spec,
         compiler_params=_compiler_params(),
@@ -496,3 +506,46 @@ def fused_ring_reduce_scatter_rows(rows: jax.Array, axis, codec: Codec, *,
         acc = lax.dynamic_update_index_in_dim(acc, new_row[None], recv_idx, axis=0)
     out = lax.dynamic_index_in_dim(acc, jnp.asarray(i), axis=0)[0]
     return out[:L]
+
+
+def fused_ring_all_to_all_rows(rows: jax.Array, axis, codec: Codec, *,
+                               n: int, i, perm_k, label: str) -> jax.Array:
+    """All-to-all of ``[n, L]`` destination rows with every phase a single
+    fused requantize -> remote-DMA -> dequantize kernel (the EQuARX fused
+    reduce hop of :func:`fused_ring_reduce_scatter_rows` minus the
+    accumulate) — the shift schedule of
+    ``algorithms._ring_all_to_all_rows``: phase k moves the row destined
+    ``k`` ranks ahead directly via the distance-k permutation ``perm_k(k)``.
+    Each row crosses exactly one hop, so the wire quantizes exactly once,
+    same as the unfused encode-once path. The own row never leaves HBM and
+    stays raw. Returns ``[n, L]`` rows ordered by source rank, in the
+    payload dtype."""
+    from deepspeed_tpu.collectives.algorithms import _hop_span
+    from deepspeed_tpu.comm import comm as dist
+
+    L = rows.shape[1]
+    if n == 1:
+        return rows
+    C, B, qb = _chunk_geometry(L, codec.block_size)
+    Lp = C * B
+    acc = rows.astype(jnp.float32)
+    if Lp != L:
+        acc = jnp.pad(acc, ((0, 0), (0, Lp - L)))
+    out = jnp.zeros((n, Lp), jnp.float32)
+    out = lax.dynamic_update_index_in_dim(
+        out, lax.dynamic_index_in_dim(acc, jnp.asarray(i), axis=0),
+        jnp.asarray(i), axis=0)  # own row: raw, no wire crossed
+    wire_bytes = (Lp + 4 * (Lp // qb)) * 1  # 1B values + fp32 scales, per hop
+    proxy = jax.ShapeDtypeStruct((wire_bytes,), jnp.int8)
+    for k in range(1, n):
+        dst, src = _neighbor_logicals(axis, perm_k(k))
+        send_idx = jnp.asarray((i + k) % n)
+        with _hop_span(label, axis, k - 1, codec, fused=True):
+            with dist._record("remote_dma", axis, proxy, backend="pallas",
+                              fused=codec.name):
+                new_row = _fused_hop(acc, send_idx, send_idx, dst, src,
+                                     C=C, B=B, qb=qb, codec=codec,
+                                     accumulate=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, new_row[None], jnp.asarray((i - k) % n), axis=0)
+    return out[:, :L].astype(rows.dtype)

@@ -35,7 +35,7 @@ from deepspeed_tpu.collectives import pallas_backend
 from deepspeed_tpu.collectives.pallas_backend import PALLAS_ALGORITHMS
 from deepspeed_tpu.utils.logging import logger
 
-OPS = ("all_reduce", "all_gather", "reduce_scatter")
+OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all")
 
 
 @dataclass(frozen=True)
@@ -164,8 +164,26 @@ def _hops_and_volume(op: str, algorithm: str, nbytes: int, n: int) -> Tuple[int,
         base = 2 * frac * nbytes
     elif op == "all_gather":
         base = ring_steps * nbytes
-    else:  # reduce_scatter
+    else:  # reduce_scatter / all_to_all: each rank ships (n-1)/n of S
         base = frac * nbytes
+    if op == "all_to_all":
+        # shift schedule: n-1 direct distance-k permutes of one destination
+        # row each; bidir pairs mirror distances on full-duplex links;
+        # ring2d is the Big-Send-off a x b sub-ring factorization —
+        # (a-1)+(b-1) hops at S*((b-1)/b + (a-1)/a) wire volume. rhd has no
+        # all-to-all form (every block has exactly one destination).
+        if algorithm == "lax":
+            return 0, base / 2
+        if algorithm == "ring":
+            return ring_steps, base
+        if algorithm == "bidir":
+            return max(-(-ring_steps // 2), 0), base / 2
+        if algorithm == "ring2d":
+            a, b = _factor_near_square(n)
+            hops = (a - 1) + (b - 1)
+            vol = nbytes * ((b - 1) / b + (a - 1) / a)
+            return hops, vol
+        raise ValueError(f"no cost model for op={op!r} algorithm={algorithm!r}")
     if algorithm == "lax":
         # the native XLA lowering: assume the best exact schedule the
         # hardware offers (bidirectional, so half the per-link volume) with
@@ -260,7 +278,7 @@ def _model_pick(op: str, nbytes: int, n: int, codec: Optional[str],
                         "model")
     candidates = ALGORITHMS + (PALLAS_ALGORITHMS if pallas_backend.available() else ())
     for alg in candidates:
-        if alg == "rhd" and not pow2:
+        if alg == "rhd" and (not pow2 or op == "all_to_all"):
             continue
         for cd in codecs:
             est = estimate_us(op, alg, cd, nbytes, n, cfg, itemsize)

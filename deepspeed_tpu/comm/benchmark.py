@@ -100,16 +100,17 @@ def run_collective_bench(
     return rows
 
 
-_SWEEP_OPS = ("all_reduce", "all_gather", "reduce_scatter")
+_SWEEP_OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all")
 
 
-def candidate_pairs(world: int, codecs, algorithms=None):
+def candidate_pairs(world: int, codecs, algorithms=None, op: Optional[str] = None):
     """(algorithm, codec) measurement candidates for one axis size — THE
     enumeration shared by ``run_sweep`` and the observatory's probe queue,
     so online rows stay comparable with sweep rows: lax + the ppermute
     schedule families (+ the pallas algorithms when the backend is
-    available), ``rhd`` only on power-of-two worlds, the native lowering
-    never paired with a wire codec."""
+    available), ``rhd`` only on power-of-two worlds (and never for
+    ``all_to_all``, which has no recursive-halving form), the native
+    lowering never paired with a wire codec."""
     from deepspeed_tpu.collectives import pallas_backend
     from deepspeed_tpu.collectives.algorithms import ALGORITHMS
     from deepspeed_tpu.collectives.pallas_backend import PALLAS_ALGORITHMS
@@ -121,7 +122,7 @@ def candidate_pairs(world: int, codecs, algorithms=None):
     pow2 = world > 0 and not (world & (world - 1))
     out = []
     for alg in algorithms:
-        if alg == "rhd" and not pow2:
+        if alg == "rhd" and (not pow2 or op == "all_to_all"):
             continue
         for cd in codecs:
             if alg == "lax" and cd != "none":
@@ -154,6 +155,10 @@ def _algorithmic_fn(op: str, axis: str, algorithm: str, codec: str, block_size: 
     if op == "reduce_scatter":
         return lambda x: dist.reduce_scatter(x, axis, algorithm=algorithm, codec=codec,
                                              block_size=block_size)
+    if op == "all_to_all":
+        return lambda x: dist.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                         algorithm=algorithm, codec=codec,
+                                         block_size=block_size)
     raise ValueError(f"sweep op {op!r} not algorithmic (one of {_SWEEP_OPS})")
 
 
@@ -210,7 +215,7 @@ def run_sweep(
         for size_mb in sizes_mb:
             elems = probe_elems(n, max(int(size_mb * 1e6 / itemsize), n))
             x = jax.device_put(jnp.ones((elems,), dtype), NamedSharding(mesh, P(axis)))
-            for alg, codec in candidate_pairs(n, codecs, algorithms):
+            for alg, codec in candidate_pairs(n, codecs, algorithms, op=op):
                 fn = (_collective_fn(op, axis) if alg == "lax"
                       else _algorithmic_fn(op, axis, alg, codec, block_size))
                 out_spec = P() if op == "all_reduce" else P(axis)
@@ -278,8 +283,7 @@ def main(argv=None) -> int:  # pragma: no cover - CLI body exercised via run_col
         ops = _SWEEP_OPS if a.op == "all" else (a.op,)
         bad = [op for op in ops if op not in _SWEEP_OPS]
         if bad:
-            p.error(f"--sweep supports {_SWEEP_OPS}, not {bad} "
-                    f"(the algorithmic library has no all_to_all)")
+            p.error(f"--sweep supports {_SWEEP_OPS}, not {bad}")
         rows = run_sweep(ops=ops, sizes_mb=sizes, axis=a.axis, iters=a.iters,
                          algorithms=([s for s in a.algorithms.split(",") if s]
                                      if a.algorithms else None),

@@ -241,6 +241,12 @@ def _algorithmic(op_name: str, x, axis, algorithm, codec, reduce_op: str = "sum"
             return None, None
         algorithm, codec = cfg.facade_algorithm, cfg.facade_codec
         from_config = True
+        if op_name == "all_to_all" and algorithm == "rhd":
+            # the configured default may be an algorithm this op has no
+            # form of (rhd: every block has exactly one destination);
+            # default routing keeps the lax lowering — only an EXPLICIT
+            # rhd request surfaces the library's error
+            return None, None
     if algorithm == "lax":
         return None, None
     if algorithm in (None, "auto"):
@@ -360,8 +366,41 @@ def reduce_scatter(x, axis, *, scatter_axis: int = 0, tiled: bool = True,
         return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=tiled)
 
 
-def all_to_all(x, axis, *, split_axis: int, concat_axis: int, tiled: bool = True):
-    """all_to_all (reference ``all_to_all_single``; backbone of Ulysses + MoE)."""
+def all_to_all(x, axis, *, split_axis: int, concat_axis: int, tiled: bool = True,
+               algorithm: Optional[str] = None, codec: Optional[str] = None,
+               block_size: Optional[int] = None):
+    """all_to_all (reference ``all_to_all_single``; backbone of Ulysses + MoE).
+
+    ``algorithm=``/``codec=`` route through the algorithmic collectives
+    library like every other facade op: ``None`` defers to the process
+    facade defaults the ``collectives`` config block installed (falling
+    back to the byte-identical ``jax.lax`` lowering when none are set —
+    callers moving already-encoded bytes must pin ``algorithm="lax"``,
+    see ``quant_collectives.exchange_wire``), "auto" consults the
+    selector, a concrete name
+    ("ring" / "bidir" / "ring2d", or "pallas_ring"/"pallas_ring2d" for
+    remote-DMA hops with the in-kernel fused int8/fp8 dispatch wire) forces
+    it. The MoE token dispatch/combine (``parallel/moe.py``) and the
+    expert-parallel inference path ride this entry point."""
+    if not tiled:
+        # untiled all_to_all has no algorithmic form (the block-exchange
+        # schedules are tiled by construction); explicit requests get a
+        # clear error, default routing skips the selector entirely
+        if algorithm is not None or codec is not None:
+            raise ValueError("algorithmic all_to_all supports tiled=True only")
+        alg = cd = None
+    else:
+        alg, cd = _algorithmic("all_to_all", x, axis, algorithm, codec)
+    if alg is not None:
+        from deepspeed_tpu import collectives
+
+        bs = _resolved_block_size(block_size)
+        with _record("all_to_all", axis, x, algorithm=alg, codec=cd), \
+                _observe_route("all_to_all", x, axis, alg, cd, bs):
+            return collectives.all_to_all(x, axis, split_axis=split_axis,
+                                          concat_axis=concat_axis,
+                                          algorithm=alg, codec=cd,
+                                          block_size=bs)
     with _record("all_to_all", axis, x):
         return jax.lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
 

@@ -503,6 +503,37 @@ class DataEfficiencyConfig(DeepSpeedConfigModel):
     data_routing: Dict[str, Any] = Field(default_factory=dict)
 
 
+class MoEAutotuneConfig(DeepSpeedConfigModel):
+    """moe_autotune section — host-side capacity-factor controller
+    (``runtime/engine.py``): consumes the ``moe/*`` dispatch gauges the MoE
+    gate already computes (telemetry + ``moe_metrics``) at the existing
+    ``steps_per_print`` sync cadence and moves the gate's *effective*
+    capacity factor between steps, inside configured bounds. Jit-cache
+    stable by construction: the capacity ARRAYS are padded to a static
+    ceiling (``TransformerConfig.moe_capacity_factor_max``, which the
+    engine installs from ``max_factor`` via the same rebuild hook the moe
+    gauges use) and the controller only moves the traced drop cutoff
+    WITHIN that preallocated bucket — one compiled program, a scalar knob
+    threaded through the batch (key ``moe_capacity_factor``)."""
+
+    enabled: bool = False
+    # drop rate above this raises capacity (the controller's error signal);
+    # at-or-below it, a balanced dispatch lowers capacity to reclaim the
+    # dead padding FLOPs
+    target_drop_rate: float = 0.01
+    # controller bounds on the effective factor. ``max_factor`` is also the
+    # static padding ceiling the capacity arrays are sized by (the bucket).
+    min_factor: float = 1.0
+    max_factor: float = 2.0
+    # asymmetric steps (raise fast on drops, decay slowly when balanced —
+    # drops hurt the loss, slack only hurts the step time)
+    increase_step: float = 0.25
+    decrease_step: float = 0.0625
+    # only lower capacity while expert load balance (E * sum(share^2), 1.0
+    # = uniform) is below this — an imbalanced dispatch needs its headroom
+    balance_threshold: float = 1.25
+
+
 class EngineConfig(DeepSpeedConfigModel):
     """Top-level typed config (reference ``DeepSpeedConfig`` runtime/config.py:708)."""
 
@@ -544,6 +575,7 @@ class EngineConfig(DeepSpeedConfigModel):
     recovery: RecoveryConfig = Field(default_factory=RecoveryConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
+    moe_autotune: MoEAutotuneConfig = Field(default_factory=MoEAutotuneConfig)
     gradient_compression: GradientCompressionConfig = Field(default_factory=GradientCompressionConfig)
 
     # Inference / misc sections accepted for schema parity

@@ -74,6 +74,13 @@ class RaggedInferenceConfig(DeepSpeedConfigModel):
 
     dtype: str = "bf16"
     tp_size: int = 1
+    # Expert-parallel serving (ISSUE 15): width of the mesh's ``ep`` axis.
+    # Expert weights shard over ep at placement (moe_partition_rules) and
+    # the MoE block's dispatch/combine runs through the facade all_to_all
+    # (model._moe_ep_collective — exact no-drop routing, so an ep>1 engine
+    # decodes token-identical to ep=1 on the same checkpoint). The serving
+    # router is oblivious: replicas declare capacity, not topology.
+    ep_size: int = 1
     kv_block_size: int = 16
     num_kv_blocks: int = 512
     # Quantized KV-cache storage (ISSUE 10): None = pool in ``dtype``;
@@ -228,9 +235,27 @@ class InferenceEngineV2:
         self.model_config = model_config
         self.config = config
         if mesh is None:
-            mesh = build_mesh(axis_sizes={"tp": config.tp_size, "dp": -1})
+            axes = {"tp": config.tp_size, "dp": -1}
+            if config.ep_size > 1:
+                axes["ep"] = config.ep_size
+            mesh = build_mesh(axis_sizes=axes)
         self.mesh = mesh
         set_mesh(mesh)
+        if mesh.shape.get("ep", 1) > 1:
+            if model_config.num_experts <= 0:
+                raise ValueError(
+                    f"ep_size={mesh.shape['ep']} on a dense model: expert "
+                    "parallelism needs num_experts > 0")
+            if model_config.num_experts % mesh.shape["ep"]:
+                raise ValueError(
+                    f"num_experts={model_config.num_experts} not divisible "
+                    f"by ep_size={mesh.shape['ep']}")
+            log_dist(
+                f"expert-parallel serving: experts sharded over ep="
+                f"{mesh.shape['ep']}, MoE dispatch/combine through the "
+                "facade all_to_all (algorithm="
+                f"{model_config.moe_dispatch_algorithm or 'facade default'}, "
+                f"codec={model_config.moe_wire_codec or 'exact'})", ranks=[0])
 
         max_len = config.max_seq_len or model_config.max_seq_len
         self.max_seq_len = max_len

@@ -133,6 +133,16 @@ def _moe(lp, cfg: TransformerConfig, x):
     top_p = top_p / jnp.maximum(top_p.sum(-1, keepdims=True), 1e-9)
 
     ep = lp["experts"]
+    if _moe_ep_size() > 1:
+        # expert-parallel serving (ISSUE 15): the ep-sharded experts are
+        # reached through the explicit collective dispatch — the SAME
+        # facade all_to_all the training path rides, so quantized token
+        # routing, hop spans and observatory signatures apply to serving
+        # MoE traffic too. Falls back to the replicated paths below (GSPMD
+        # reshards the ep-sharded kernels) only on non-divisible shapes.
+        out = _moe_ep_collective(cfg, ep, tokens, top_p, top_i)
+        if out is not None:
+            return out.reshape(B, S, M)
     if T >= 2 * E:
         return _moe_ragged(cfg, ep, tokens, top_p, top_i).reshape(B, S, M)
 
@@ -145,6 +155,80 @@ def _moe(lp, cfg: TransformerConfig, x):
     out_e = jnp.einsum("teh,ehm->tem", h1, ep["w_down"].astype(cfg.dtype))
     out = jnp.einsum("te,tem->tm", gate.astype(cfg.dtype), out_e)
     return out.reshape(B, S, M)
+
+
+# The no-drop collective dispatch materializes [T*k, E, T*k] routing
+# one-hots (capacity = T*k for exactness) — quadratic in the token count.
+# Fine at decode/short-prefill shapes; a long prefill would OOM on the
+# one-hots alone, so beyond this bound the ep>1 engine falls back to the
+# replicated ragged/dense paths (GSPMD reshards the ep-sharded kernels —
+# same math, no collective wire).
+_MOE_EP_COLLECTIVE_MAX_TOKENS = 1024
+
+
+def _moe_ep_size() -> int:
+    """Expert-parallel width of the active mesh (1 = no ep sharding)."""
+    from deepspeed_tpu.topology.mesh import get_mesh, has_mesh
+
+    if not has_mesh():
+        return 1
+    return int(get_mesh().shape.get("ep", 1))
+
+
+def _moe_ep_collective(cfg: TransformerConfig, ep, tokens, top_p, top_i):
+    """Expert-parallel inference dispatch through the facade all-to-all.
+
+    Builds NO-DROP dispatch/combine one-hots (capacity = T*k: every
+    (token, expert) pair owns a globally unique slot, so routing is exact —
+    token-identity with the ep=1 paths is a sum reordering, never a drop)
+    and runs the training layer's :func:`collective_moe_apply`: one
+    shard_map region, the [E, C, M] reshard as ONE facade ``all_to_all``
+    over ep each way, the expert FFN on the LOCAL ep shard. Returns None
+    when the (mesh, shape) cannot be served (caller falls back to the
+    replicated compute with GSPMD resharding)."""
+    from deepspeed_tpu.parallel.moe import _token_axes, collective_moe_apply
+    from deepspeed_tpu.topology.mesh import get_mesh
+    from deepspeed_tpu.utils.logging import logger
+
+    mesh = get_mesh()
+    T, M = tokens.shape
+    E, k = cfg.num_experts, cfg.moe_top_k
+    shards = 1
+    for a in _token_axes(mesh):
+        shards *= mesh.shape[a]
+    if E % mesh.shape["ep"] or T % shards:
+        # trace-time, so this fires once per compiled program shape — the
+        # operator's signal that wire codec / hop spans will NOT engage
+        logger.warning(
+            f"moe ep dispatch: shape unservable ({T} tokens vs {shards} "
+            f"token shards, E={E} vs ep={mesh.shape['ep']}); falling back "
+            "to replicated compute (GSPMD reshards the ep-sharded kernels)")
+        return None
+    if T > _MOE_EP_COLLECTIVE_MAX_TOKENS:
+        logger.warning(
+            f"moe ep dispatch: {T} tokens exceeds the "
+            f"{_MOE_EP_COLLECTIVE_MAX_TOKENS}-token collective bound "
+            "(no-drop one-hots are quadratic); falling back to replicated "
+            "compute for this program")
+        return None
+    C = T * k  # the no-drop static bound: capacity can never overflow
+    flat_e = top_i.reshape(-1)  # [T*k] token-major expert choices
+    onehot = (flat_e[:, None] == jnp.arange(E)[None, :]).astype(jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) - onehot  # slot within expert, global
+    pos_in_e = (pos * onehot).sum(-1)  # [T*k]
+    slot = (pos_in_e[:, None] == jnp.arange(C)[None, :])  # [T*k, C] one-hot
+    pair = onehot.astype(bool)[:, :, None] & slot[:, None, :]  # [T*k, E, C]
+    dispatch = pair.reshape(T, k, E, C).sum(1).astype(cfg.dtype)
+    combine = (pair.reshape(T, k, E, C)
+               * top_p.reshape(T, k, 1, 1)).sum(1).astype(cfg.dtype)
+    w_gate = (ep["w_gate"].astype(cfg.dtype)
+              if cfg.activation == "silu_glu" else None)
+    kernels = (w_gate, ep["w_up"].astype(cfg.dtype),
+               ep["w_down"].astype(cfg.dtype))
+    return collective_moe_apply(
+        tokens, combine, dispatch, kernels, activation=cfg.activation,
+        dtype=cfg.dtype, algorithm=cfg.moe_dispatch_algorithm,
+        codec=cfg.moe_wire_codec)
 
 
 def _gmm_padded(lhs, rhs, group_sizes, interpret: bool = False):

@@ -131,6 +131,21 @@ class TransformerConfig:
     # (loss, logits, stats_dict) instead of (loss, logits). The engine flips
     # this on via rebuild when telemetry is enabled (no-op for dense models).
     moe_metrics: bool = False
+    # Expert-parallel token dispatch (ISSUE 15; parallel/moe.py): how the
+    # [E, C, M] dispatch/combine reshards onto ep — "auto" runs the explicit
+    # shard_map + facade all_to_all path (cross-tp token gather/drop) on
+    # ep x tp meshes and GSPMD constraints elsewhere; "collective"/"gspmd"
+    # force one. The algorithm/codec knobs route the dispatch wire
+    # (int8/fp8 = quantized token routing; None = facade defaults).
+    moe_dispatch: str = "auto"
+    moe_dispatch_algorithm: Optional[str] = None
+    moe_wire_codec: Optional[str] = None
+    # Capacity-factor autotuning ceiling (runtime moe_autotune block): when
+    # set, capacity arrays are sized by THIS factor and the enforced cutoff
+    # follows a traced scalar (batch key "moe_capacity_factor", threaded by
+    # the engine's controller) — capacity moves between steps with the jit
+    # cache staying at one program.
+    moe_capacity_factor_max: Optional[float] = None
 
     def __post_init__(self):
         if self.moe_layer_experts is not None and len(self.moe_layer_experts) != self.num_layers:
@@ -175,6 +190,13 @@ class TransformerConfig:
     @property
     def num_moe_layers(self) -> int:
         return sum(1 for i in range(self.num_layers) if self.experts_for_layer(i) > 0)
+
+    @property
+    def moe_dynamic_capacity(self) -> bool:
+        """Whether the gate enforces a traced (autotunable) capacity cutoff
+        — requires a ceiling AND drops (capacity is meaningless without)."""
+        return (self.moe_capacity_factor_max is not None and self.moe_drop_tokens
+                and self.has_moe)
 
     @property
     def kv_heads(self) -> int:
@@ -462,8 +484,15 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _=None):
-        x, mask, positions, aux = carry
         cfg = self.config
+        cap_scale = None
+        if cfg.moe_dynamic_capacity:
+            # dynamic capacity rides the carry as a traced fp32 scalar (the
+            # engine's autotuning controller feeds it through the batch) —
+            # dense layers pass it through untouched
+            x, mask, positions, aux, cap_scale = carry
+        else:
+            x, mask, positions, aux = carry
         if cfg.parallel_block:
             # x = x + attn(ln1(x)) + mlp(h); h = ln1(x) shared (falcon) or a
             # separate ln2(x) (gpt-neox parallel_mlp_norm)
@@ -493,13 +522,18 @@ class Block(nn.Module):
                 drop_tokens=cfg.moe_drop_tokens,
                 aux_loss_weight=cfg.moe_aux_loss_weight,
                 collect_metrics=collect,
+                dispatch=cfg.moe_dispatch,
+                dispatch_algorithm=cfg.moe_dispatch_algorithm,
+                dispatch_codec=cfg.moe_wire_codec,
+                max_capacity_factor=(cfg.moe_capacity_factor_max
+                                     if cfg.moe_dynamic_capacity else None),
             )
             moe_out = MoELayer(
                 moe_cfg, cfg.hidden_size, cfg.intermediate_size,
                 activation=cfg.activation, dtype=cfg.dtype, train=self.train,
                 use_residual=cfg.moe_use_residual,
                 name="moe",
-            )(h)
+            )(h, cap_scale)
             if collect:
                 l_aux, out, stats = moe_out
                 aux_sum, stats_acc = aux
@@ -511,6 +545,8 @@ class Block(nn.Module):
             x = x + out
         else:
             x = x + MLP(cfg, name="mlp")(h, self.train)
+        if cfg.moe_dynamic_capacity:
+            return (x, mask, positions, aux, cap_scale), None
         return (x, mask, positions, aux), None
 
 
@@ -560,20 +596,33 @@ class CausalLM(nn.Module):
         aux = jnp.zeros((), jnp.float32)
         collect_moe = cfg.moe_metrics and train and cfg.has_moe
         if collect_moe:
-            from deepspeed_tpu.parallel.moe import MOE_STAT_KEYS
+            from deepspeed_tpu.parallel.moe import (MOE_DYNAMIC_STAT_KEYS,
+                                                    MOE_STAT_KEYS)
 
             # (aux-loss sum, per-layer stat sums) — averaged over MoE layers
-            # below; Block keeps this structure through the whole stack
-            aux = (aux, {k: jnp.zeros((), jnp.float32) for k in MOE_STAT_KEYS})
+            # below; Block keeps this structure through the whole stack.
+            # Dynamic-capacity gates additionally report the enforced factor.
+            keys = (MOE_DYNAMIC_STAT_KEYS if (cfg.moe_dynamic_capacity and train)
+                    else MOE_STAT_KEYS)
+            aux = (aux, {k: jnp.zeros((), jnp.float32) for k in keys})
         block_cls = Block
         if cfg.remat:
             block_cls = nn.remat(Block, prevent_cse=False)
+        if cfg.scan_layers and cfg.moe_layer_experts is not None:
+            raise ValueError(
+                "pyramid MoE (moe_layer_experts) needs scan_layers=False: "
+                "heterogeneous expert counts cannot stack into one scan"
+            )
+        carry = (x, pad_mask, positions, aux)
+        if cfg.moe_dynamic_capacity:
+            # the autotuning controller's knob: a traced fp32 scalar the
+            # engine injects per step (falls back to the configured static
+            # factor — same program either way, only the value moves)
+            cap = batch.get("moe_capacity_factor")
+            cap = (jnp.float32(cfg.moe_capacity_factor) if cap is None
+                   else jnp.asarray(cap, jnp.float32).reshape(()))
+            carry = carry + (cap,)
         if cfg.scan_layers:
-            if cfg.moe_layer_experts is not None:
-                raise ValueError(
-                    "pyramid MoE (moe_layer_experts) needs scan_layers=False: "
-                    "heterogeneous expert counts cannot stack into one scan"
-                )
             stack = nn.scan(
                 block_cls,
                 variable_axes={"params": 0},
@@ -581,11 +630,12 @@ class CausalLM(nn.Module):
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, train, name="layers")
-            (x, _, _, aux), _ = stack((x, pad_mask, positions, aux), None)
+            carry, _ = stack(carry, None)
         else:
             for i in range(cfg.num_layers):
-                (x, _, _, aux), _ = block_cls(cfg, train, layer_idx=i, name=f"layer_{i}")(
-                    (x, pad_mask, positions, aux), None)
+                carry, _ = block_cls(cfg, train, layer_idx=i, name=f"layer_{i}")(
+                    carry, None)
+        x, aux = carry[0], carry[3]
 
         moe_stats = None
         if collect_moe:
@@ -698,6 +748,12 @@ def pipelined_causal_lm_loss(params, batch, rng, *, config: TransformerConfig,
             "stats dict cannot ride the pp activation ring) — the engine "
             "skips the rebuild on pp>1 meshes; construct with "
             "moe_metrics=False for pipelined MoE")
+    if cfg.moe_dynamic_capacity:
+        raise ValueError(
+            "moe_capacity_factor_max (capacity autotuning) is not wired "
+            "through the pipelined loss path (the capacity scalar cannot "
+            "ride the pp activation ring) — the engine skips it on pp>1 "
+            "meshes; construct without moe_capacity_factor_max")
     M = num_microbatches
     ids = batch["input_ids"]
     B, S = ids.shape

@@ -16,6 +16,7 @@ so the [E, C, M] activation resharding onto ``ep`` IS the dispatch all-to-all.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Tuple
 
 import flax.linen as nn
@@ -24,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.topology.mesh import get_mesh, has_mesh
+from deepspeed_tpu.utils.logging import logger
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,6 +44,35 @@ class MoEConfig:
     # Emit device-computed dispatch stats (MOE_STAT_KEYS) alongside the aux
     # loss — the telemetry moe/* gauges. Changes the layer's return arity.
     collect_metrics: bool = False
+    # How the [E, C, M] dispatch/combine reshards onto the ep axis (ISSUE 15):
+    #   "auto"       — explicit collective dispatch on ep x tp meshes (where
+    #                  constraint-based resharding is the unverified path the
+    #                  engine used to refuse), GSPMD constraints elsewhere
+    #   "collective" — force the shard_map + facade all_to_all dispatch on
+    #                  any ep>1 mesh
+    #   "gspmd"      — force constraint-based resharding everywhere
+    # The collective path is the reference moe/mappings.py shape: tokens are
+    # gathered across the tp group at region entry (token specs never name
+    # tp, so tp ranks see the full token set) and the duplicate outputs are
+    # dropped at region exit; the dispatch and combine each cross the wire
+    # as ONE facade all_to_all over ep, so algorithm/codec routing, hop
+    # spans, and observatory signatures all apply to MoE token traffic.
+    dispatch: str = "auto"
+    # facade all_to_all routing of the collective dispatch: None = facade
+    # defaults / selector ("auto" when the collectives block is enabled);
+    # a concrete name ("ring" / "bidir" / "ring2d" / "pallas_ring" /
+    # "pallas_ring2d") forces that schedule
+    dispatch_algorithm: Optional[str] = None
+    # wire codec of the dispatch/combine all-to-all: "int8"/"fp8" quantize
+    # the token wire (EQuARX-style on the pallas backend: requantize ->
+    # remote DMA -> dequantize in one kernel per hop); None = exact wire
+    dispatch_codec: Optional[str] = None
+    # Capacity-factor autotuning support (runtime moe_autotune block): when
+    # set, the capacity ARRAYS are sized by this ceiling factor and the
+    # factor actually enforced is a traced scalar clipped into
+    # [capacity_factor bounds, ceiling] — so the host-side controller can
+    # move the effective capacity between steps without a recompile.
+    max_capacity_factor: Optional[float] = None
 
 
 # Dispatch-health gauges the gating math can compute for free (ROADMAP item
@@ -57,6 +88,12 @@ class MoEConfig:
 #                           perfectly uniform, E = total collapse onto one
 MOE_STAT_KEYS = ("moe/capacity_factor", "moe/token_drop_rate",
                  "moe/expert_load_balance")
+
+# With dynamic capacity (``MoEConfig.max_capacity_factor``) the gate also
+# reports the factor it actually ENFORCED this step — the autotuning
+# controller's feedback that its knob reached the program:
+#   moe/capacity_factor_applied   effective_capacity * E / (T * k)
+MOE_DYNAMIC_STAT_KEYS = MOE_STAT_KEYS + ("moe/capacity_factor_applied",)
 
 
 def _ep_constrain(x: jax.Array, spec: P) -> jax.Array:
@@ -84,6 +121,7 @@ def top_k_gating(
     use_rts: bool = True,
     drop_tokens: bool = True,
     collect_stats: bool = False,
+    effective_capacity: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, ...]:
     """Generic top-k gating (covers the reference's top1/top2/topk gates).
 
@@ -93,6 +131,12 @@ def top_k_gating(
     With ``collect_stats`` a fifth element is appended: a ``MOE_STAT_KEYS``
     dict of fp32 scalar dispatch-health gauges (see the key docs above) —
     a handful of reductions over masks the gate already built.
+
+    ``effective_capacity`` (int scalar, traced or static, <= ``capacity``)
+    makes the drop cutoff dynamic while the array dims stay padded to the
+    static ``capacity`` bound — the capacity-autotuning contract: one
+    compiled program, a data-dependent cutoff. Adds the
+    ``moe/capacity_factor_applied`` stat when stats are collected.
     """
     T, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -110,6 +154,7 @@ def top_k_gating(
     # capacity to max(exp_counts) dynamically — impossible in XLA.)
     if not drop_tokens:
         capacity = T * top_k
+        effective_capacity = None
 
     # position of each token within its expert's capacity, priority by order
     # (optionally randomized: random token selection, ``use_rts``)
@@ -124,7 +169,8 @@ def top_k_gating(
     route_counts = flat.sum(axis=0)  # [E] pre-drop demand per expert
     positions = jnp.cumsum(flat, axis=0) - flat  # [k*T, E]
     pos_in_expert = (positions * flat).sum(axis=-1)  # [k*T]
-    keep = pos_in_expert < capacity
+    cutoff = capacity if effective_capacity is None else effective_capacity
+    keep = pos_in_expert < cutoff
     flat = flat * keep[:, None]
 
     # back to [T, k, E]
@@ -153,6 +199,11 @@ def top_k_gating(
         "moe/token_drop_rate": 1.0 - exp_counts.sum() / slots,
         "moe/expert_load_balance": E * jnp.sum(share * share),
     }
+    if effective_capacity is not None:
+        # the factor the cutoff actually enforced — the controller's
+        # feedback that its between-steps knob reached the program
+        stats["moe/capacity_factor_applied"] = (
+            jnp.asarray(effective_capacity, jnp.float32) * E / slots)
     return out + ({k: v.astype(jnp.float32) for k, v in stats.items()},)
 
 
@@ -163,7 +214,9 @@ class TopKGate(nn.Module):
     model_dim: int
 
     @nn.compact
-    def __call__(self, x: jax.Array, train: bool) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    def __call__(self, x: jax.Array, train: bool,
+                 capacity_scale: Optional[jax.Array] = None
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
         cfg = self.config
         T = x.shape[0]
         if cfg.noisy_gate_policy not in (None, "RSample", "Jitter"):
@@ -183,11 +236,25 @@ class TopKGate(nn.Module):
             noise = jax.random.normal(self.make_rng("dropout"), logits.shape)
             logits = logits + noise / cfg.num_experts
         factor = cfg.capacity_factor if train else cfg.eval_capacity_factor
-        capacity = _capacity(T, cfg.num_experts, factor, cfg.min_capacity, cfg.top_k)
+        effective = None
+        if train and cfg.max_capacity_factor is not None and cfg.drop_tokens:
+            # dynamic capacity: the arrays are padded to the CEILING bound
+            # (jit-cache-stable), the enforced cutoff follows the traced
+            # factor scalar the engine's autotuning controller threads in
+            capacity = _capacity(T, cfg.num_experts, cfg.max_capacity_factor,
+                                 cfg.min_capacity, cfg.top_k)
+            f = (jnp.float32(factor) if capacity_scale is None
+                 else jnp.asarray(capacity_scale, jnp.float32))
+            effective = jnp.clip(
+                jnp.ceil(T * cfg.top_k * f / cfg.num_experts),
+                cfg.min_capacity, capacity).astype(jnp.int32)
+        else:
+            capacity = _capacity(T, cfg.num_experts, factor, cfg.min_capacity, cfg.top_k)
         rng = self.make_rng("dropout") if (train and cfg.use_rts and self.has_rng("dropout")) else None
         gated = top_k_gating(
             logits, cfg.top_k, capacity, rng=rng, use_rts=cfg.use_rts and train,
             drop_tokens=cfg.drop_tokens, collect_stats=cfg.collect_metrics,
+            effective_capacity=effective,
         )
         l_aux, combine, dispatch = gated[0], gated[1], gated[2]
         if cfg.collect_metrics:
@@ -195,11 +262,31 @@ class TopKGate(nn.Module):
         return l_aux, combine, dispatch
 
 
+def experts_ffn(x: jax.Array, w_gate: Optional[jax.Array], w_up: jax.Array,
+                w_down: jax.Array, activation: str, dtype) -> jax.Array:
+    """The stacked-expert FFN math on ``[E, C, M]`` slots — ONE definition
+    shared by the :class:`Experts` module and the collective dispatch path
+    (which runs it on the LOCAL expert shard inside shard_map). Biasless by
+    construction: an all-zero capacity slot maps to an all-zero output,
+    the invariant the partial-sum dispatch relies on."""
+    if activation == "silu_glu":
+        h = jax.nn.silu(jnp.einsum("ecm,emh->ech", x, w_gate.astype(dtype)))
+        h = h * jnp.einsum("ecm,emh->ech", x, w_up.astype(dtype))
+    else:
+        from deepspeed_tpu.models.transformer import act_fn
+
+        h = act_fn(activation)(jnp.einsum("ecm,emh->ech", x, w_up.astype(dtype)))
+    return jnp.einsum("ech,ehm->ecm", h, w_down.astype(dtype))
+
+
 class Experts(nn.Module):
     """Stacked expert FFNs (reference ``Experts`` moe/experts.py:13).
 
     Weights: [E, M, H] / [E, H, M], sharded over the ``ep`` mesh axis via the
     partition rules below — grouped matmul over experts maps to one einsum.
+    Declared in ``setup`` (not compact) so the collective dispatch path can
+    read the kernels via :meth:`kernels` and run :func:`experts_ffn` on the
+    LOCAL expert shard inside its shard_map region.
     """
 
     num_experts: int
@@ -208,23 +295,189 @@ class Experts(nn.Module):
     activation: str = "silu_glu"
     dtype: jnp.dtype = jnp.float32
 
-    @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:  # x: [E, C, M]
+    def setup(self):
         E, M, H = self.num_experts, self.model_dim, self.hidden_dim
         init = nn.initializers.lecun_normal()
         if self.activation == "silu_glu":
-            w_gate = self.param("w_gate", init, (E, M, H))
-            w_up = self.param("w_up", init, (E, M, H))
-            w_down = self.param("w_down", init, (E, H, M))
-            h = jax.nn.silu(jnp.einsum("ecm,emh->ech", x, w_gate.astype(self.dtype)))
-            h = h * jnp.einsum("ecm,emh->ech", x, w_up.astype(self.dtype))
-        else:
-            from deepspeed_tpu.models.transformer import act_fn
+            self.w_gate = self.param("w_gate", init, (E, M, H))
+        self.w_up = self.param("w_up", init, (E, M, H))
+        self.w_down = self.param("w_down", init, (E, H, M))
 
-            w_up = self.param("w_up", init, (E, M, H))
-            w_down = self.param("w_down", init, (E, H, M))
-            h = act_fn(self.activation)(jnp.einsum("ecm,emh->ech", x, w_up.astype(self.dtype)))
-        return jnp.einsum("ech,ehm->ecm", h, w_down.astype(self.dtype))
+    def kernels(self) -> Tuple[Optional[jax.Array], jax.Array, jax.Array]:
+        """(w_gate | None, w_up, w_down) — raw stacked kernels."""
+        return (getattr(self, "w_gate", None) if self.activation == "silu_glu" else None,
+                self.w_up, self.w_down)
+
+    def __call__(self, x: jax.Array) -> jax.Array:  # x: [E, C, M]
+        w_gate, w_up, w_down = self.kernels()
+        return experts_ffn(x, w_gate, w_up, w_down, self.activation, self.dtype)
+
+
+# ------------------------------------------------- collective token dispatch
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _routed_all_to_all(x, axis, split_axis, concat_axis, algorithm, codec):
+    """Facade all_to_all with the reference ``_AllToAll`` autograd contract
+    (``moe/sharded_moe.py:96``): the backward pass is the REVERSE exchange
+    through the same algorithm/codec — so a lossy dispatch wire quantizes
+    the gradient tokens exactly like the forward tokens, instead of AD
+    differentiating through the rounding (zero gradients)."""
+    from deepspeed_tpu.comm import comm as dist
+
+    return dist.all_to_all(x, axis, split_axis=split_axis,
+                           concat_axis=concat_axis, algorithm=algorithm,
+                           codec=codec)
+
+
+def _routed_a2a_fwd(x, axis, split_axis, concat_axis, algorithm, codec):
+    return _routed_all_to_all(x, axis, split_axis, concat_axis, algorithm, codec), None
+
+
+def _routed_a2a_bwd(axis, split_axis, concat_axis, algorithm, codec, _res, g):
+    from deepspeed_tpu.comm import comm as dist
+
+    return (dist.all_to_all(g, axis, split_axis=concat_axis,
+                            concat_axis=split_axis, algorithm=algorithm,
+                            codec=codec),)
+
+
+_routed_all_to_all.defvjp(_routed_a2a_fwd, _routed_a2a_bwd)
+
+
+def _token_axes(mesh) -> Tuple[str, ...]:
+    """The mesh axes token shards split over inside the collective dispatch
+    region: the batch axes, ep (the expert-data decomposition) AND tp.
+
+    The cross-tp token mapping (reference ``moe/mappings.py``
+    gather_tokens/drop_tokens): the gate runs on the full GATHERED token
+    set outside the region, and inside it the token dim shards across tp
+    too — each tp rank dispatches a distinct token slice, so the duplicate
+    work (and the duplicate outputs the reference drops) never exists.
+    Naming EVERY >1 mesh axis in the token specs is also deliberate
+    hygiene: on this jax 0.4.37, a shard_map output spec that leaves a >1
+    manual axis unmentioned (replication-assumed) mis-assembles the global
+    result when the region's inputs are traced intermediates — observed as
+    deterministic garbage on ep x tp meshes; fully-named specs sidestep
+    the bug entirely (sp rides along for the same reason — a flattened
+    [B*S] token dim slices over it like any other)."""
+    return tuple(a for a in ("dp", "fsdp", "ep", "sp", "tp")
+                 if mesh.shape[a] > 1) or ("ep",)
+
+
+def collective_dispatch_blocker(cfg: MoEConfig, mesh, num_tokens: int) -> Optional[str]:
+    """Why the collective dispatch CANNOT serve this (mesh, shape) — None
+    when it can. Static trace-time answer."""
+    ep = mesh.shape["ep"]
+    if cfg.num_experts % ep:
+        return f"num_experts {cfg.num_experts} not divisible by ep={ep}"
+    shards = 1
+    for a in _token_axes(mesh):
+        shards *= mesh.shape[a]
+    if num_tokens % shards:
+        return (f"{num_tokens} tokens not divisible by the "
+                f"{shards} token shards (dp x fsdp x ep x sp x tp)")
+    if mesh.shape["pp"] > 1:
+        return "pp>1 runs layers inside the pipeline's own shard_map regions"
+    return None
+
+
+def resolve_dispatch_mode(cfg: MoEConfig, num_tokens: int) -> str:
+    """'collective' | 'gspmd' for this trace (see ``MoEConfig.dispatch``).
+
+    "auto" routes collective whenever tp > 1 — ep present or not: driving
+    the constraint path end-to-end on tp meshes showed its MoE einsum
+    lowering deviating from the global math on this jax/XLA (step-1 loss
+    off by ~0.5% on a dp2 x ep2 x tp2 CPU mesh, ep=1 x tp=2 likewise —
+    the "silent mis-routing" the engine's old ep x tp refusal guarded
+    against, now reproduced). The collective region matches the global
+    math to fp rounding."""
+    if not has_mesh():
+        return "gspmd"
+    mesh = get_mesh()
+    ep, tp = mesh.shape["ep"], mesh.shape["tp"]
+    if cfg.dispatch == "gspmd":
+        return "gspmd"
+    if cfg.dispatch not in ("auto", "collective"):
+        raise ValueError(
+            f"MoEConfig.dispatch must be auto|collective|gspmd, got {cfg.dispatch!r}")
+    if cfg.dispatch == "auto" and tp <= 1:
+        return "gspmd"
+    if cfg.dispatch == "collective" and ep <= 1 and tp <= 1:
+        return "gspmd"  # nothing to dispatch over — the region would be a no-op
+    reason = collective_dispatch_blocker(cfg, mesh, num_tokens)
+    if reason is None:
+        return "collective"
+    if mesh.shape["pp"] > 1:
+        # a pipeline mesh can NEVER host the collective region (layers run
+        # inside the pipeline's own shard_map) — raising would leave
+        # pipelined MoE no path at all, so keep the pre-PR GSPMD behavior
+        # and say loudly what that means on tp meshes
+        logger.warning(
+            f"moe: collective dispatch unavailable ({reason}); falling back "
+            "to GSPMD constraint resharding"
+            + (" — KNOWN to deviate ~0.5% from global math on tp>1 meshes "
+               "(set moe_dispatch='gspmd' to acknowledge and silence)"
+               if tp > 1 else ""))
+        return "gspmd"
+    if tp > 1:
+        # tp meshes NEED the explicit dispatch — the GSPMD constraint path
+        # mis-routes there (~0.5% loss deviation, ep present or not; the
+        # corruption the engine's old ep x tp refusal guarded against) —
+        # so an unservable shape must fail loudly, never silently fall
+        # back onto the known-bad lowering
+        raise ValueError(
+            f"ep={ep} x tp={tp} MoE requires the collective token dispatch, "
+            f"which cannot serve this shape: {reason}")
+    # ep-only meshes: the GSPMD resharding is the verified path there
+    logger.warning(f"moe: collective dispatch unavailable ({reason}); "
+                   "falling back to GSPMD constraint resharding")
+    return "gspmd"
+
+
+def collective_moe_apply(tokens: jax.Array, combine: jax.Array,
+                         dispatch: jax.Array, kernels, *, activation: str,
+                         dtype, algorithm: Optional[str] = None,
+                         codec: Optional[str] = None) -> jax.Array:
+    """The explicit expert-parallel dispatch (reference ``moe/mappings.py``
+    + ``_AllToAll``): one full-manual shard_map region where
+
+    1. each token shard (dp x fsdp x ep x sp x tp — the gate saw the full
+       GATHERED token set outside; inside, every rank dispatches a distinct
+       slice, so the reference's post-combine duplicate drop never exists)
+       builds its PARTIAL ``[E, C, M]`` dispatch einsum — global capacity
+       slots, so shard contributions are disjoint and all-zero elsewhere;
+    2. ONE facade ``all_to_all`` over ep (split E, concat C) lands every
+       shard's slots on the owning expert rank — the quantized-routable
+       dispatch wire;
+    3. the local expert FFN runs on ``[E/ep, ep*C, M]`` (biasless: zero
+       slots stay zero, so disjoint partials stay disjoint);
+    4. the reverse ``all_to_all`` returns each shard its slots' outputs;
+    5. the local combine einsum reads only the shard's own tokens' slots.
+    """
+    from deepspeed_tpu.utils.compat import shard_map
+
+    mesh = get_mesh()
+    w_gate, w_up, w_down = kernels
+    tok = _token_axes(mesh)
+    tok_entry = tok if len(tok) > 1 else tok[0]
+    n_ws = 3 if w_gate is not None else 2
+    ws = [w for w in (w_gate, w_up, w_down) if w is not None]
+
+    def shard_fn(tok_l, comb_l, disp_l, *ws_l):
+        wg, wu, wd = ws_l if n_ws == 3 else (None,) + ws_l
+        expert_in = jnp.einsum("tec,tm->ecm", disp_l, tok_l)  # partial [E, C, M]
+        expert_in = _routed_all_to_all(expert_in, "ep", 0, 1, algorithm, codec)
+        h = experts_ffn(expert_in, wg, wu, wd, activation, dtype)  # [E/ep, ep*C, M]
+        expert_out = _routed_all_to_all(h, "ep", 1, 0, algorithm, codec)
+        return jnp.einsum("tec,ecm->tm", comb_l, expert_out)  # [T_l, M]
+
+    f = shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(tok_entry, None), P(tok_entry, None, None),
+                  P(tok_entry, None, None)) + tuple(P("ep", None, None) for _ in ws),
+        out_specs=P(tok_entry, None), check_vma=False)
+    return f(tokens, combine, dispatch, *ws)
 
 
 class MoELayer(nn.Module):
@@ -247,22 +500,34 @@ class MoELayer(nn.Module):
     use_residual: bool = False
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> Tuple[jax.Array, ...]:
+    def __call__(self, x: jax.Array,
+                 capacity_scale: Optional[jax.Array] = None) -> Tuple[jax.Array, ...]:
         B, S, M = x.shape
         tokens = x.reshape(B * S, M)
-        gated = TopKGate(self.config, M, name="gate")(tokens, self.train)
+        gated = TopKGate(self.config, M, name="gate")(tokens, self.train, capacity_scale)
         if self.config.collect_metrics:
             l_aux, combine, dispatch, stats = gated
         else:
             (l_aux, combine, dispatch), stats = gated, None
-        # dispatch: [T, E, C] x [T, M] -> [E, C, M], then shard E over ep
-        expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(self.dtype), tokens)
-        expert_in = _ep_constrain(expert_in, P("ep", None, None))  # all-to-all in
-        expert_out = Experts(
-            self.config.num_experts, M, self.hidden_dim, self.activation, self.dtype, name="experts"
-        )(expert_in)
-        expert_out = _ep_constrain(expert_out, P("ep", None, None))
-        out = jnp.einsum("tec,ecm->tm", combine.astype(self.dtype), expert_out)
+        experts = Experts(
+            self.config.num_experts, M, self.hidden_dim, self.activation,
+            self.dtype, name="experts")
+        mode = resolve_dispatch_mode(self.config, B * S)
+        if mode == "collective":
+            # explicit expert-parallel dispatch: cross-tp token gather/drop
+            # + facade all_to_all over ep (quantized routing, hop spans)
+            out = collective_moe_apply(
+                tokens, combine.astype(self.dtype), dispatch.astype(self.dtype),
+                experts.kernels(), activation=self.activation, dtype=self.dtype,
+                algorithm=self.config.dispatch_algorithm,
+                codec=self.config.dispatch_codec)
+        else:
+            # dispatch: [T, E, C] x [T, M] -> [E, C, M], then shard E over ep
+            expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(self.dtype), tokens)
+            expert_in = _ep_constrain(expert_in, P("ep", None, None))  # all-to-all in
+            expert_out = experts(expert_in)
+            expert_out = _ep_constrain(expert_out, P("ep", None, None))
+            out = jnp.einsum("tec,ecm->tm", combine.astype(self.dtype), expert_out)
         if self.use_residual:
             # residual expert: a dense FFN every token takes; the 2-way
             # coefficient gate decides the routed/residual mix per token

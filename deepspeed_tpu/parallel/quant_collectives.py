@@ -40,10 +40,12 @@ def gather_wire(wire, axis):
 
 def exchange_wire(wire, axis):
     """All-to-all every non-empty leaf of a wire pytree (split/concat axis 0
-    — the qgZ destination-shard exchange)."""
+    — the qgZ destination-shard exchange), pinned to the plain lowering
+    like :func:`gather_wire`: an already-encoded wire must never route back
+    through the algorithmic/codec path."""
     return jax.tree_util.tree_map(
         lambda w: w if w.size == 0 else dist.all_to_all(
-            w, axis, split_axis=0, concat_axis=0), wire)
+            w, axis, split_axis=0, concat_axis=0, algorithm="lax"), wire)
 
 
 def quantized_reduce_scatter(grad: jax.Array, axis: str, block_size: int = DEFAULT_BLOCK,

@@ -58,7 +58,7 @@ from deepspeed_tpu.utils.timer import ThroughputTimer
 # Device-computed MoE dispatch gauges (parallel/moe.py gating stats): keys in
 # the step metrics dict, monitor scalars, and registry gauges alike.
 _MOE_METRIC_KEYS = ("moe/capacity_factor", "moe/token_drop_rate",
-                    "moe/expert_load_balance")
+                    "moe/expert_load_balance", "moe/capacity_factor_applied")
 
 # /metrics HTTP servers, one per configured port for the process lifetime
 # (daemon threads over the process-global registry — engines come and go,
@@ -213,6 +213,10 @@ class DeepSpeedTPUEngine:
         # ---- MoE dispatch gauges (must precede step compilation: the stats
         # are computed inside the jitted step) ------------------------------
         self._resolve_moe_metrics()
+        # ---- capacity-factor autotuning (feeds on those gauges; must also
+        # precede step compilation: it rebuilds the spec with the padded
+        # static capacity ceiling the traced cutoff moves within) -----------
+        self._resolve_moe_autotune()
 
         mcfg = getattr(self.model, "transformer_config", None)
         if (getattr(mcfg, "fpdt_offload", False)
@@ -224,17 +228,21 @@ class DeepSpeedTPUEngine:
                 "single-chip, or use attn_impl='fpdt' without offload (or "
                 "sp_impl='ring') for multi-chip long context")
 
-        # MoE × TP is unverified here (round-5 verdict item 6): the reference
-        # composes them by gathering/dropping tokens across the tp group
-        # inside the MoE block (moe/mappings.py:105,113) — this engine has no
-        # such token mapping, so an ep×tp mesh would silently mis-route
-        # expert tokens. Refuse loudly instead.
-        if dict(self.mesh.shape).get("ep", 1) > 1 and dict(self.mesh.shape).get("tp", 1) > 1:
-            raise NotImplementedError(
-                f"ep={self.mesh.shape['ep']} × tp={self.mesh.shape['tp']} mesh: "
-                "MoE expert parallelism does not compose with tensor "
-                "parallelism here (no cross-tp token gather/drop, reference "
-                "moe/mappings.py). Use ep with dp/sp axes, or tp without ep.")
+        # MoE × TP (ISSUE 15): ep×tp meshes route the MoE block through the
+        # explicit collective dispatch (parallel/moe.py collective_moe_apply
+        # — the reference moe/mappings.py token gather/drop across the tp
+        # group, with the [E, C, M] reshard as facade all_to_all over ep).
+        # The old loud refusal is gone; an unservable shape (non-divisible
+        # tokens/experts) still fails loudly at trace time inside
+        # resolve_dispatch_mode rather than silently mis-routing.
+        if (dict(self.mesh.shape).get("ep", 1) > 1
+                and dict(self.mesh.shape).get("tp", 1) > 1
+                and getattr(mcfg, "has_moe", False)):
+            log_dist(
+                f"MoE ep={self.mesh.shape['ep']} × tp={self.mesh.shape['tp']}: "
+                "token dispatch/combine routed through the collective "
+                "all_to_all (cross-tp gather/drop; moe_dispatch="
+                f"{getattr(mcfg, 'moe_dispatch', 'auto')!r})", ranks=[0])
 
         # ---- pre-flight HBM-fit guard (BEFORE any device materialization:
         # an over-budget init on this platform wedges the device without
@@ -562,6 +570,125 @@ class DeepSpeedTPUEngine:
         log_dist("moe metrics: dispatch gauges ENGAGED "
                  "(moe/capacity_factor|token_drop_rate|expert_load_balance)",
                  ranks=[0])
+
+    def _resolve_moe_autotune(self) -> None:
+        """Arm the host-side capacity-factor controller (``moe_autotune``
+        config block): the model spec is rebuilt with
+        ``moe_capacity_factor_max = max_factor`` so every capacity array is
+        padded to the static ceiling and the gate's drop cutoff follows a
+        traced scalar (batch key ``moe_capacity_factor``); the controller
+        then nudges that scalar between steps from the ``moe/*`` gauges it
+        reads at the existing ``steps_per_print`` fetch — never a recompile,
+        never an extra device sync."""
+        self._moe_autotune = None
+        self._moe_cap_leaf = None
+        self._moe_cap_leaf_value = None
+        cfg = self.config.model.moe_autotune
+        if not cfg.enabled:
+            return
+        # bad bounds are a config error regardless of whether the controller
+        # can arm — report them before any disarm path goes quiet
+        if not (0 < cfg.min_factor <= cfg.max_factor):
+            raise ValueError(
+                f"moe_autotune: need 0 < min_factor <= max_factor, got "
+                f"[{cfg.min_factor}, {cfg.max_factor}]")
+        if not getattr(self, "_moe_metrics", False):
+            # the gauges ARE the controller's sensor; every reason metrics
+            # are unavailable (telemetry off, dense model, pp>1, zero++/
+            # 1-bit/offload step builders) disables autotuning with it
+            log_dist("moe_autotune: requires the moe/* dispatch gauges "
+                     "(telemetry enabled + an MoE model on a non-pp mesh, "
+                     "fused/zero step builders); controller disarmed", ranks=[0])
+            return
+        import dataclasses as _dc
+
+        mcfg = self.model.transformer_config
+        if not mcfg.moe_drop_tokens:
+            log_dist("moe_autotune: drop_tokens=False has no capacity bound "
+                     "to tune; controller disarmed", ranks=[0])
+            return
+        # the ceiling must never SHRINK the capacity below the static factor
+        # the model was tuned with — arming the controller may only add
+        # headroom, so the padded bound is max(max_factor, configured)
+        ceiling = max(float(cfg.max_factor), float(mcfg.moe_capacity_factor))
+        if ceiling > cfg.max_factor:
+            log_dist(
+                f"moe_autotune: max_factor={cfg.max_factor} below the "
+                f"configured moe_capacity_factor={mcfg.moe_capacity_factor}; "
+                f"raising the ceiling to {ceiling} (the controller never "
+                "clamps a model below its static factor)", ranks=[0])
+        if getattr(mcfg, "moe_capacity_factor_max", None) != ceiling:
+            if self.model.rebuild is None:
+                log_dist("moe_autotune: model spec has no rebuild hook; set "
+                         "TransformerConfig(moe_capacity_factor_max=...) to "
+                         "opt in", ranks=[0])
+                return
+            self.model = self.model.rebuild(
+                _dc.replace(mcfg, moe_capacity_factor_max=ceiling))
+            mcfg = self.model.transformer_config
+        self._moe_autotune = cfg
+        self._moe_cap_max = ceiling
+        # the knob starts at the configured static factor, clipped in-bounds
+        self._moe_cap_factor = float(
+            min(max(mcfg.moe_capacity_factor, cfg.min_factor), ceiling))
+        log_dist(
+            f"moe_autotune: capacity-factor controller ENGAGED (start="
+            f"{self._moe_cap_factor:.3f}, bounds=[{cfg.min_factor}, "
+            f"{ceiling}], target_drop={cfg.target_drop_rate}, "
+            f"cadence=every {self.config.model.steps_per_print} steps)",
+            ranks=[0])
+
+    def _moe_autotune_batch_key(self, placed):
+        """Thread the controller's knob into the placed batch: a replicated
+        ``[gas]`` fp32 leaf (one scalar per micro-step, so it rides the
+        micro scan like every other leaf). Shape/dtype/sharding are
+        identical every step — only the VALUE moves, the jit cache holds
+        one program."""
+        if self._moe_autotune is None or not isinstance(placed, dict):
+            return placed
+        leaf = self._moe_cap_leaf
+        if leaf is None or self._moe_cap_leaf_value != self._moe_cap_factor:
+            # the leaf only changes at controller ticks (steps_per_print
+            # cadence) — cache the placed array so steady-state steps pay
+            # no per-step host->device transfer for an unchanged knob
+            gas = self.config.gradient_accumulation_steps
+            leaf = jax.device_put(
+                jnp.full((gas,), self._moe_cap_factor, jnp.float32),
+                NamedSharding(self.mesh, PartitionSpec()))
+            self._moe_cap_leaf = leaf
+            self._moe_cap_leaf_value = self._moe_cap_factor
+        placed = dict(placed)
+        placed["moe_capacity_factor"] = leaf
+        return placed
+
+    def _moe_autotune_update(self, fetched: Dict[str, Any]) -> None:
+        """One controller tick from the freshly fetched step metrics:
+        drops above target raise the effective factor (fast), a balanced
+        no-drop dispatch lowers it (slow decay) — always inside
+        ``[min_factor, max_factor]``."""
+        cfg = self._moe_autotune
+        drop = fetched.get("moe/token_drop_rate")
+        balance = fetched.get("moe/expert_load_balance")
+        if drop is None:
+            return
+        drop = float(drop)
+        prev = self._moe_cap_factor
+        if drop > cfg.target_drop_rate:
+            self._moe_cap_factor = min(prev + cfg.increase_step,
+                                       self._moe_cap_max)
+        elif balance is not None and float(balance) <= cfg.balance_threshold:
+            self._moe_cap_factor = max(prev - cfg.decrease_step, cfg.min_factor)
+        if self._tracer.enabled:
+            # the controller's own breadcrumbs next to the gate gauges it
+            # feeds on (moe/capacity_factor_applied confirms arrival)
+            self._tracer.registry.gauge("moe/capacity_factor_target").set(
+                self._moe_cap_factor)
+        if self._moe_cap_factor != prev:
+            log_dist(
+                f"moe_autotune: drop_rate={drop:.4f} balance="
+                f"{float(balance) if balance is not None else -1.0:.3f} -> "
+                f"capacity factor {prev:.3f} -> {self._moe_cap_factor:.3f}",
+                ranks=[0])
 
     def _configure_offload(self) -> None:
         """Resolve the ZeRO-Offload/Infinity mode from the config.
@@ -2059,6 +2186,8 @@ class DeepSpeedTPUEngine:
                 placed = self._shard_global_batch(batch)
             else:
                 placed = self._stack_micro_batches(data_iter)
+            if getattr(self, "_moe_autotune", None) is not None:
+                placed = self._moe_autotune_batch_key(placed)
         prof = self.flops_profiler
         fp_cfg = prof.config
         config_fire = (fp_cfg.enabled and prof.result is None
@@ -2146,6 +2275,10 @@ class DeepSpeedTPUEngine:
         if step % self.config.model.steps_per_print == 0:
             # periodic sync point: one fetch per steps_per_print batches
             fetched = jax.device_get(metrics)
+            if getattr(self, "_moe_autotune", None) is not None:
+                # controller tick: the fetch already paid the sync, the
+                # adjustment is pure host arithmetic on the step's gauges
+                self._moe_autotune_update(fetched)
             if self._tracer.enabled:
                 # moe/* registry gauges refresh at the existing sync cadence
                 # (ROADMAP item 4 instrumentation: capacity/drops/balance in

@@ -838,3 +838,285 @@ def test_selector_decision_emits_telemetry_instant():
         assert evs[0]["args"]["algorithm"] in ALGS + ("lax",)
     finally:
         telemetry.configure(enabled=False)
+
+
+# --------------------------------------------------------------- all-to-all
+#
+# ISSUE 15: the algorithmic library's all_to_all (ring / bidir / ring2d
+# schedules, encode-once wire codecs, pallas remote-DMA hops) against the
+# ``jax.lax.all_to_all(tiled=True)`` baseline.
+
+A2A_ALGS = ("ring", "bidir", "ring2d")
+
+
+def _lax_a2a(mesh, x, split=0, concat=0):
+    return np.asarray(_run(
+        mesh, lambda v: jax.lax.all_to_all(
+            v[0], "dp", split_axis=split, concat_axis=concat, tiled=True)[None],
+        x))
+
+
+@pytest.mark.parametrize("alg", A2A_ALGS)
+@pytest.mark.parametrize("codec", CODECS)
+def test_all_to_all_matrix_vs_lax(mesh8, alg, codec):
+    """Pure data movement: passthrough codecs are BIT-identical to the lax
+    baseline; lossy wires quantize each destination row exactly once
+    (encode-once at the source, the ring2d middle hop relays WIRE bytes),
+    so the error bound is one codec roundtrip. 37 columns: the per-row
+    length is not a multiple of the codec block (padding path)."""
+    x = _int_payload((8, 64, 37), seed=31)
+
+    def f(v):
+        return collectives.all_to_all(v[0], "dp", split_axis=0, concat_axis=0,
+                                      algorithm=alg, codec=codec,
+                                      block_size=BLOCK)[None]
+
+    out = np.asarray(_run(mesh8, f, x))
+    expected = _lax_a2a(mesh8, x)
+    if codec in ("none", "fp32"):
+        np.testing.assert_array_equal(out, expected, err_msg=f"{alg}/{codec}")
+    elif codec == "bf16":
+        np.testing.assert_allclose(out, expected, rtol=0.01, atol=0.05)
+    else:  # int8 / fp8: ONE quantization regardless of relay hops
+        scale = np.abs(expected).max() + 1e-9
+        tol = 0.01 if codec == "int8" else 0.05
+        assert np.abs(out - expected).max() / scale < tol, (alg, codec)
+        # own block never crosses a link: stays bit-exact on every rank
+        own = np.asarray(x).reshape(8, 8, 8, 37)
+        got = out.reshape(8, 8, 8, 37)
+        for r in range(8):
+            np.testing.assert_array_equal(got[r, r], own[r, r])
+
+
+@pytest.mark.parametrize("alg", A2A_ALGS)
+def test_all_to_all_split_concat_axes(mesh8, alg):
+    """lax tiled semantics on distinct split/concat axes (the MoE dispatch
+    shape: split experts, concat capacity — and back)."""
+    x = _int_payload((8, 16, 8), seed=32)
+
+    def f(split, concat):
+        def body(v):
+            return collectives.all_to_all(v[0], "dp", split_axis=split,
+                                          concat_axis=concat, algorithm=alg)[None]
+        return body
+
+    out = np.asarray(_run(mesh8, f(0, 1), x))
+    np.testing.assert_array_equal(out, _lax_a2a(mesh8, x, split=0, concat=1))
+    out = np.asarray(_run(mesh8, f(1, 0), x))
+    np.testing.assert_array_equal(out, _lax_a2a(mesh8, x, split=1, concat=0))
+
+
+def test_all_to_all_non_divisible_split_raises(mesh8):
+    x = jnp.ones((8, 12), jnp.float32)  # 12 % 8 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        _run(mesh8, lambda v: collectives.all_to_all(
+            v[0], "dp", split_axis=0, concat_axis=0, algorithm="ring")[None], x)
+
+
+def test_all_to_all_rejects_rhd_and_multi_axis(mesh8):
+    with pytest.raises(ValueError, match="recursive-halving"):
+        collectives.all_to_all(jnp.ones((8, 8)), "dp", split_axis=0,
+                               concat_axis=0, algorithm="rhd")
+    with pytest.raises(ValueError, match="one axis"):
+        collectives.all_to_all(jnp.ones((8, 8)), ("dp", "tp"), split_axis=0,
+                               concat_axis=0, algorithm="ring")
+    with pytest.raises(ValueError, match="tiled"):
+        dist.all_to_all(jnp.ones((8, 8)), "dp", split_axis=0, concat_axis=0,
+                        tiled=False, algorithm="ring")
+
+
+def test_all_to_all_ring2d_factorization(mesh8):
+    """The Big-Send-off sub-ring factored schedule: 8 = 4x2, so the traced
+    program carries (a-1)+(b-1) = 4 hop phases instead of ring's 7 — the
+    structural evidence the 2D variant actually factors the exchange."""
+    from deepspeed_tpu.utils.compat import shard_map as smap
+
+    x = jnp.ones((8, 64), jnp.float32)
+
+    def traced(alg):
+        def body(v):
+            return collectives.all_to_all(v[0], "dp", split_axis=0,
+                                          concat_axis=0, algorithm=alg)[None]
+        return jax.make_jaxpr(smap(body, mesh=mesh8, in_specs=P("dp"),
+                                   out_specs=P("dp"), check_vma=False))(x)
+
+    ring = _count_primitives(traced("ring").jaxpr)
+    two_d = _count_primitives(traced("ring2d").jaxpr)
+    assert ring.get("ppermute", 0) == 7
+    assert two_d.get("ppermute", 0) == 4  # (4-1) + (2-1)
+    # bidir pairs mirror distances: ceil(7/2) = 4 phases, two sends in all
+    # but the middle phase -> still 7 row moves
+    bidir = _count_primitives(traced("bidir").jaxpr)
+    assert bidir.get("ppermute", 0) == 7
+
+
+def test_all_to_all_pallas_census(mesh8):
+    """Acceptance (ISSUE 15): the fused pallas dispatch wire runs ONE
+    pallas program per hop — n-1 pallas_calls, ZERO ppermutes — where the
+    unfused int8 ring permutes wire values + scales around XLA codec math."""
+    from deepspeed_tpu.utils.compat import shard_map as smap
+
+    x = jnp.ones((8, 96), jnp.float32)
+
+    def traced(alg, codec):
+        def body(v):
+            return collectives.all_to_all(v[0], "dp", split_axis=0,
+                                          concat_axis=0, algorithm=alg,
+                                          codec=codec, block_size=32)[None]
+        return jax.make_jaxpr(smap(body, mesh=mesh8, in_specs=P("dp"),
+                                   out_specs=P("dp"), check_vma=False))(x)
+
+    fused = _count_primitives(traced("pallas_ring", "int8").jaxpr)
+    assert fused.get("pallas_call", 0) == 7  # n-1 hops, one program each
+    assert fused.get("ppermute", 0) == 0
+    unfused = _count_primitives(traced("ring", "int8").jaxpr)
+    assert unfused.get("pallas_call", 0) == 0
+    assert unfused.get("ppermute", 0) == 2 * 7  # q + scales per hop
+    exact = _count_primitives(traced("pallas_ring", "none").jaxpr)
+    assert exact.get("pallas_call", 0) == 7  # exact wire still remote-DMA
+    assert exact.get("ppermute", 0) == 0
+
+
+@pytest.mark.parametrize("alg,codec", [("pallas_ring", "int8"),
+                                       ("pallas_ring", "fp8"),
+                                       ("pallas_ring2d", "int8")])
+def test_all_to_all_pallas_matches_unfused(mesh8, alg, codec):
+    """Interpret-mode equivalence: the fused requantize->DMA->dequant hop
+    must track the unfused encode-once wire (same ops.quant block math) —
+    and the exact pallas wire must be BIT-identical to lax."""
+    x = (jax.random.normal(jax.random.PRNGKey(33), (8, 96)) * 3).astype(jnp.float32)
+
+    def f(a, c):
+        return lambda v: collectives.all_to_all(
+            v[0], "dp", split_axis=0, concat_axis=0, algorithm=a, codec=c,
+            block_size=32)[None]
+
+    fused = np.asarray(_run(mesh8, f(alg, codec), x))
+    base = "ring" if alg == "pallas_ring" else "ring2d"
+    unfused = np.asarray(_run(mesh8, f(base, codec), x))
+    exact = _lax_a2a(mesh8, x)
+    scale = np.abs(exact).max() + 1e-9
+    tol = 0.02 if codec == "int8" else 0.06  # fp8 E4M3: 3 mantissa bits
+    assert np.abs(fused - exact).max() / scale < tol, (alg, codec)
+    assert np.abs(fused - unfused).max() / scale < tol / 2, (alg, codec)
+    got = np.asarray(_run(mesh8, f("pallas_ring", "none"), x))
+    np.testing.assert_array_equal(got, exact)
+
+
+def test_all_to_all_facade_routing_with_hop_spans(mesh8, tmp_path):
+    """Acceptance (ISSUE 15): comm.all_to_all(algorithm='ring',
+    codec='int8') routes through the collectives layer with the facade span
+    tagged, per-hop coll: spans, and an observatory route signature."""
+    from deepspeed_tpu.collectives import observatory as coll_obs
+
+    tracer = telemetry.configure(enabled=True)
+    tracer.reset()
+    obs = coll_obs.configure(enabled=True, persist=False, refit_every=0,
+                             async_compile=False)
+    try:
+        x = _int_payload((8, 8, 64), seed=34)
+        out = _run(mesh8, lambda v: dist.all_to_all(
+            v[0], "dp", split_axis=0, concat_axis=0, algorithm="ring",
+            codec="int8", block_size=32)[None], x)
+        expected = _lax_a2a(mesh8, x)
+        scale = np.abs(expected).max() + 1e-9
+        assert np.abs(np.asarray(out) - expected).max() / scale < 0.02
+        names = [e.get("name") for e in tracer.events()]
+        facade = next(e for e in tracer.events()
+                      if e.get("name") == "comm:all_to_all")
+        assert facade["args"]["algorithm"] == "ring"
+        assert facade["args"]["codec"] == "int8"
+        assert any(n == "coll:all_to_all:ring" for n in names), names
+        routes = obs.routes()
+        sig = next(r for r in routes if r.op == "all_to_all")
+        assert (sig.algorithm, sig.codec, sig.backend) == ("ring", "int8",
+                                                           "ppermute")
+        assert sig.hops == 7 and sig.wire_bytes > 0  # n-1 hop census
+    finally:
+        coll_obs.configure(enabled=False)
+        telemetry.configure(enabled=False)
+
+
+def test_all_to_all_selector_and_measured_routing(tmp_path):
+    """Selector coverage for the new op: the model never proposes rhd (no
+    recursive-halving form), repeated queries hit the decision cache, and a
+    measured decision-table row routes an auto call onto its algorithm."""
+    selector.configure(codecs=("none", "int8"))
+    d1 = selector.select("all_to_all", 1 << 20, 8)
+    assert d1.algorithm != "rhd"
+    d2 = selector.select("all_to_all", 1 << 20, 8)
+    assert d1 is d2 and selector.cache_info()["hits"] >= 1
+    # measured mode: a table row for all_to_all wins over the model
+    table = [{"op": "all_to_all", "world": 8, "size_mb": 1.0,
+              "algorithm": "ring2d", "codec": "int8", "latency_ms": 0.4},
+             {"op": "all_to_all", "world": 8, "size_mb": 1.0,
+              "algorithm": "ring", "codec": "none", "latency_ms": 2.0}]
+    path = tmp_path / "a2a.json"
+    path.write_text(json.dumps(table))
+    selector.configure(decision_table=str(path), codecs=("none", "int8"))
+    d = selector.select("all_to_all", 1_000_000, 8)
+    assert d.source == "measured" and d.algorithm == "ring2d" and d.codec == "int8"
+
+
+def test_all_to_all_candidate_pairs_exclude_rhd():
+    """The sweep/probe enumeration (ONE function, shared) never proposes
+    rhd for all_to_all, on any world size."""
+    from deepspeed_tpu.comm.benchmark import candidate_pairs
+
+    pairs = candidate_pairs(8, ("none", "int8"), op="all_to_all")
+    assert pairs and all(alg != "rhd" for alg, _ in pairs)
+    assert ("ring", "int8") in pairs and ("lax", "none") in pairs
+    # other ops keep rhd on pow2 worlds (no behavior change)
+    assert any(alg == "rhd" for alg, _ in candidate_pairs(8, ("none",)))
+
+
+def test_all_to_all_sweep_feeds_selector(tmp_path):
+    """--sweep covers all_to_all end-to-end: backend-stamped rows the
+    measured mode consumes."""
+    from deepspeed_tpu.comm.benchmark import run_sweep
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("dp",))
+    rows = run_sweep(ops=("all_to_all",), sizes_mb=[0.01], mesh=mesh,
+                     algorithms=["lax", "ring"], codecs=["none"],
+                     iters=2, warmup=1)
+    assert {r["algorithm"] for r in rows} == {"lax", "ring"}
+    assert all(r["op"] == "all_to_all" and r["latency_ms"] > 0 for r in rows)
+    assert {r["backend"] for r in rows} == {"xla", "ppermute"}
+    path = tmp_path / "sweep.json"
+    path.write_text(json.dumps(rows))
+    selector.configure(decision_table=str(path))
+    d = selector.select("all_to_all", 10_000, 4)
+    assert d.source == "measured"
+
+
+def test_qgz_exchange_wire_stays_on_lax(mesh8):
+    """The zeropp qgZ destination-shard exchange moves an ALREADY-ENCODED
+    wire — a facade default must never route it back through the
+    algorithmic/codec path (double quantization)."""
+    from deepspeed_tpu.parallel.quant_collectives import exchange_wire
+
+    selector.configure(facade_algorithm="ring", facade_codec="int8")
+    tracer = telemetry.configure(enabled=True)
+    tracer.reset()
+    try:
+        x = _int_payload((8, 64), seed=35)
+        out = _run(mesh8, lambda v: exchange_wire(v[0], "dp")[None], x)
+        np.testing.assert_array_equal(np.asarray(out), _lax_a2a(mesh8, x))
+        facade = next(e for e in tracer.events()
+                      if e.get("name") == "comm:all_to_all")
+        assert "algorithm" not in facade.get("args", {})
+    finally:
+        telemetry.configure(enabled=False)
+
+
+def test_all_to_all_facade_default_rhd_falls_back_to_lax(mesh8):
+    """A configured facade default the op has NO form of (rhd) must keep
+    default-routed all_to_all on the lax lowering — only an explicit rhd
+    request surfaces the library's error."""
+    selector.configure(facade_algorithm="rhd", facade_codec="int8")
+    x = _int_payload((8, 64), seed=36)
+    out = np.asarray(_run(
+        mesh8, lambda v: dist.all_to_all(v[0], "dp", split_axis=0,
+                                         concat_axis=0)[None], x))
+    np.testing.assert_array_equal(out, _lax_a2a(mesh8, x))

@@ -250,3 +250,89 @@ def test_alibi_model_under_sp_matches_dp(devices):
     l_dp = run({"dp": 8}, gas=1)
     l_sp = run({"sp": 2, "dp": 4}, gas=2)
     np.testing.assert_allclose(l_sp, l_dp, rtol=2e-5, atol=2e-6)
+
+
+class TestMoETPComposition:
+    """ISSUE 15: ep x tp meshes route the MoE block through the explicit
+    collective token dispatch (parallel/moe.py collective_moe_apply) instead
+    of the old loud refusal at runtime/engine.py."""
+
+    def test_collective_dispatch_matches_gspmd_on_ep_mesh(self, devices):
+        """Forced collective dispatch reproduces the verified GSPMD ep-only
+        trajectory on the SAME mesh — the correctness pin for the shard_map
+        + facade all_to_all region itself (no cross-mesh init confounds)."""
+        coll = TransformerConfig(**{**MOE_MODEL.__dict__,
+                                    "moe_dispatch": "collective"})
+        e1, *_ = deepspeed_tpu.initialize(
+            model=causal_lm_spec(MOE_MODEL), config=_cfg(mesh={"dp": 2, "ep": 4}),
+            seed=13)
+        e2, *_ = deepspeed_tpu.initialize(
+            model=causal_lm_spec(coll), config=_cfg(mesh={"dp": 2, "ep": 4}),
+            seed=13)
+        l1 = [float(e1.train_batch(_tokens(2, 16, seed=70 + i))["loss"])
+              for i in range(3)]
+        l2 = [float(e2.train_batch(_tokens(2, 16, seed=70 + i))["loss"])
+              for i in range(3)]
+        np.testing.assert_allclose(l1, l2, rtol=1e-4)
+
+    def test_ep_tp_trains_and_matches_global_math(self, devices):
+        """Acceptance: dp2 x ep2 x tp2 trains end-to-end, and the collective
+        dispatch on that mesh reproduces the GLOBAL (1-device) math of the
+        same loss on the engine's own trained params — the direct
+        mis-routing pin (the GSPMD constraint path the engine used to
+        refuse deviates ~0.5% here; the collective region must not).
+        Cross-mesh trajectory comparison is impossible at identical params
+        (sharded init draws per-shard RNG), so the reference is a replay,
+        not a second engine."""
+        from deepspeed_tpu.topology import mesh as mesh_mod
+        from deepspeed_tpu.topology.mesh import set_mesh
+
+        e2, *_ = deepspeed_tpu.initialize(
+            model=causal_lm_spec(MOE_MODEL),
+            config=_cfg(mesh={"dp": 2, "ep": 2, "tp": 2}, micro=2), seed=21)
+        assert e2.train_batch_size == 4
+        l2 = [float(e2.train_batch(_tokens(4, 16, seed=90 + i))["loss"])
+              for i in range(6)]
+        assert l2[-1] < l2[0]  # end-to-end: the composition actually learns
+        w = e2.state.params["layers"]["moe"]["experts"]["w_up"]
+        assert "ep" in str(w.sharding.spec), w.sharding.spec
+        # replay: same loss fn, same params, same rng — once through the
+        # ep x tp collective dispatch, once as plain global math
+        host = jax.device_get(e2.state.params)
+        batch = _tokens(4, 16, seed=99)
+        rng = jax.random.PRNGKey(7)
+        set_mesh(e2.mesh)
+        mesh_loss = float(jax.jit(e2.model.loss_fn)(host, batch, rng)[0])
+        mesh_mod._ACTIVE_MESH = None  # no mesh: the unsharded reference
+        global_loss = float(jax.jit(e2.model.loss_fn)(host, batch, rng)[0])
+        np.testing.assert_allclose(mesh_loss, global_loss, rtol=1e-5)
+
+    def test_ep_tp_int8_wire_bounded(self, devices):
+        """The quantized dispatch wire (moe_wire_codec='int8') on the
+        ep x tp mesh stays within a pinned bound of the exact wire — and
+        still learns."""
+        q = TransformerConfig(**{**MOE_MODEL.__dict__,
+                                 "moe_wire_codec": "int8"})
+        e1, *_ = deepspeed_tpu.initialize(
+            model=causal_lm_spec(MOE_MODEL),
+            config=_cfg(mesh={"dp": 2, "ep": 2, "tp": 2}), seed=33)
+        e2, *_ = deepspeed_tpu.initialize(
+            model=causal_lm_spec(q),
+            config=_cfg(mesh={"dp": 2, "ep": 2, "tp": 2}), seed=33)
+        l1 = [float(e1.train_batch(_tokens(2, 16, seed=40 + i))["loss"])
+              for i in range(4)]
+        l2 = [float(e2.train_batch(_tokens(2, 16, seed=40 + i))["loss"])
+              for i in range(4)]
+        np.testing.assert_allclose(l2, l1, rtol=0.05)  # quantization-bounded
+        assert np.isfinite(l2).all()
+
+    def test_ep_tp_unservable_shape_fails_loudly(self, devices):
+        """The old blanket NotImplementedError is gone; what remains loud is
+        a genuinely unservable ep x tp shape (experts not divisible by ep)
+        — it must raise at trace time, never silently mis-route."""
+        bad = TransformerConfig(**{**MOE_MODEL.__dict__, "num_experts": 3})
+        with pytest.raises(ValueError, match="collective token dispatch"):
+            engine, *_ = deepspeed_tpu.initialize(
+                model=causal_lm_spec(bad),
+                config=_cfg(mesh={"dp": 2, "ep": 2, "tp": 2}))
+            engine.train_batch(_tokens(engine.train_batch_size, 16))

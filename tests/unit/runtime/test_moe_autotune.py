@@ -1,0 +1,171 @@
+"""Capacity-factor autotuning (ISSUE 15): the host-side controller moves the
+gate's effective capacity between steps from the moe/* dispatch gauges,
+inside the moe_autotune bounds, with the jit cache pinned at ONE program
+(capacity arrays are padded to the static ceiling; only the traced cutoff
+scalar moves)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+from deepspeed_tpu.telemetry import get_tracer
+
+
+def _moe_cfg(**overrides):
+    base = dict(
+        vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+        num_heads=4, max_seq_len=64, num_experts=4, moe_top_k=2,
+        moe_capacity_factor=1.0)
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def _engine(model_cfg, autotune, steps_per_print=1, telemetry=True):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": steps_per_print,
+        "telemetry": {"enabled": telemetry},
+        "moe_autotune": autotune,
+    }
+    eng, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(model_cfg, example_seq_len=16), config=cfg)
+    return eng
+
+
+def _batch(eng, seed=0, vocab=128):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(
+        0, vocab, (eng.train_batch_size, 16), dtype=np.int32)}
+
+
+def test_drop_rate_above_target_raises_capacity_within_bounds(devices):
+    """Starting tight (factor 1.0), random routing drops tokens, so every
+    controller tick must RAISE the factor — monotonically, by
+    increase_step, never past max_factor — and the compiled step count
+    stays at one program across all adjustments."""
+    eng = _engine(_moe_cfg(), {
+        "enabled": True, "target_drop_rate": 0.01, "min_factor": 0.5,
+        "max_factor": 2.0, "increase_step": 0.25})
+    assert eng._moe_autotune is not None
+    factors = [eng._moe_cap_factor]
+    drops = []
+    for i in range(6):
+        m = eng.train_batch(_batch(eng, seed=i))
+        drops.append(float(m["moe/token_drop_rate"]))
+        factors.append(eng._moe_cap_factor)
+    # every above-target observation raised the knob by exactly the step
+    for prev, nxt, d in zip(factors, factors[1:], drops):
+        if d > 0.01:
+            assert nxt == pytest.approx(min(prev + 0.25, 2.0))
+        assert 0.5 <= nxt <= 2.0
+    assert factors[-1] > factors[0]  # net effect: capacity grew
+    assert eng._train_step._cache_size() == 1  # one program, a moving scalar
+
+
+def test_balanced_no_drop_load_lowers_capacity(devices):
+    """At a generous starting factor the drop rate is ~0 and the dispatch
+    is near balanced, so ticks DECAY the factor toward min_factor (by
+    decrease_step, never below)."""
+    eng = _engine(_moe_cfg(moe_capacity_factor=2.0), {
+        "enabled": True, "target_drop_rate": 0.5, "min_factor": 1.0,
+        "max_factor": 2.0, "decrease_step": 0.125, "balance_threshold": 4.0})
+    factors = [eng._moe_cap_factor]
+    for i in range(4):
+        m = eng.train_batch(_batch(eng, seed=10 + i))
+        assert float(m["moe/token_drop_rate"]) <= 0.5
+        factors.append(eng._moe_cap_factor)
+    for prev, nxt in zip(factors, factors[1:]):
+        assert nxt == pytest.approx(max(prev - 0.125, 1.0))
+    assert factors[-1] < factors[0]
+    assert eng._train_step._cache_size() == 1
+
+
+def test_applied_gauge_reflects_realized_factor(devices):
+    """moe/capacity_factor_applied is the factor the step's cutoff actually
+    ENFORCED — it must track the knob with a one-step lag (the controller
+    adjusts AFTER the step ran) and land in the registry/monitor stream."""
+    eng = _engine(_moe_cfg(), {
+        "enabled": True, "target_drop_rate": 0.0, "min_factor": 0.5,
+        "max_factor": 2.0, "increase_step": 0.5})
+    knob_before = []
+    applied = []
+    for i in range(3):
+        knob_before.append(eng._moe_cap_factor)
+        m = eng.train_batch(_batch(eng, seed=20 + i))
+        applied.append(float(m["moe/capacity_factor_applied"]))
+    # applied_t == ceil-quantized knob_t (the cutoff is an integer slot
+    # count, so the realized factor is the knob rounded UP to the slot grid
+    # within bounds); with T=32 tokens x k=2 over E=4 the grid is E/(T*k)
+    T, k, E = 32, 2, 4
+    for knob, got in zip(knob_before, applied):
+        slots = np.ceil(T * k * knob / E)
+        assert got == pytest.approx(float(slots) * E / (T * k))
+    # the registry carries both the applied gauge and the controller target
+    reg = get_tracer().registry
+    assert reg.gauge("moe/capacity_factor_applied").value > 0
+    assert reg.gauge("moe/capacity_factor_target").value == pytest.approx(
+        eng._moe_cap_factor)
+
+
+def test_autotune_disarmed_without_gauges(devices):
+    """moe_autotune without telemetry (no moe/* sensors) must disarm the
+    controller — the engine trains exactly as before, no batch key, no
+    factor state."""
+    eng = _engine(_moe_cfg(), {"enabled": True}, telemetry=False)
+    assert eng._moe_autotune is None
+    m = eng.train_batch(_batch(eng))
+    assert np.isfinite(float(m["loss"]))
+    assert "moe/capacity_factor_applied" not in m
+
+
+def test_autotune_bad_bounds_rejected(devices):
+    with pytest.raises(ValueError, match="min_factor"):
+        _engine(_moe_cfg(), {"enabled": True, "min_factor": 2.0,
+                             "max_factor": 1.0})
+    # a config error must surface even when the controller would disarm
+    # (telemetry off) — never accepted silently
+    with pytest.raises(ValueError, match="min_factor"):
+        _engine(_moe_cfg(), {"enabled": True, "min_factor": 2.0,
+                             "max_factor": 1.0}, telemetry=False)
+
+
+def test_gate_dynamic_capacity_unit():
+    """top_k_gating with effective_capacity: the traced cutoff is enforced
+    (no slot beyond it is used), the arrays keep the padded static bound,
+    and the applied-factor stat reports the cutoff."""
+    from deepspeed_tpu.parallel.moe import top_k_gating
+
+    T, E, C = 32, 4, 16
+    logits = jax.random.normal(jax.random.PRNGKey(0), (T, E))
+    eff = jnp.int32(4)
+    l_aux, combine, dispatch, counts, stats = top_k_gating(
+        logits, 2, C, use_rts=False, drop_tokens=True, collect_stats=True,
+        effective_capacity=eff)
+    assert dispatch.shape == (T, E, C)  # padded static bound
+    used = np.asarray(dispatch).sum(axis=(0, 1))  # per-slot occupancy
+    assert used[4:].sum() == 0  # nothing beyond the dynamic cutoff
+    assert used[:4].sum() > 0
+    assert float(stats["moe/capacity_factor_applied"]) == pytest.approx(
+        4 * E / (T * 2))
+    # same call, larger cutoff: more slots fill, same shapes (jit-stable)
+    _, _, d2, _, s2 = top_k_gating(
+        logits, 2, C, use_rts=False, drop_tokens=True, collect_stats=True,
+        effective_capacity=jnp.int32(16))
+    assert d2.shape == dispatch.shape
+    assert np.asarray(d2).sum() >= np.asarray(dispatch).sum()
+
+
+def test_autotune_never_shrinks_configured_capacity(devices):
+    """max_factor below the model's static capacity factor must RAISE the
+    ceiling, not clamp the model below what it was tuned with."""
+    eng = _engine(_moe_cfg(moe_capacity_factor=3.0), {
+        "enabled": True, "min_factor": 1.0, "max_factor": 2.0})
+    assert eng._moe_cap_max == 3.0
+    assert eng._moe_cap_factor == 3.0  # starts AT the configured factor
+    assert eng.model.transformer_config.moe_capacity_factor_max == 3.0
+    m = eng.train_batch(_batch(eng))
+    assert float(m["moe/capacity_factor_applied"]) >= 1.0

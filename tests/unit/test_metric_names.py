@@ -145,7 +145,12 @@ def test_known_names_pass_and_bad_names_fail():
                  # disaggregated serving (ISSUE 14)
                  "serving/migration_ms", "serving/migrated_blocks",
                  "serving/migration_failures", "router/migrations",
-                 "fleet/role_processes"):
+                 "fleet/role_processes",
+                 # MoE at scale (ISSUE 15): capacity autotuning gauges next
+                 # to the PR-7 dispatch-health family; the all-to-all hop
+                 # timings ride the existing coll/* histograms
+                 "moe/capacity_factor_applied", "moe/capacity_factor_target",
+                 "moe/token_drop_rate", "coll/hop_ms", "coll/achieved_gbps"):
         assert _check_name(good) is None, good
     for bad in ("ttft", "Serving/ttft", "serving ttft", "{x}/y", "bogus/name"):
         assert _check_name(bad) is not None, bad

@@ -8,7 +8,7 @@ Two uses:
     hop backend, the calibrated alpha/beta constants, drift counters, and a
     staleness check on the persisted decision table.
   - **CLI / nightly stage**: run standalone it forces an 8-device CPU mesh,
-    routes the three algorithmic collectives through the comm facade,
+    routes the four algorithmic collectives (all_to_all included) through the comm facade,
     drains the observatory's probe queue (real timed hop-scope dispatches),
     refits alpha/beta, injects one deliberately slow sample to prove the
     drift alarm arms, and persists the online table — proving on every
@@ -102,7 +102,7 @@ def table_age_hours(path: str) -> Optional[float]:
 
 
 def _drive_probes(table_path: str, rounds: int) -> dict:
-    """Route the three algorithmic ops on an 8-device CPU mesh, drain the
+    """Route the four algorithmic ops on an 8-device CPU mesh, drain the
     observatory probe queue, refit, and fire the injected-drift check."""
     import jax
     import jax.numpy as jnp
@@ -133,6 +133,11 @@ def _drive_probes(table_path: str, rounds: int) -> dict:
           P("dp"))
     route(lambda v: dist.reduce_scatter(v, "dp", algorithm="ring",
                                         codec="none"), P("dp"))
+    # all_to_all (ISSUE 15): the MoE dispatch wire enters the same feedback
+    # loop — quantized ring route + a second family via the probe queue
+    route(lambda v: dist.all_to_all(v, "dp", split_axis=0, concat_axis=0,
+                                    algorithm="ring", codec="int8",
+                                    block_size=64), P("dp"))
 
     step = 0
     for _ in range(rounds):
@@ -224,7 +229,7 @@ def main(argv: Optional[list] = None) -> int:
 
     ok = {
         "ops_probed": set(gates.get("ops_probed", ())) == {
-            "all_reduce", "all_gather", "reduce_scatter"},
+            "all_reduce", "all_gather", "reduce_scatter", "all_to_all"},
         "multi_algorithm_coverage": gates.get("multi_algorithm_coverage", False),
         "refit_finite": gates.get("refit_finite", False),
         "selector_calibrated": gates.get("selector_calibrated", False),

@@ -101,6 +101,25 @@ disagg_rc=${PIPESTATUS[0]}
 [ "${disagg_rc}" -ne 0 ] && rc=1
 echo "# disagg smoke: ${DISAGG_OUT} (exit ${disagg_rc})" >> "${OUT}"
 
+# MoE-at-scale smoke (ISSUE 15): dp2 x ep2 x tp2 collective-dispatch
+# training must match a global-math replay of its own params (the
+# mis-routing gate), the int8 dispatch wire must stay within its pinned
+# bound, and an ep-sharded v2 engine must decode token-identical to ep=1
+# through the collective dispatch. Committed as its own artifact (log +
+# JSON) so the ep x tp composition is auditable per round.
+MOE_OUT="MOE_${ROUND}.log"
+{
+  echo "# moe-at-scale smoke — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  echo "# HEAD: ${HEAD_SHA}"
+  echo "# uncommitted-diff sha256: ${DIFF_SHA}"
+  echo "# cmd: python tools/moe_smoke.py --output MOE_${ROUND}.json"
+} > "${MOE_OUT}"
+JAX_PLATFORMS=cpu python tools/moe_smoke.py --output "MOE_${ROUND}.json" \
+  2>/dev/null | tee -a "${MOE_OUT}"
+moe_rc=${PIPESTATUS[0]}
+[ "${moe_rc}" -ne 0 ] && rc=1
+echo "# moe smoke: ${MOE_OUT} (exit ${moe_rc})" >> "${OUT}"
+
 # Compiled-program inventory (ISSUE 7): the registry must capture a real
 # train-step and v2 decode-chain program with nonzero flops/peak-HBM and a
 # computed hbm/estimate_ratio. Committed alongside this log as its own
@@ -156,8 +175,8 @@ fleet_rc=${PIPESTATUS[0]}
 echo "# fleet smoke: ${FLEET_OUT} (exit ${fleet_rc})" >> "${OUT}"
 
 {
-  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, disagg smoke: ${disagg_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc})"
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, disagg smoke: ${disagg_rc}, moe smoke: ${moe_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
-echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT} ${DISAGG_OUT}"
+echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT} ${DISAGG_OUT} ${MOE_OUT}"
 exit "${rc}"
