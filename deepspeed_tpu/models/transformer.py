@@ -103,7 +103,7 @@ class TransformerConfig:
     # Pallas attention scheduling knobs forwarded to the flash kernel when it
     # is the resolved impl (dropped on the XLA path — identical math either
     # way): {"block_q": ..., "block_k": ..., "k_splits": ...}. The autotuner /
-    # profile_attn_sweep pick these on hardware. Frozen to a tuple-of-pairs at
+    # profile_bench --stage attn-sweep pick these on hardware. Frozen to a tuple-of-pairs at
     # construction (configs are jit static args).
     attn_kwargs: Optional[Any] = None
     sp_impl: str = "ulysses"  # ulysses (all-to-all) | ring (ppermute) over sp

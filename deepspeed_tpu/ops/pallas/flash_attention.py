@@ -665,7 +665,7 @@ def flash_causal_attention(
     # next sub-chunk's QK^T hoisted ahead of the previous one's softmax, so the
     # MXU matmul overlaps the VPU exp2/renormalize passes (the named TF/s
     # bottleneck, PERF.md). Pure instruction-level restructuring: identical
-    # math, A/B via tools/profile_attn_sweep.py. A fixed k_splits must stay
+    # math, A/B via tools/profile_bench.py --stage attn-sweep. A fixed k_splits must stay
     # valid when short sequences clamp block_k, so degrade to the largest
     # compatible divisor (sub-chunks divide block_k; >=128 lanes on hardware).
     while k_splits > 1 and (block_k % k_splits != 0

@@ -56,6 +56,10 @@ from deepspeed_tpu.telemetry.fleet import (
     configure_identity,
     get_identity,
 )
+from deepspeed_tpu.telemetry.perfledger import (
+    PerfLedger,
+    make_row,
+)
 from deepspeed_tpu.telemetry.registry import (
     Counter,
     Gauge,
@@ -79,6 +83,7 @@ __all__ = [
     "MetricsRegistry",
     "MetricsServer",
     "NOOP_SPAN",
+    "PerfLedger",
     "ProcessIdentity",
     "TraceContext",
     "Tracer",
@@ -94,6 +99,7 @@ __all__ = [
     "export_prometheus",
     "get_identity",
     "get_tracer",
+    "make_row",
     "render_json_snapshot",
     "render_prometheus",
     "serve_metrics",

@@ -1,0 +1,256 @@
+"""Noise-aware perf regression gate over the unified ledger.
+
+The gate compares fresh rows against the per-``(backend, suite, metric)``
+ledger history — NEVER across backends: a cpu number can't vouch for (or
+indict) a tpu number. Three gating modes, chosen per row:
+
+  1. **Absolute overhead bound** — metrics ending in ``overhead_pct`` /
+     ``overhead_pct_max`` carry their own contract (the repo-wide <2%
+     paired-step bound every telemetry feature ships under); they fail on
+     ``value > overhead_bound_pct`` with no history needed.
+  2. **Headline history gate** — the curated per-suite headline metrics
+     (:data:`HEADLINE_PATTERNS`) gate on the PR-2 median+MAD discipline
+     when history has quorum (>=3 rows): regression = beyond
+     ``median ± mads·MAD`` in the row's bad direction, with the MAD floored
+     at ``mad_floor_rel·|median|`` so a too-quiet history can't make the
+     gate hair-triggered. Below quorum, a relative-bound fallback
+     (default 30% worsening vs the historical median) applies.
+  3. **Trajectory-only** — everything else (config echoes, percentile
+     tails, sub-metrics) publishes a ``perf/trajectory`` gauge and never
+     fails the build. The legacy history is genuinely noisy (serving
+     telemetry-overhead wandered 12→28% across rounds); gating every row
+     would train people to ignore the gate.
+
+A regression does three things beyond the nonzero exit: increments the
+``perf/regression_events`` counter, publishes the offending value as a
+``perf/trajectory`` gauge (both in the PR-1 registry), and arms every
+live PR-7 profiler capture via :func:`profiling.capture.arm_all` — a
+nightly regression leaves a step trace, not just a red line in a log.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import statistics
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.telemetry.perfledger import PerfLedger, row_key
+
+#: per-suite curated headline metrics (fnmatch patterns on the metric
+#: path) — the numbers a round is *about*; sub-metrics stay trajectory-only
+HEADLINE_PATTERNS: Dict[str, Tuple[str, ...]] = {
+    "bench": ("tokens_per_sec*",),
+    "serving": (
+        "end_to_end/chained/tokens_per_sec",
+        "host_path/chained/host_us_per_decode_token",
+        "slo/goodput",
+    ),
+    "perf": ("*tokens_per_sec*",),
+}
+
+#: matched AFTER the headline patterns: derived ratios ride along with a
+#: headline name but are baseline-relative, not round-comparable
+HEADLINE_EXCLUDE: Tuple[str, ...] = ("*/vs_baseline",)
+
+_OVERHEAD_SUFFIXES = ("overhead_pct", "overhead_pct_max")
+
+
+@dataclass
+class GateConfig:
+    mads: float = 6.0            # PR-2 straggler discipline width
+    quorum: int = 3              # min history rows for the MAD gate
+    rel_bound: float = 0.30      # sub-quorum fallback: max fractional worsening
+    mad_floor_rel: float = 0.01  # MAD floor as a fraction of |median|
+    overhead_bound_pct: float = 2.0  # absolute bound for *overhead_pct rows
+    policy: str = "headline"     # "headline" | "all" (gate every row)
+    headline: Dict[str, Tuple[str, ...]] = field(
+        default_factory=lambda: dict(HEADLINE_PATTERNS))
+
+
+@dataclass
+class Verdict:
+    row: Dict[str, Any]
+    status: str        # "ok" | "regression" | "no_history" | "info"
+    mode: str          # "absolute" | "mad" | "rel" | "info"
+    detail: str = ""
+    threshold: Optional[float] = None
+    history_n: int = 0
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return row_key(self.row)
+
+
+@dataclass
+class GateReport:
+    verdicts: List[Verdict]
+
+    @property
+    def regressions(self) -> List[Verdict]:
+        return [v for v in self.verdicts if v.status == "regression"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.regressions
+
+    def summary(self) -> str:
+        n = len(self.verdicts)
+        gated = sum(1 for v in self.verdicts if v.mode != "info")
+        lines = [f"perf_gate: {n} rows checked, {gated} gated, "
+                 f"{len(self.regressions)} regression(s)"]
+        for v in self.regressions:
+            b, s, m = v.key
+            lines.append(f"  REGRESSION [{b}] {s}/{m}: value="
+                         f"{v.row['value']:.6g} {v.detail}")
+        return "\n".join(lines)
+
+
+def is_overhead_metric(metric: str) -> bool:
+    return metric.endswith(_OVERHEAD_SUFFIXES)
+
+
+def is_headline(row: Dict[str, Any], cfg: GateConfig) -> bool:
+    metric = str(row["metric"])
+    if any(fnmatch.fnmatch(metric, pat) for pat in HEADLINE_EXCLUDE):
+        return False
+    pats = cfg.headline.get(str(row["suite"]), ())
+    return any(fnmatch.fnmatch(metric, pat) for pat in pats)
+
+
+def _worsening(value: float, base: float, direction: str) -> float:
+    """Fractional change in the row's BAD direction (positive = worse)."""
+    if base == 0:
+        return 0.0
+    delta = (base - value) if direction == "higher" else (value - base)
+    return delta / abs(base)
+
+
+def gate_row(row: Dict[str, Any], history: Sequence[Dict[str, Any]],
+             cfg: GateConfig) -> Verdict:
+    """Pure per-row decision. ``history`` must already be the row's own
+    (backend, suite, metric) key — callers own backend isolation; this
+    function enforces it defensively."""
+    history = [h for h in history if row_key(h) == row_key(row)]
+    value = float(row["value"])
+    direction = str(row["direction"])
+    metric = str(row["metric"])
+
+    if is_overhead_metric(metric):
+        bound = cfg.overhead_bound_pct
+        if value > bound:
+            return Verdict(row, "regression", "absolute",
+                           f"> absolute bound {bound:g}%", bound, len(history))
+        return Verdict(row, "ok", "absolute", f"<= bound {bound:g}%",
+                       bound, len(history))
+
+    if cfg.policy != "all" and not is_headline(row, cfg):
+        return Verdict(row, "info", "info", "trajectory-only",
+                       None, len(history))
+
+    vals = [float(h["value"]) for h in history]
+    if not vals:
+        return Verdict(row, "no_history", "info", "no history for key",
+                       None, 0)
+
+    med = statistics.median(vals)
+    if len(vals) >= cfg.quorum:
+        mad = statistics.median(abs(v - med) for v in vals)
+        mad = max(mad, cfg.mad_floor_rel * abs(med), 1e-9)
+        if direction == "higher":
+            threshold = med - cfg.mads * mad
+            bad = value < threshold
+        else:
+            threshold = med + cfg.mads * mad
+            bad = value > threshold
+        status = "regression" if bad else "ok"
+        return Verdict(row, status, "mad",
+                       f"median={med:.6g} mad={mad:.6g} n={len(vals)} "
+                       f"threshold={threshold:.6g}", threshold, len(vals))
+
+    worsening = _worsening(value, med, direction)
+    bad = worsening > cfg.rel_bound
+    return Verdict(row, "regression" if bad else "ok", "rel",
+                   f"median={med:.6g} n={len(vals)} worsening="
+                   f"{worsening:.1%} (bound {cfg.rel_bound:.0%})",
+                   cfg.rel_bound, len(vals))
+
+
+# ------------------------------------------------------------ orchestration
+def gate_fresh(rows: Sequence[Dict[str, Any]], ledger: PerfLedger,
+               cfg: Optional[GateConfig] = None) -> GateReport:
+    """Gate a fresh run's rows against the full ledger history. Rows of a
+    versioned round (round > 0) compare only against strictly older
+    rounds; unversioned rows (round 0) compare against everything."""
+    cfg = cfg or GateConfig()
+    verdicts = []
+    for row in rows:
+        backend, suite, metric = row_key(row)
+        before = int(row["round"]) if int(row["round"]) > 0 else None
+        history = ledger.history(backend, suite, metric, before_round=before)
+        verdicts.append(gate_row(row, history, cfg))
+    return GateReport(verdicts)
+
+
+def self_check(ledger: PerfLedger, cfg: Optional[GateConfig] = None,
+               ) -> GateReport:
+    """Gate the latest round of every key against its own older history —
+    the nightly's HEAD-must-pass check over the committed ledger."""
+    cfg = cfg or GateConfig()
+    by_key: Dict[Tuple[str, str, str], List[Dict[str, Any]]] = {}
+    for row in ledger.rows():
+        by_key.setdefault(row_key(row), []).append(row)
+    verdicts = []
+    for key, rows in sorted(by_key.items()):
+        latest = max(int(r["round"]) for r in rows)
+        fresh = [r for r in rows if int(r["round"]) == latest]
+        history = sorted((r for r in rows if int(r["round"]) < latest),
+                         key=lambda r: int(r["round"]))
+        for row in fresh:
+            verdicts.append(gate_row(row, history, cfg))
+    return GateReport(verdicts)
+
+
+def inject_regression(rows: Sequence[Dict[str, Any]], pct: float,
+                      ) -> List[Dict[str, Any]]:
+    """Synthetically degrade rows by ``pct``% in each row's bad direction —
+    the nightly proves the gate FIRES on these (inverted exit check), so a
+    green gate is evidence of a working sentinel, not a silent one."""
+    factor = pct / 100.0
+    out = []
+    for row in rows:
+        row = dict(row)
+        if row["direction"] == "higher":
+            row["value"] = float(row["value"]) * (1.0 - factor)
+        else:
+            row["value"] = float(row["value"]) * (1.0 + factor)
+        out.append(row)
+    return out
+
+
+def publish(report: GateReport, registry=None, arm: bool = True,
+            ) -> Dict[str, Any]:
+    """Land the gate outcome in the telemetry plane: a ``perf/trajectory``
+    gauge per gated row, a ``perf/regression_events`` counter increment per
+    regression, and (``arm=True``) arm every live profiler capture so the
+    next step window leaves a trace."""
+    if registry is None:
+        from deepspeed_tpu.telemetry import get_tracer
+
+        registry = get_tracer().registry
+    armed = 0
+    for v in report.verdicts:
+        backend, suite, metric = v.key
+        if v.mode != "info" or v.status == "no_history":
+            registry.gauge("perf/trajectory", suite=suite, metric=metric,
+                           backend=backend).set(float(v.row["value"]))
+    for v in report.regressions:
+        backend, suite, metric = v.key
+        registry.counter("perf/regression_events", suite=suite,
+                         metric=metric, backend=backend).add(1)
+    if report.regressions and arm:
+        from deepspeed_tpu.profiling.capture import arm_all
+
+        worst = report.regressions[0]
+        armed = arm_all(reason="perf_gate:" + "/".join(worst.key))
+    return {"regressions": len(report.regressions), "captures_armed": armed}

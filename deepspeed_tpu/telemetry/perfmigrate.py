@@ -1,0 +1,273 @@
+"""Legacy perf-artifact migration into the unified ledger (schema v1).
+
+Every pre-ledger round left a root-level JSON artifact with its own shape:
+
+  - ``BENCH_rNN.json``    — headline wrapper ``{n, cmd, rc, tail, parsed}``
+  - ``MULTICHIP_rNN.json``— mesh dryrun ``{n_devices, rc, ok, skipped, tail}``
+  - ``SERVING_rNN.json``  — nested numeric tree (allocator/assembly/host_path/
+                            end_to_end, later slo/kv_capacity/disagg)
+  - ``COLL_r11.json`` / ``FLEET_r13.json`` — worst-of-three paired-step extras
+  - ``COMPILE_r09.json`` / ``ELASTIC_r08.json`` — 3x paired-step run lists
+  - ``MOE_r15.json``      — smoke verdict + loss curve
+
+This module turns each family into schema-v1 rows **losslessly for every
+numeric leaf** (strings/bools/nulls are verdicts or provenance, not
+measurements; ``rc`` is an exit code): the metric name is the
+slash-joined path to the leaf, so a value in the ledger can always be
+found again in the original artifact. Originals stay in place — the
+ledger is derived state, the artifact is the evidence.
+
+Migration is idempotent (append only rows whose identity is not in the
+ledger yet) and ``check()`` verifies the committed ledger still contains
+every row a fresh migration would produce — the nightly's migrate-check
+stage fails if an artifact and the ledger drift apart.
+
+The generic tree flattener + direction/unit heuristics here are also the
+live-emission path for ``tools/bench_serving.py`` (same tree in, same
+rows out — a serving number migrated from r12 and one emitted at r16 are
+directly comparable).
+
+All legacy rounds ran on the CPU container, so every migrated row is
+stamped ``backend=cpu``; ``time_unix`` is fixed at 0.0 (file mtimes are
+checkout-volatile and would break idempotence), ``run_id`` is ``legacy``.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.telemetry.perfledger import (
+    PerfLedger, SCHEMA_VERSION, row_identity, validate_row,
+)
+
+LEGACY_RUN_ID = "legacy"
+LEGACY_BACKEND = "cpu"
+
+# numeric leaves under these keys are exit codes / dup round counters,
+# not measurements
+_SKIP_KEYS = frozenset({"rc", "n"})
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+
+# --------------------------------------------------------------- heuristics
+# ordered: the first higher-marker match wins before any lower-marker is
+# consulted (e.g. "tpot_p99_improvement" is higher-better despite "p99")
+_HIGHER_MARKERS = (
+    "per_sec", "speedup", "goodput", "gbps", "tokens_per_sec", "mfu",
+    "capacity_gain", "improvement", "slo_met", "hit_rate", "learns",
+)
+_LOWER_MARKERS = (
+    "_ms", "_us", "ms_per", "us_per", "latency", "wait", "overhead",
+    "failures", "shed", "preempt", "missed", "_err", "syncs_per",
+    "programs_per", "queue", "loss", "_pct", "bytes_per_token", "stall",
+)
+
+
+def direction_for(metric: str) -> str:
+    m = metric.lower()
+    if any(h in m for h in _HIGHER_MARKERS):
+        return "higher"
+    if any(lo in m for lo in _LOWER_MARKERS) or m.endswith(("_ms", "_us", "_s")):
+        return "lower"
+    return "higher"
+
+
+def unit_for(metric: str) -> str:
+    m = metric.lower()
+    if "tokens_per_sec" in m:
+        return "tokens/s"
+    if "per_sec" in m:
+        return "1/s"
+    if "gbps" in m:
+        return "GB/s"
+    if "_pct" in m or m.endswith("pct"):
+        return "%"
+    if any(x in m for x in ("speedup", "ratio", "gain", "vs_baseline",
+                            "improvement", "rel_err")):
+        return "ratio"
+    if "_ms" in m or m.endswith("_ms"):
+        return "ms"
+    if "_us" in m or m.endswith("_us"):
+        return "us"
+    if "bytes" in m:
+        return "bytes"
+    if "flops" in m:
+        return "flops"
+    if "goodput" in m or "hit_rate" in m:
+        return "fraction"
+    if m.endswith(("_s", "wall_s")):
+        return "s"
+    if "loss" in m:
+        return "nats"
+    return "count"
+
+
+def method_for_metric(metric: str, default: str = "single") -> str:
+    """Percentile rows carry their percentile as the method stamp."""
+    tail = metric.rsplit("/", 1)[-1]
+    if tail in ("p50", "p95", "p99"):
+        return tail
+    return default
+
+
+def flatten_numeric(obj: Any, prefix: str = "") -> List[Tuple[str, float]]:
+    """Every numeric leaf of a JSON tree as (slash-path, value). Bools,
+    strings and nulls are skipped (verdicts/provenance); list elements are
+    indexed path segments so e.g. a loss curve stays ordered."""
+    out: List[Tuple[str, float]] = []
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if k in _SKIP_KEYS and not prefix:
+                continue
+            out.extend(flatten_numeric(v, f"{prefix}/{k}" if prefix else str(k)))
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            out.extend(flatten_numeric(v, f"{prefix}/{i}" if prefix else str(i)))
+    elif isinstance(obj, bool) or obj is None:
+        pass
+    elif isinstance(obj, (int, float)):
+        out.append((prefix, float(obj)))
+    return out
+
+
+def rows_from_tree(suite: str, payload: Dict[str, Any], *, round: int,
+                   backend: str = LEGACY_BACKEND, run_id: str = LEGACY_RUN_ID,
+                   git_sha: str = "", method: str = "single",
+                   samples: int = 1, time_unix: float = 0.0,
+                   ) -> List[Dict[str, Any]]:
+    """Generic tree -> rows: the shared path for migration AND live serving
+    emission. Percentile leaves override ``method``; everything else takes
+    the family default."""
+    rows = []
+    for metric, value in flatten_numeric(payload):
+        rows.append(validate_row({
+            "schema": SCHEMA_VERSION, "run_id": run_id, "git_sha": git_sha,
+            "round": int(round), "backend": backend, "suite": suite,
+            "metric": metric, "value": value, "unit": unit_for(metric),
+            "direction": direction_for(metric),
+            "method": method_for_metric(metric, method),
+            "samples": int(samples), "time_unix": float(time_unix),
+        }))
+    return rows
+
+
+# ----------------------------------------------------------------- families
+def _rows_bench(payload: Dict[str, Any], round: int) -> List[Dict[str, Any]]:
+    """BENCH wrapper: only ``parsed`` holds measurements — the headline
+    metric under its own name plus its vs_baseline ratio."""
+    parsed = payload.get("parsed") or {}
+    if "metric" not in parsed:
+        return []
+    rows = [{
+        "schema": SCHEMA_VERSION, "run_id": LEGACY_RUN_ID, "git_sha": "",
+        "round": round, "backend": LEGACY_BACKEND, "suite": "bench",
+        "metric": str(parsed["metric"]), "value": float(parsed["value"]),
+        "unit": str(parsed.get("unit", "tokens/s")), "direction": "higher",
+        "method": "single", "samples": 1, "time_unix": 0.0,
+    }]
+    if "vs_baseline" in parsed:
+        rows.append({
+            "schema": SCHEMA_VERSION, "run_id": LEGACY_RUN_ID, "git_sha": "",
+            "round": round, "backend": LEGACY_BACKEND, "suite": "bench",
+            "metric": f"{parsed['metric']}/vs_baseline",
+            "value": float(parsed["vs_baseline"]), "unit": "ratio",
+            "direction": "higher", "method": "single", "samples": 1,
+            "time_unix": 0.0,
+        })
+    return [validate_row(r) for r in rows]
+
+
+def _family_samples(payload: Dict[str, Any]) -> int:
+    runs = payload.get("runs")
+    return len(runs) if isinstance(runs, list) and runs else 1
+
+
+def _make_tree_loader(suite: str, method: str) -> Callable:
+    def load(payload: Dict[str, Any], round: int) -> List[Dict[str, Any]]:
+        samples = _family_samples(payload)
+        rows = rows_from_tree(suite, payload, round=round, method=method,
+                              samples=samples)
+        # per-run sub-rows are individual observations, not aggregates
+        for r in rows:
+            if r["metric"].startswith("runs/"):
+                r["samples"] = 1
+        return rows
+    return load
+
+
+def _policy_method(payload: Dict[str, Any], default: str) -> str:
+    policy = str(payload.get("policy", ""))
+    return policy.replace("_", "-") if policy else default
+
+
+def _rows_policy_family(suite: str):
+    """COLL/FLEET extras carry their discipline in a ``policy`` field
+    (``worst_of_three``) — that, not a family constant, is the method."""
+    def load(payload: Dict[str, Any], round: int) -> List[Dict[str, Any]]:
+        method = _policy_method(payload, "paired")
+        return _make_tree_loader(suite, method)(payload, round)
+    return load
+
+
+#: (glob, suite, loader(payload, round) -> rows) — the closed list of
+#: legacy families; later native-ledger artifacts (PERF_r16+) never
+#: migrate, they emit rows directly.
+FAMILIES: List[Tuple[str, str, Callable]] = [
+    ("BENCH_r*.json", "bench", _rows_bench),
+    ("MULTICHIP_r*.json", "multichip", _make_tree_loader("multichip", "single")),
+    ("SERVING_r*.json", "serving", _make_tree_loader("serving", "single")),
+    ("COLL_r*.json", "coll", _rows_policy_family("coll")),
+    ("FLEET_r*.json", "fleet", _rows_policy_family("fleet")),
+    ("COMPILE_r*.json", "compile", _make_tree_loader("compile", "paired")),
+    ("ELASTIC_r*.json", "elastic", _make_tree_loader("elastic", "paired")),
+    ("MOE_r*.json", "moe", _make_tree_loader("moe", "single")),
+]
+
+
+def round_from_filename(name: str) -> Optional[int]:
+    m = _ROUND_RE.search(name)
+    return int(m.group(1)) if m else None
+
+
+def legacy_rows(repo_root: str) -> List[Dict[str, Any]]:
+    """All schema-v1 rows a fresh migration of ``repo_root``'s legacy
+    artifacts produces, deterministically ordered."""
+    rows: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(repo_root))
+    except OSError:
+        return rows
+    for glob, _suite, loader in FAMILIES:
+        for name in names:
+            if not fnmatch.fnmatch(name, glob):
+                continue
+            rnd = round_from_filename(name)
+            if rnd is None:
+                continue
+            with open(os.path.join(repo_root, name), encoding="utf-8") as f:
+                payload = json.load(f)
+            rows.extend(loader(payload, rnd))
+    return rows
+
+
+def migrate(repo_root: str, ledger: PerfLedger) -> Dict[str, int]:
+    """Idempotent: append only rows not already in the ledger (by
+    measurement identity). Returns ``{"found": N, "appended": M}``."""
+    fresh = legacy_rows(repo_root)
+    have = ledger.identities()
+    new = [r for r in fresh if row_identity(r) not in have]
+    ledger.append(new)
+    return {"found": len(fresh), "appended": len(new)}
+
+
+def check(repo_root: str, ledger: PerfLedger) -> List[Dict[str, Any]]:
+    """Rows a fresh migration would produce that the ledger is missing
+    (subset check — live rows appended since migration are fine). Empty
+    list == the committed ledger still covers every legacy artifact."""
+    have = ledger.identities()
+    return [r for r in legacy_rows(repo_root) if row_identity(r) not in have]

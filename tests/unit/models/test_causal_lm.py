@@ -5,6 +5,7 @@ import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models import PRESETS, TransformerConfig, causal_lm_spec
+from tests.unit.parallel.partial_manual import partial_manual_xfail
 
 
 def _tokens(bs, seq, vocab=256, seed=0):
@@ -53,6 +54,7 @@ def test_gpt2_style_trains(devices):
     assert losses[-1] < losses[0]
 
 
+@partial_manual_xfail
 def test_tp_matches_pure_dp(devices):
     """tp=2 must reproduce the dp-only loss trajectory (same seed/data).
 

@@ -9,6 +9,7 @@ import deepspeed_tpu
 from deepspeed_tpu.config import MeshConfig
 from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
 from deepspeed_tpu.topology import build_mesh, mesh_context
+from tests.unit.parallel.partial_manual import partial_manual_xfail
 
 
 def _tokens(bs, seq, vocab=256, seed=0):
@@ -95,6 +96,7 @@ class TestMoE:
         losses = [float(engine.train_batch(batch)["loss"]) for _ in range(6)]
         assert losses[-1] < losses[0]
 
+    @partial_manual_xfail
     def test_expert_parallel_matches_dense_ep(self, devices):
         """ep=4 sharded experts must reproduce the ep=1 trajectory."""
         e1, *_ = deepspeed_tpu.initialize(
@@ -216,6 +218,7 @@ def test_pyramid_moe_per_layer_experts(devices):
                     "steps_per_print": 1000})
 
 
+@partial_manual_xfail
 def test_alibi_model_under_sp_matches_dp(devices):
     """Bloom-style ALiBi + Ulysses sequence parallelism: the sharding-
     constraint form keeps the program global SPMD, so the per-head slope

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import deepspeed_tpu
 from deepspeed_tpu.comm.comm import comms_logger
 from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+from tests.unit.parallel.partial_manual import partial_manual_xfail
 
 
 def _cfg(stage=2, **zero_extra):
@@ -210,6 +211,7 @@ def test_loco_requires_qg():
             config=_cfg(stage=2, loco_param={"err_beta": 0.8}))
 
 
+@partial_manual_xfail
 def test_zpp_composes_with_ulysses_sp(devices):
     """Ulysses sharding constraints inside the ZeRO++ manual micro fn must
     name only non-manual axes (round-5 dryrun D caught the violation)."""

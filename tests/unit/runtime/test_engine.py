@@ -9,6 +9,7 @@ import pytest
 
 import deepspeed_tpu
 from tests.unit.simple_model import make_dataset, random_batch, simple_model_spec
+from tests.unit.parallel.partial_manual import partial_manual_xfail
 
 
 def _config(stage=0, dtype="fp32", mesh=None, gas=1, micro=2, extra=None):
@@ -49,7 +50,8 @@ def test_engine_trains_and_loss_decreases(devices):
     assert engine.global_steps == 10
 
 
-@pytest.mark.parametrize("stage", [1, 2, 3])
+@pytest.mark.parametrize(
+    "stage", [1, 2, pytest.param(3, marks=partial_manual_xfail)])
 def test_zero_stage_matches_stage0(devices, stage):
     """Same data + seed: sharded stages must track the unsharded trajectory."""
     mesh = {"dp": 2, "fsdp": 4} if stage == 3 else None

@@ -11,6 +11,7 @@ import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+from tests.unit.parallel.partial_manual import partial_manual_xfail
 
 TC = TransformerConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
                        num_layers=2, num_heads=4, max_seq_len=32)
@@ -63,6 +64,7 @@ def test_hpz_trajectory_matches_stage3(devices):
     np.testing.assert_allclose(runs["hpz"], runs["plain"], rtol=2e-4)
 
 
+@partial_manual_xfail
 def test_hpz_gathers_ride_small_axis(devices):
     """Comm-volume evidence: the compiled hpZ step's all-gathers are
     predominantly over 2-device (intra-node) groups; the plain stage-3 step

@@ -16,6 +16,7 @@ from deepspeed_tpu.runtime.sparse_grad import (
     sparse_grad_comm_volume,
 )
 from deepspeed_tpu.topology.mesh import build_mesh
+from tests.unit.parallel.partial_manual import partial_manual_xfail
 
 V, H = 64, 16
 
@@ -157,6 +158,7 @@ def test_engine_sparse_gradients_trajectory(devices):
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
 
 
+@partial_manual_xfail
 def test_sparse_gradients_compose_with_zeropp(devices):
     """Sparse embedding grads inside the ZeRO++ manual-shard_map micro fn:
     the backward detects the bound axes and gathers directly (no nested
@@ -190,6 +192,7 @@ def test_sparse_gradients_compose_with_zeropp(devices):
     np.testing.assert_allclose(run(True), run(False), rtol=0.05)
 
 
+@partial_manual_xfail
 def test_sparse_lookup_grad_scale_inside_manual_shard_map(devices):
     """Inside a manual shard_map (the ZeRO++/1-bit micro-fn convention:
     per-rank grads that a downstream pmean averages), the sparse backward

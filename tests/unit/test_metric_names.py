@@ -34,6 +34,7 @@ ALLOWED_SUBSYSTEMS = {
     "health",
     "mem",
     "moe",
+    "perf",
     "program",
     "recompile",
     "router",
@@ -114,7 +115,13 @@ def test_lint_scans_telemetry_and_serving_sources():
         for f in ("tracer.py", "registry.py", "exposition.py",
                   # fleet telemetry plane (ISSUE 13): the federation layer
                   # mints the fleet/* rollup series
-                  "fleet.py", "collector.py")
+                  "fleet.py", "collector.py",
+                  # perf observatory (ISSUE 16): the gate mints the
+                  # perf/trajectory + perf/regression_events series
+                  "perfgate.py")
+    } | {
+        # step-time attribution gauges (ISSUE 16)
+        os.path.join("deepspeed_tpu", "profiling", "attribution.py"),
     } | {
         os.path.join("deepspeed_tpu", "inference", f)
         for f in ("engine_v2.py", "lifecycle.py", "router.py",
@@ -150,7 +157,13 @@ def test_known_names_pass_and_bad_names_fail():
                  # to the PR-7 dispatch-health family; the all-to-all hop
                  # timings ride the existing coll/* histograms
                  "moe/capacity_factor_applied", "moe/capacity_factor_target",
-                 "moe/token_drop_rate", "coll/hop_ms", "coll/achieved_gbps"):
+                 "moe/token_drop_rate", "coll/hop_ms", "coll/achieved_gbps",
+                 # perf observatory (ISSUE 16): gate trajectory/regression
+                 # series and the step-time attribution gauges
+                 "perf/trajectory", "perf/regression_events",
+                 "perf/attribution_wall_ms", "perf/attribution_compute_ms",
+                 "perf/attribution_stall_ms", "perf/attribution_bound",
+                 "perf/roofline_flops_fraction", "perf/roofline_bw_fraction"):
         assert _check_name(good) is None, good
     for bad in ("ttft", "Serving/ttft", "serving ttft", "{x}/y", "bogus/name"):
         assert _check_name(bad) is not None, bad

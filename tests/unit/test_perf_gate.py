@@ -1,0 +1,169 @@
+"""Noise-aware perf gate: fires on real regressions, stays quiet on the
+repo's own (genuinely noisy) historical ledger, never mixes backends.
+
+The two load-bearing properties, per ISSUE 16's acceptance bar:
+
+  - the gate FAILS (regression verdicts + counter + armed profiler) on a
+    synthetic 30% degradation of the current numbers, and
+  - the gate PASSES on the committed history as-is — the historical
+    round-to-round noise (serving telemetry overhead wandered 12→28%)
+    must not produce false alarms.
+"""
+
+import os
+
+import pytest
+
+from deepspeed_tpu.profiling.capture import ProfilerCapture
+from deepspeed_tpu.telemetry import perfmigrate
+from deepspeed_tpu.telemetry.perfgate import (
+    GateConfig,
+    gate_fresh,
+    gate_row,
+    inject_regression,
+    is_headline,
+    publish,
+    self_check,
+)
+from deepspeed_tpu.telemetry.perfledger import PerfLedger, make_row
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _row(value, *, metric="tokens_per_sec_probe", suite="bench",
+         backend="cpu", round=0, direction="higher"):
+    return make_row(suite, metric, value, "tokens/s", direction=direction,
+                    backend=backend, round=round, run_id="test",
+                    git_sha="", time_unix=0.0)
+
+
+@pytest.fixture()
+def seeded(tmp_path):
+    """Ledger with 5 rounds of quorum history for one headline key."""
+    led = PerfLedger(str(tmp_path))
+    for rnd, v in enumerate((100.0, 102.0, 98.0, 101.0, 99.0), start=1):
+        led.append([_row(v, round=rnd)])
+    return led
+
+
+# ------------------------------------------------------------------- modes
+def test_mad_gate_fires_on_injected_30pct(seeded):
+    fresh = [_row(100.0, round=6)]
+    assert gate_fresh(fresh, seeded).ok
+
+    degraded = inject_regression(fresh, 30.0)
+    assert degraded[0]["value"] == pytest.approx(70.0)
+    report = gate_fresh(degraded, seeded)
+    assert not report.ok
+    (v,) = report.regressions
+    assert v.mode == "mad"
+    assert "REGRESSION" in report.summary()
+
+
+def test_mad_gate_lower_is_better(tmp_path):
+    led = PerfLedger(str(tmp_path))
+    for rnd, v in enumerate((9.9, 10.0, 10.1), start=1):
+        led.append([_row(v, metric="host_path/chained/host_us_per_decode_token",
+                         suite="serving", round=rnd, direction="lower")])
+    fresh = [_row(10.0, metric="host_path/chained/host_us_per_decode_token",
+                  suite="serving", round=4, direction="lower")]
+    assert gate_fresh(fresh, led).ok
+    report = gate_fresh(inject_regression(fresh, 30.0), led)
+    assert not report.ok
+    assert report.regressions[0].row["value"] == pytest.approx(13.0)
+
+
+def test_rel_fallback_below_quorum(tmp_path):
+    led = PerfLedger(str(tmp_path))
+    led.append([_row(100.0, round=1), _row(100.0, round=2)])
+    ok = gate_fresh([_row(80.0, round=3)], led)  # -20% < 30% bound
+    assert ok.ok and ok.verdicts[0].mode == "rel"
+    bad = gate_fresh([_row(65.0, round=3)], led)  # -35% > 30% bound
+    assert not bad.ok and bad.regressions[0].mode == "rel"
+
+
+def test_absolute_overhead_bound_needs_no_history(tmp_path):
+    led = PerfLedger(str(tmp_path))
+    ok = gate_fresh([_row(1.9, metric="telemetry_overhead_pct", suite="perf",
+                          direction="lower")], led)
+    assert ok.ok and ok.verdicts[0].mode == "absolute"
+    bad = gate_fresh([_row(2.5, metric="telemetry_overhead_pct", suite="perf",
+                           direction="lower")], led)
+    assert not bad.ok and bad.regressions[0].mode == "absolute"
+
+
+def test_non_headline_rows_are_trajectory_only(seeded):
+    # a 10x crash in a sub-metric never fails the build under the default
+    # policy — but policy="all" gates it
+    sub = [_row(1.0, metric="probes/some_sub_metric", round=6)]
+    report = gate_fresh(sub, seeded)
+    assert report.ok and report.verdicts[0].mode == "info"
+    assert not is_headline(sub[0], GateConfig())
+
+
+def test_vs_baseline_rows_excluded_from_headline():
+    row = _row(0.5, metric="tokens_per_sec_probe/vs_baseline")
+    assert not is_headline(row, GateConfig())
+
+
+def test_backend_isolation(seeded):
+    """5 rounds of cpu history must NOT gate (or vouch for) a tpu row."""
+    tpu = [_row(1.0, backend="tpu-v5e", round=6)]  # 99% below cpu median
+    report = gate_fresh(tpu, seeded)
+    assert report.ok
+    assert report.verdicts[0].status == "no_history"
+    # and gate_row enforces it defensively even if handed foreign history
+    v = gate_row(tpu[0], seeded.rows(), GateConfig())
+    assert v.status == "no_history"
+
+
+def test_round0_rows_compare_against_everything(seeded):
+    report = gate_fresh(inject_regression([_row(100.0, round=0)], 30.0), seeded)
+    assert not report.ok
+
+
+def test_versioned_round_ignores_same_round_history(seeded):
+    """A round-6 row must not be averaged with other round-6 rows (a bad
+    round would vouch for itself)."""
+    seeded.append([_row(70.0, round=6)])
+    report = gate_fresh([_row(70.0, round=6)], seeded)
+    assert not report.ok  # still judged against rounds 1-5 only
+
+
+# ------------------------------------------------------- publish side-effects
+def test_publish_counter_gauge_and_profiler_arm(seeded, tmp_path):
+    reg = MetricsRegistry()
+    cap = ProfilerCapture(steps=1, out_dir=str(tmp_path / "prof"))
+    report = gate_fresh(inject_regression([_row(100.0, round=6)], 30.0), seeded)
+    out = publish(report, registry=reg, arm=True)
+    assert out["regressions"] == 1
+    assert out["captures_armed"] >= 1
+    assert cap._armed_reason.startswith("perf_gate:")
+    assert reg.counter("perf/regression_events", suite="bench",
+                       metric="tokens_per_sec_probe", backend="cpu").value == 1
+    assert reg.gauge("perf/trajectory", suite="bench",
+                     metric="tokens_per_sec_probe",
+                     backend="cpu").value == pytest.approx(70.0)
+
+
+def test_publish_ok_report_arms_nothing(seeded, tmp_path):
+    reg = MetricsRegistry()
+    cap = ProfilerCapture(steps=1, out_dir=str(tmp_path / "prof"))
+    out = publish(gate_fresh([_row(100.0, round=6)], seeded), registry=reg)
+    assert out == {"regressions": 0, "captures_armed": 0}
+    assert cap._armed_reason is None
+    assert reg.counters() == {}
+
+
+# ------------------------------------------------------- the real ledger
+def test_quiet_on_real_historical_noise(tmp_path):
+    """self_check over the migrated legacy ledger: the committed history —
+    noise and all — produces ZERO regressions at HEAD."""
+    led = PerfLedger(str(tmp_path))
+    perfmigrate.migrate(REPO_ROOT, led)
+    report = self_check(led)
+    assert report.regressions == []
+    assert len(report.verdicts) > 200  # the whole ledger was walked
+    gated = [v for v in report.verdicts if v.mode != "info"]
+    assert gated  # and the headline/overhead rows really were gated

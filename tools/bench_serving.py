@@ -953,6 +953,31 @@ def router_smoke(replicas=2) -> Dict:
     return out
 
 
+def _emit_perf_ledger(payload: dict) -> None:
+    """Append this run's numeric tree to the unified perf ledger, suite
+    ``serving`` (ISSUE 16) — the SAME flattener migration uses on the
+    legacy SERVING_rNN artifacts, so a number emitted today and one
+    migrated from r12 are directly comparable rows. Best-effort: the bench
+    must never fail because the ledger dir is unwritable."""
+    try:
+        import time as _time
+
+        from deepspeed_tpu.telemetry.fleet import get_identity
+        from deepspeed_tpu.telemetry.perfledger import (
+            PerfLedger, default_backend, default_round, resolve_git_sha,
+        )
+        from deepspeed_tpu.telemetry.perfmigrate import rows_from_tree
+
+        rows = rows_from_tree(
+            "serving", payload, round=default_round(),
+            backend=default_backend(), run_id=get_identity().run_id,
+            git_sha=resolve_git_sha(), time_unix=_time.time())
+        PerfLedger().append(rows)
+    except Exception as e:  # noqa: BLE001 — evidence plane, not the bench
+        print(f"[bench_serving] perf-ledger append skipped: {e}",
+              file=sys.stderr)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rows", type=int, default=8)
@@ -1011,6 +1036,7 @@ def main() -> None:
         if args.output:
             with open(args.output, "w") as f:
                 f.write(text + "\n")
+        _emit_perf_ledger(res)
         sys.exit(0)
 
     if args.router_smoke:
@@ -1051,6 +1077,7 @@ def main() -> None:
     if args.output:
         with open(args.output, "w") as f:
             f.write(text + "\n")
+    _emit_perf_ledger(out)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Noise-aware perf regression gate (telemetry/perfgate.py).
+
+Modes:
+
+  (default)        self-check: gate the latest round of every
+                   (backend, suite, metric) key in the committed ledger
+                   against its own strictly-older history — the nightly's
+                   HEAD-must-pass stage
+  --fresh FILE     gate a fresh run's rows (JSON list or JSONL of schema-v1
+                   rows) against the full ledger history
+  --inject-pct P   degrade the self-check's fresh rows by P% in each row's
+                   bad direction before gating — proves the sentinel FIRES
+                   (the nightly runs this with an inverted exit check)
+
+Exit 0 iff no regression. A regression also increments the
+``perf/regression_events`` counter, publishes ``perf/trajectory`` gauges,
+and arms every live profiler capture (``--no-arm`` to skip), so a nightly
+regression leaves a profiler trace.
+
+Gate policy (see perfgate.py): ``*overhead_pct`` rows gate on the repo's
+absolute <2% bound; per-suite headline metrics gate on median+MAD (quorum
+>=3) with a 30% relative fallback below quorum; everything else is
+trajectory-only. Backends never mix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_rows(path: str):
+    with open(path, encoding="utf-8") as f:
+        text = f.read().strip()
+    if text.startswith("["):
+        return json.loads(text)
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+def main(argv=None) -> int:
+    from deepspeed_tpu.telemetry import perfgate
+    from deepspeed_tpu.telemetry.perfledger import PerfLedger, row_key
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ledger", default=None,
+                    help="ledger dir (default: <repo>/perf/ledger)")
+    ap.add_argument("--fresh", default=None,
+                    help="JSON/JSONL file of fresh schema-v1 rows to gate")
+    ap.add_argument("--inject-pct", type=float, default=None,
+                    help="synthetically degrade fresh rows by this %% "
+                         "(sentinel demonstration; expected to FAIL)")
+    ap.add_argument("--policy", choices=["headline", "all"], default="headline")
+    ap.add_argument("--mads", type=float, default=6.0)
+    ap.add_argument("--quorum", type=int, default=3)
+    ap.add_argument("--rel-bound", type=float, default=0.30)
+    ap.add_argument("--overhead-bound", type=float, default=2.0)
+    ap.add_argument("--no-arm", action="store_true",
+                    help="do not arm profiler captures on regression")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON summary line")
+    args = ap.parse_args(argv)
+
+    cfg = perfgate.GateConfig(
+        mads=args.mads, quorum=args.quorum, rel_bound=args.rel_bound,
+        overhead_bound_pct=args.overhead_bound, policy=args.policy)
+    ledger = PerfLedger(args.ledger)
+
+    if args.fresh:
+        rows = _load_rows(args.fresh)
+        if args.inject_pct:
+            rows = perfgate.inject_regression(rows, args.inject_pct)
+        report = perfgate.gate_fresh(rows, ledger, cfg)
+    elif args.inject_pct:
+        # self-check's fresh rows, degraded, re-gated as a next round
+        by_key = {}
+        for r in ledger.rows():
+            by_key.setdefault(row_key(r), []).append(r)
+        fresh = []
+        for rows_ in by_key.values():
+            latest = max(int(r["round"]) for r in rows_)
+            fresh += [dict(r, round=latest + 1) for r in rows_
+                      if int(r["round"]) == latest]
+        report = perfgate.gate_fresh(
+            perfgate.inject_regression(fresh, args.inject_pct), ledger, cfg)
+    else:
+        report = perfgate.self_check(ledger, cfg)
+
+    pub = perfgate.publish(report, arm=not args.no_arm)
+    if args.json:
+        print(json.dumps({
+            "rows": len(report.verdicts),
+            "gated": sum(1 for v in report.verdicts if v.mode != "info"),
+            "regressions": pub["regressions"],
+            "captures_armed": pub["captures_armed"],
+            "ok": report.ok,
+        }, sort_keys=True))
+    else:
+        print(report.summary())
+        if report.regressions:
+            print(f"perf_gate: armed {pub['captures_armed']} profiler "
+                  f"capture(s)")
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Unified perf ledger CLI (telemetry/perfledger.py + perfmigrate.py).
+
+  migrate          append every legacy ``*_rNN.json`` family into
+                   ``perf/ledger/*.jsonl`` (idempotent — re-running appends
+                   nothing; originals stay in place as the evidence)
+  migrate --check  verify the committed ledger still contains every row a
+                   fresh migration would produce (subset check: rows
+                   appended live since migration are fine) — nonzero on
+                   drift; the nightly's migrate-check stage
+  list             per-suite row/key census of the ledger
+  show             print rows (optionally one --suite / --metric) as JSONL
+
+The ledger root defaults to ``<repo>/perf/ledger`` (override with
+``--ledger`` or ``$DSTPU_PERF_LEDGER_DIR``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    from deepspeed_tpu.telemetry import perfmigrate
+    from deepspeed_tpu.telemetry.perfledger import PerfLedger, row_key
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("cmd", choices=["migrate", "list", "show"])
+    ap.add_argument("--ledger", default=None,
+                    help="ledger dir (default: <repo>/perf/ledger)")
+    ap.add_argument("--repo", default=REPO,
+                    help="root holding the legacy *_rNN.json artifacts")
+    ap.add_argument("--check", action="store_true",
+                    help="migrate: verify-only, nonzero if the ledger is "
+                         "missing any freshly-migratable row")
+    ap.add_argument("--suite", default=None)
+    ap.add_argument("--metric", default=None)
+    args = ap.parse_args(argv)
+
+    ledger = PerfLedger(args.ledger)
+
+    if args.cmd == "migrate":
+        if args.check:
+            missing = perfmigrate.check(args.repo, ledger)
+            if missing:
+                print(f"perf_ledger check: FAIL — {len(missing)} legacy "
+                      f"row(s) missing from {ledger.root}")
+                for r in missing[:10]:
+                    print(f"  missing: [{r['backend']}] {r['suite']}/"
+                          f"{r['metric']} r{r['round']} = {r['value']}")
+                return 1
+            print(f"perf_ledger check: OK — ledger at {ledger.root} covers "
+                  f"all legacy artifacts")
+            return 0
+        stats = perfmigrate.migrate(args.repo, ledger)
+        print(f"perf_ledger migrate: {stats['found']} legacy rows found, "
+              f"{stats['appended']} appended -> {ledger.root}")
+        return 0
+
+    if args.cmd == "list":
+        rows = ledger.rows()
+        by_suite = {}
+        for r in rows:
+            s = by_suite.setdefault(r["suite"], {"rows": 0, "keys": set(),
+                                                 "rounds": set()})
+            s["rows"] += 1
+            s["keys"].add(row_key(r))
+            s["rounds"].add(int(r["round"]))
+        print(f"# ledger {ledger.root}: {len(rows)} rows, "
+              f"{len(by_suite)} suites")
+        for suite in sorted(by_suite):
+            s = by_suite[suite]
+            rounds = sorted(s["rounds"])
+            print(f"  {suite:<10} rows={s['rows']:<5} keys={len(s['keys']):<4}"
+                  f" rounds={rounds[0]}..{rounds[-1]}")
+        return 0
+
+    # show
+    for r in ledger.rows(args.suite):
+        if args.metric and r["metric"] != args.metric:
+            continue
+        print(json.dumps(r, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

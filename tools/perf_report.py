@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Perf report renderer: ledger -> round tables + trajectory curves.
+
+  (default)            print the markdown report for the committed ledger
+  --update-perf-md F   regenerate the marker-delimited generated section
+                       inside PERF.md (everything between the BEGIN/END
+                       markers is owned by this tool; the hand-written
+                       narrative above them is not touched)
+  --smoke              step-time attribution smoke on the CPU bench config:
+                       builds the tiny bench engine, measures one steady
+                       step, decomposes it via profiling/attribution.py and
+                       exit-gates on the four buckets summing exactly to
+                       the measured wall (the decomposition's contract) —
+                       the nightly's attribution stage
+
+The report body is selective on purpose: the table shows the gate's own
+rows (headline metrics + overhead bounds per round); sparklines show every
+headline key with >=2 rounds of history. The full 460+-row ledger stays
+queryable via ``tools/perf_ledger.py show``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BEGIN_MARK = "<!-- BEGIN GENERATED: perf_report (tools/perf_report.py) -->"
+END_MARK = "<!-- END GENERATED: perf_report -->"
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[float]) -> str:
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return _SPARK[0] * len(values)
+    return "".join(_SPARK[int((v - lo) / (hi - lo) * (len(_SPARK) - 1))]
+                   for v in values)
+
+
+def _table_rows(ledger) -> List[Tuple]:
+    from deepspeed_tpu.telemetry.perfgate import (
+        GateConfig, is_headline, is_overhead_metric,
+    )
+
+    cfg = GateConfig()
+    out = []
+    for r in ledger.rows():
+        if is_headline(r, cfg) or is_overhead_metric(r["metric"]):
+            out.append((r["suite"], r["metric"], int(r["round"]),
+                        r["backend"], float(r["value"]), r["unit"],
+                        r["method"]))
+    return sorted(out)
+
+
+def render_report(ledger) -> str:
+    from deepspeed_tpu.telemetry.perfledger import row_key
+
+    lines = ["## Perf ledger round table", "",
+             f"{len(ledger.rows())} rows in `perf/ledger/` "
+             f"({', '.join(ledger.suites())}). Gate-relevant rows "
+             "(headline metrics and overhead bounds):", "",
+             "| suite | metric | round | backend | value | unit | method |",
+             "|---|---|---|---|---|---|---|"]
+    for suite, metric, rnd, backend, value, unit, method in _table_rows(ledger):
+        lines.append(f"| {suite} | `{metric}` | r{rnd:02d} | {backend} "
+                     f"| {value:g} | {unit} | {method} |")
+
+    # trajectories: headline keys with history
+    by_key: Dict[Tuple[str, str, str], List[Tuple[int, float]]] = {}
+    for suite, metric, rnd, backend, value, _unit, _m in _table_rows(ledger):
+        by_key.setdefault((backend, suite, metric), []).append((rnd, value))
+    lines += ["", "### Trajectories", ""]
+    curves = 0
+    for (backend, suite, metric), pts in sorted(by_key.items()):
+        pts = sorted(pts)
+        if len(pts) < 2:
+            continue
+        vals = [v for _, v in pts]
+        rounds = [r for r, _ in pts]
+        lines.append(f"- `{suite}/{metric}` [{backend}] "
+                     f"r{rounds[0]:02d}→r{rounds[-1]:02d}: "
+                     f"{sparkline(vals)}  ({vals[0]:g} → {vals[-1]:g})")
+        curves += 1
+    if not curves:
+        lines.append("- (no key has multi-round history yet)")
+    return "\n".join(lines) + "\n"
+
+
+def update_perf_md(path: str, body: str) -> bool:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    block = f"{BEGIN_MARK}\n\n{body}\n{END_MARK}"
+    if BEGIN_MARK in text and END_MARK in text:
+        head, rest = text.split(BEGIN_MARK, 1)
+        _, tail = rest.split(END_MARK, 1)
+        new = head + block + tail
+    else:
+        new = text.rstrip() + "\n\n" + block + "\n"
+    if new == text:
+        return False
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(new)
+    return True
+
+
+def attribution_smoke() -> int:
+    """Exit-gated attribution on the CPU bench config: buckets must sum
+    exactly to the measured wall, the compute bucket must be nonzero (the
+    program registry captured real flops), and the verdict must name a
+    bucket."""
+    import time
+
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+    from deepspeed_tpu.profiling.attribution import attribute_program
+
+    telemetry.configure(enabled=True)
+    cfg = TransformerConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, max_seq_len=256,
+    )
+    seq, micro = 256, 4
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+            "telemetry": {"enabled": True},
+        })
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    for _ in range(3):  # warm the compile cache off the clock
+        m = engine.train_batch(batch)
+    np.asarray(m["loss"])
+    t0 = time.perf_counter()
+    m = engine.train_batch(batch)
+    np.asarray(m["loss"])
+    wall_s = time.perf_counter() - t0
+
+    attr = attribute_program("train_step", wall_s)
+    print(attr.render())
+    print(json.dumps(attr.as_dict(), sort_keys=True))
+
+    bucket_sum = sum(attr.buckets().values())
+    ok = (abs(bucket_sum - attr.wall_ms) < 1e-6 * max(attr.wall_ms, 1.0)
+          and attr.compute_ms > 0.0
+          and all(v >= 0.0 for v in attr.buckets().values())
+          and attr.bound in ("compute", "memory", "comm", "host", "stall"))
+    print(f"attribution-smoke: {'OK' if ok else 'FAIL'} "
+          f"(buckets_sum={bucket_sum:.4f}ms wall={attr.wall_ms:.4f}ms "
+          f"bound={attr.bound})")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ledger", default=None,
+                    help="ledger dir (default: <repo>/perf/ledger)")
+    ap.add_argument("--update-perf-md", default=None, metavar="PERF_MD",
+                    help="rewrite the generated block inside this file")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the exit-gated attribution smoke instead")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return attribution_smoke()
+
+    from deepspeed_tpu.telemetry.perfledger import PerfLedger
+
+    ledger = PerfLedger(args.ledger)
+    body = render_report(ledger)
+    if args.update_perf_md:
+        changed = update_perf_md(args.update_perf_md, body)
+        print(f"perf_report: {args.update_perf_md} "
+              f"{'updated' if changed else 'unchanged'}")
+        return 0
+    print(body, end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

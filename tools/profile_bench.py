@@ -12,9 +12,17 @@ parameterized tool (formerly profile_bench.py + profile_bench{2,3,4}.py).
               matmul
   --stage 4   per-shape matmul sweep, flash-vs-xla attention fwd/bwd, and a
               jax.profiler trace attempt
+  --stage attn
+              tuned flash kernel vs xla reference: fwd + bwd latency and
+              max-abs-diff parity check (formerly profile_attn.py)
+  --stage attn-sweep
+              flash kernel block-size sweep chained inside ONE jitted program
+              via lax.scan so dispatch amortizes away; --grad times fwd+bwd
+              (formerly profile_attn_sweep.py)
   --stage all run every stage in order
 
-Usage: python tools/profile_bench.py [--stage 1|2|3|4|all]
+Usage: python tools/profile_bench.py [--stage 1|2|3|4|attn|attn-sweep|all]
+                                     [--batch B] [--seq S] [--grad]
 """
 
 from __future__ import annotations
@@ -372,17 +380,143 @@ def stage4():
         print(f"profiler trace FAILED: {type(e).__name__} {e}")
 
 
+# --------------------------------------------------------------- stage attn
+def stage_attn(batch=None, seq=None, grad=False):
+    """Tuned flash kernel vs the xla reference: fwd/bwd latency + parity."""
+    del grad  # attn always times both fwd and bwd
+    from deepspeed_tpu.ops.registry import dispatch
+
+    B, S, H, D = batch or 8, seq or 1024, 12, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D), jnp.bfloat16)
+    att_fl = 4 * B * H * S * S * D  # fwd flops (causal halves useful work)
+
+    outs = {}
+    for impl in ("pallas", "xla"):
+        f = dispatch("causal_attention", impl)
+        fn = jax.jit(lambda q, k, v, f=f: f(q, k, v))
+        r = fn(q, k, v)
+        outs[impl] = np.asarray(r, np.float32)
+        t = fetch_time(lambda: fn(q, k, v), lambda r: r[0, 0, 0, 0], n=10, warmup=3)
+        print(f"fwd {impl}: {t*1e3:.2f} ms ({att_fl/t/1e12:.1f} TF/s)")
+
+    err = np.abs(outs["pallas"] - outs["xla"]).max()
+    print(f"fwd max abs diff pallas vs xla: {err:.4f}")
+
+    grads = {}
+    for impl in ("pallas", "xla"):
+        f = dispatch("causal_attention", impl)
+
+        @jax.jit
+        def gfn(q, k, v, f=f):
+            def loss(q, k, v):
+                return f(q, k, v).astype(jnp.float32).sum()
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        r = gfn(q, k, v)
+        t = fetch_time(lambda: gfn(q, k, v), lambda r: r[0][0, 0, 0, 0], n=10, warmup=3)
+        print(f"bwd {impl}: {t*1e3:.2f} ms")
+        grads[impl] = [np.asarray(x, np.float32) for x in r]
+    for nm, a, b in zip("qkv", grads["pallas"], grads["xla"]):
+        print(f"d{nm} max abs diff: {np.abs(a-b).max():.4f} (scale {np.abs(b).max():.2f})")
+
+
+# --------------------------------------------------------- stage attn-sweep
+def stage_attn_sweep(batch=None, seq=None, grad=False):
+    """Sweep flash-attention block sizes inside ONE jitted program.
+
+    A lax.scan chains the kernel invocations with a data dependency (the
+    output feeds the next query), so per-program relay dispatch (~6 ms)
+    amortizes away and the measured time is the kernel itself.
+    """
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_causal_attention
+
+    def bench(fn, *args, iters=20):
+        # grad mode differentiates w.r.t. ALL of q/k/v and feeds every
+        # gradient back into the carry — otherwise the dkv kernel is dead
+        # code under jit and the sweep never times it.
+        inner = jax.grad(lambda q, k, v: (fn(q, k, v).astype(jnp.float32) ** 2).sum(),
+                         argnums=(0, 1, 2))
+
+        @jax.jit
+        def chained(q, k, v):
+            def body(carry, _):
+                q, k, v = carry
+                decay = jnp.asarray(0.999, q.dtype)
+                eps = jnp.asarray(1e-3, q.dtype)
+                if grad:
+                    dq, dk, dv = inner(q, k, v)
+                    new = (q * decay + dq.astype(q.dtype) * eps,
+                           k * decay + dk.astype(k.dtype) * eps,
+                           v * decay + dv.astype(v.dtype) * eps)
+                else:
+                    new = (fn(q, k, v) * eps + q * decay, k, v)
+                return new, ()
+
+            (q, k, v), _ = jax.lax.scan(body, (q, k, v), None, length=iters)
+            return q
+
+        r = chained(*args)
+        _ = np.asarray(r[0, 0, 0, 0])  # warm compile + sync
+        t0 = time.perf_counter()
+        r = chained(*args)
+        _ = np.asarray(r[0, 0, 0, 0])
+        return (time.perf_counter() - t0) / iters
+
+    B, S, H, D = batch or 4, seq or 1024, 12, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, S, H, D), jnp.bfloat16)
+    fl = 4 * B * H * S * S * D  # dense fwd flops; causal useful ~ (1+nblk)/(2 nblk)
+    if grad:
+        # fwd (2 matmuls) + dq kernel (3: s, dp, ds@k) + dkv kernel (4: s, dv,
+        # dp, dk) = 18 B·H·S²·D dense matmul flops per step
+        fl = fl * 18 // 4
+
+    # k_splits > 1 = sub-chunked online softmax (next QK^T hoisted over the
+    # previous chunk's VPU passes) — the round-5 attack on the per-cell
+    # softmax serialization named in PERF.md.
+    for bq, bk, ks in ((256, 256, 1), (256, 512, 1), (512, 256, 1),
+                       (512, 512, 1), (512, 512, 2), (512, 1024, 1),
+                       (512, 1024, 2), (512, 1024, 4),
+                       (1024, 512, 1), (1024, 512, 2),
+                       (1024, 1024, 1), (1024, 1024, 2), (1024, 1024, 4),
+                       (1024, 2048, 4), (2048, 2048, 4)):
+        if bq > S or bk > S:
+            continue
+        fn = lambda q, k, v: flash_causal_attention(q, k, v, block_q=bq,
+                                                    block_k=bk, k_splits=ks)
+        try:
+            t = bench(fn, q, k, v)
+        except Exception as e:  # noqa: BLE001 - sweep keeps going past bad configs
+            print(f"bq={bq} bk={bk} ks={ks}: FAIL {type(e).__name__}")
+            continue
+        print(f"bq={bq:5d} bk={bk:5d} ks={ks}: {t*1e3:7.3f} ms  "
+              f"dense-rate {fl/t/1e12:6.1f} TF/s")
+
+
 STAGES = {"1": stage1, "2": stage2, "3": stage3, "4": stage4}
+ATTN_STAGES = {"attn": stage_attn, "attn-sweep": stage_attn_sweep}
 
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--stage", choices=[*STAGES, "all"], default="1")
+    ap.add_argument("--stage", choices=[*STAGES, *ATTN_STAGES, "all"], default="1")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="batch dim for the attn stages (attn: 8, attn-sweep: 4)")
+    ap.add_argument("--seq", type=int, default=None,
+                    help="seq dim for the attn stages (default 1024)")
+    ap.add_argument("--grad", action="store_true",
+                    help="attn-sweep: time fwd+bwd instead of fwd-only")
     args = ap.parse_args()
     for name in STAGES if args.stage == "all" else [args.stage]:
         if args.stage == "all":
             print(f"\n===== stage {name} =====")
-        STAGES[name]()
+        if name in ATTN_STAGES:
+            ATTN_STAGES[name](batch=args.batch, seq=args.seq, grad=args.grad)
+        else:
+            STAGES[name]()
 
 
 if __name__ == "__main__":

@@ -20,13 +20,13 @@ echo "bench exit $? at $(stamp)" >> "$LOG"
 # 2. Flash-attention block/k_splits sweep (fwd + grad, two sequence lengths).
 {
   echo "== sweep fwd B=4 S=1024 $(stamp)"
-  timeout 900 python tools/profile_attn_sweep.py 4 1024
+  timeout 900 python tools/profile_bench.py --stage attn-sweep --batch 4 --seq 1024
   echo "== sweep fwd B=1 S=4096 $(stamp)"
-  timeout 900 python tools/profile_attn_sweep.py 1 4096
+  timeout 900 python tools/profile_bench.py --stage attn-sweep --batch 1 --seq 4096
   echo "== sweep grad B=4 S=1024 $(stamp)"
-  timeout 1200 python tools/profile_attn_sweep.py --grad 4 1024
+  timeout 1200 python tools/profile_bench.py --stage attn-sweep --grad --batch 4 --seq 1024
   echo "== sweep grad B=1 S=4096 $(stamp)"
-  timeout 1200 python tools/profile_attn_sweep.py --grad 1 4096
+  timeout 1200 python tools/profile_bench.py --stage attn-sweep --grad --batch 1 --seq 4096
 } >> "$SWEEP" 2>&1
 echo "sweep done at $(stamp)" >> "$LOG"
 

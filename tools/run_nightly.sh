@@ -174,9 +174,49 @@ fleet_rc=${PIPESTATUS[0]}
 [ "${fleet_rc}" -ne 0 ] && rc=1
 echo "# fleet smoke: ${FLEET_OUT} (exit ${fleet_rc})" >> "${OUT}"
 
+# Perf-gate stage (ISSUE 16): (a) migrate-check — the committed ledger must
+# still cover every legacy *_rNN.json artifact; (b) the noise-aware gate
+# must PASS at HEAD against the committed history; (c) the same gate must
+# FAIL on a synthetic 30% regression (inverted exit check — a sentinel that
+# can't fire is worse than none); (d) the step-time attribution smoke must
+# decompose a real CPU bench step into buckets that sum to the wall.
+# Committed as its own artifact so the regression observatory is auditable
+# per round.
+PERFGATE_OUT="PERFGATE_${ROUND}.log"
 {
-  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, disagg smoke: ${disagg_rc}, moe smoke: ${moe_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc})"
+  echo "# perf gate — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  echo "# HEAD: ${HEAD_SHA}"
+  echo "# uncommitted-diff sha256: ${DIFF_SHA}"
+  echo "# cmd: perf_ledger.py migrate --check && perf_gate.py && ! perf_gate.py --inject-pct 30 && perf_report.py --smoke"
+} > "${PERFGATE_OUT}"
+perfgate_rc=0
+JAX_PLATFORMS=cpu python tools/perf_ledger.py migrate --check 2>/dev/null \
+  | tee -a "${PERFGATE_OUT}"
+[ "${PIPESTATUS[0]}" -ne 0 ] && perfgate_rc=1
+JAX_PLATFORMS=cpu python tools/perf_gate.py 2>/dev/null \
+  | tee -a "${PERFGATE_OUT}"
+[ "${PIPESTATUS[0]}" -ne 0 ] && perfgate_rc=1
+# the sentinel demonstration: this run MUST exit nonzero
+JAX_PLATFORMS=cpu python tools/perf_gate.py --inject-pct 30 --json 2>/dev/null \
+  | sed 's/^/inject-30pct: /' | tee -a "${PERFGATE_OUT}"
+if [ "${PIPESTATUS[0]}" -eq 0 ]; then
+  echo "inject-30pct: FAIL — gate did not fire on a 30% synthetic regression" \
+    | tee -a "${PERFGATE_OUT}"
+  perfgate_rc=1
+else
+  echo "inject-30pct: OK — gate fired (nonzero exit) as required" \
+    | tee -a "${PERFGATE_OUT}"
+fi
+JAX_PLATFORMS=cpu python tools/perf_report.py --smoke 2>/dev/null \
+  | tail -3 | sed 's/^/attribution: /' | tee -a "${PERFGATE_OUT}"
+[ "${PIPESTATUS[0]}" -ne 0 ] && perfgate_rc=1
+echo "# perf gate exit: ${perfgate_rc}" >> "${PERFGATE_OUT}"
+[ "${perfgate_rc}" -ne 0 ] && rc=1
+echo "# perf gate: ${PERFGATE_OUT} (exit ${perfgate_rc})" >> "${OUT}"
+
+{
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, disagg smoke: ${disagg_rc}, moe smoke: ${moe_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc}, perf gate: ${perfgate_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
-echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT} ${DISAGG_OUT} ${MOE_OUT}"
+echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT} ${DISAGG_OUT} ${MOE_OUT} ${PERFGATE_OUT}"
 exit "${rc}"
