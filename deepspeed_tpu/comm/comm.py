@@ -276,7 +276,14 @@ def _observe_route(op_name: str, x, axis, algorithm: str, codec: str,
     never adds operations; its timings come from standalone probe
     dispatches)."""
     from deepspeed_tpu.collectives import observatory as _coll_obs
+    from deepspeed_tpu.telemetry import numerics as _numerics_obs
 
+    # the numerics observatory registers the same signature for its
+    # wire-fidelity probes (lossy codecs only; a no-op when disabled)
+    _numerics_obs.note_route(
+        op_name, algorithm, codec, _nbytes(x), _itemsize(x),
+        _axis_size(axis), axis, str(getattr(x, "dtype", "unknown")),
+        block_size)
     return _coll_obs.note_route(
         op_name, algorithm, codec, _nbytes(x), _itemsize(x),
         _axis_size(axis), axis, str(getattr(x, "dtype", "unknown")),

@@ -353,6 +353,39 @@ class DiagnosticsConfig(DeepSpeedConfigModel):
     profiler_capture: ProfilerCaptureConfig = Field(default_factory=ProfilerCaptureConfig)
 
 
+class NumericsConfig(DeepSpeedConfigModel):
+    """numerics section — the numerics observatory
+    (``telemetry/numerics.py``): sampled wire-fidelity probes over every
+    routed lossy codec, the in-jit cross-replica divergence sentinel
+    (carried in ``TrainState.numerics`` like the health field), LoCo
+    error-feedback residual gauges, and serving fidelity probes. Disabled
+    (the default) the traced step program is jaxpr-identical to a build
+    without the block (pinned by ``tests/unit/test_numerics.py``)."""
+
+    enabled: bool = False
+    # 1-in-N train steps runs the standalone wire/serving fidelity probes
+    # (codec encode->decode round trips on deterministic payloads); <= 0
+    # keeps route registration live but never probes
+    sample_every: int = 16
+    # in-jit divergence sentinel: digests the params on sampled steps and
+    # compares replicas across the mesh axes each leaf is replicated over
+    sentinel: bool = True
+    sentinel_sample_every: int = 16
+    # what a confirmed cross-replica divergence does: "log" (counter +
+    # loud warning + profiler capture arm) or "abort" (raise
+    # TrainingHealthError through the diagnostics manager, dumping the
+    # flight recorder when one is live)
+    divergence_policy: str = "log"  # log | abort
+    max_probe_elems: int = 65536  # wire-probe payload cap (elements)
+    # wire rel-err beyond drift_ratio x the codec's pinned bound
+    # (numerics.WIRE_REL_ERR_BOUNDS) is a drift event
+    drift_ratio: float = 2.0
+    # spec-decode acceptance-rate trend alarm (PR-2 median+MAD, low side)
+    spec_accept_window: int = 64
+    spec_accept_mads: float = 6.0
+    spec_accept_min_n: int = 8
+
+
 class SnapshotConfig(DeepSpeedConfigModel):
     """snapshot section — elastic async sharded snapshots
     (``checkpoint/snapshot.py``). At every ``every_n_steps`` step boundary the
@@ -570,6 +603,7 @@ class EngineConfig(DeepSpeedConfigModel):
     collectives: CollectivesConfig = Field(default_factory=CollectivesConfig)
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     diagnostics: DiagnosticsConfig = Field(default_factory=DiagnosticsConfig)
+    numerics: NumericsConfig = Field(default_factory=NumericsConfig)
     hbm_guard: HBMGuardConfig = Field(default_factory=HBMGuardConfig)
     snapshot: SnapshotConfig = Field(default_factory=SnapshotConfig)
     recovery: RecoveryConfig = Field(default_factory=RecoveryConfig)

@@ -123,6 +123,60 @@ class FaultInjector:
         logger.warning(f"faultinject: NaN planted in {n} param leaves")
         return n
 
+    def flip_param_bit(self, engine, replica_index: int = -1, bit: int = 20,
+                       element: int = 0) -> str:
+        """Flip ONE mantissa bit of ONE element on ONE replica's copy of the
+        first replicated float param leaf — the single-replica silent-
+        corruption fault (an SDC/cosmic-ray flip, or a diverged lossy
+        collective) the numerics divergence sentinel exists to catch.
+
+        Unlike :meth:`poison_engine_params` (which poisons every replica
+        identically and is therefore INVISIBLE to a cross-replica digest),
+        this edits exactly one addressable shard's buffer, so replicas
+        physically disagree afterwards. Deterministic: the same
+        (replica_index, bit, element) always flips the same bit. Returns
+        the path-string of the leaf flipped."""
+        import jax
+
+        leaves = jax.tree_util.tree_flatten_with_path(engine.state.params)[0]
+        for path, leaf in leaves:
+            arr_dtype = np.asarray(jax.device_get(
+                leaf.addressable_shards[0].data)).dtype if leaf.addressable_shards else None
+            if arr_dtype is None or not np.issubdtype(arr_dtype, np.floating):
+                continue
+            shards = [np.array(np.asarray(s.data), copy=True)
+                      for s in leaf.addressable_shards]
+            # only a leaf with >1 replica copy can disagree: find two shards
+            # holding identical data (a fully-sharded leaf has none)
+            if len(shards) < 2 or not any(
+                    np.array_equal(shards[0], s) for s in shards[1:]):
+                continue
+            target = shards[replica_index % len(shards)]
+            if target.size <= element or target.dtype != np.float32:
+                # the master params are fp32; a sub-fp32 leaf would round
+                # the flip away on the astype round trip — skip it
+                continue
+            flat = np.ascontiguousarray(target)
+            flat.view(np.uint32).flat[element] ^= np.uint32(1 << bit)
+            shards[replica_index % len(shards)] = flat
+            bufs = [jax.device_put(s, sh.device)
+                    for s, sh in zip(shards, leaf.addressable_shards)]
+            new_leaf = jax.make_array_from_single_device_arrays(
+                leaf.shape, leaf.sharding, bufs)
+            key = jax.tree_util.keystr(path)
+            params = jax.tree_util.tree_map_with_path(
+                lambda p, l: new_leaf if p == path else l,
+                engine.state.params)
+            engine.state = engine.state._replace(params=params)
+            logger.warning(
+                f"faultinject: flipped bit {bit} of element {element} on "
+                f"replica shard {replica_index % len(shards)} of param "
+                f"{key} — replicas now physically disagree")
+            return key
+        raise ValueError(
+            "flip_param_bit: no replicated float param leaf to corrupt "
+            "(every leaf is fully sharded or non-float)")
+
     def nan_params_fn(
         self,
         engine,

@@ -1073,6 +1073,35 @@ class InferenceEngineV2:
         self.spec_tokens_emitted += int(emitted.sum())
         return out, emitted, rng
 
+    # ----------------------------------------------------------- numerics plane
+    def _numerics_probe_chain(self, n_spec: int) -> None:
+        """Serving-fidelity probes (telemetry/numerics.py plane 3), sampled
+        at decode-chain boundaries: KV dequant round-trip error for the
+        quantized pool formats, WOQ matmul error for the quantized weight
+        format, and the spec-decode acceptance-rate trend alarm (PR-2
+        median+MAD, low side). Standalone dispatches — the compiled decode
+        programs are untouched; a single attribute check when disabled."""
+        from deepspeed_tpu.telemetry import numerics as numerics_mod
+
+        nm = numerics_mod.get_observatory()
+        if not nm.enabled:
+            return
+        if n_spec > 0 and self.spec_model_steps:
+            nm.note_spec_accept(
+                (self.spec_tokens_emitted - self.spec_model_steps)
+                / (self.spec_model_steps * n_spec))
+        every = max(1, int(nm.config.sample_every))
+        if self.chain_steps % every != 0:
+            return
+        kvq = self.config.kv_quant
+        if kvq is not None:
+            nm.kv_dequant_probe(kvq,
+                                head_dim=self.model_config.dims_per_head)
+        if self.config.quant.enabled:
+            from deepspeed_tpu.inference.woq import woq_format
+
+            nm.woq_matmul_probe(woq_format(self.config.quant))
+
     # ---------------------------------------------------------------- serving loop
     def generate(
         self,
@@ -1342,6 +1371,7 @@ class InferenceEngineV2:
                         / (self.spec_model_steps * n_spec))
                     g_spec_tpf.set(
                         self.spec_tokens_emitted / self.spec_model_steps)
+            self._numerics_probe_chain(n_spec)
             for i, u in enumerate(uids):
                 for t in out[i, : emitted[i]]:
                     if u in active:

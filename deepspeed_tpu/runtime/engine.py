@@ -111,6 +111,27 @@ def _facade_grad_mean(g, live):
     loss pmean stays native: a scalar control value is never worth hops."""
     from deepspeed_tpu.comm import comm as comm_mod
 
+    # A FORCED lossy wire reaches this path with NO error feedback (the
+    # zeropp/LoCo/1-bit paths carry residuals; the plain grad mean does
+    # not) — quantization error lands in the update every step. Warn once
+    # (trace time only) and let the numerics wire probes, which see this
+    # route via comm._observe_route, report the realized error.
+    from deepspeed_tpu.collectives import selector as _coll_sel
+    from deepspeed_tpu.telemetry import numerics as _numerics_mod
+
+    _cfg = _coll_sel.get_config()
+    _codec = getattr(_cfg, "facade_codec", None)
+    # codec alone never routes — a lossy wire is live only when a facade
+    # algorithm forces the grad mean off the native pmean lowering
+    if (_codec in _numerics_mod.LOSSY_CODECS
+            and getattr(_cfg, "facade_algorithm", None) not in (None, "lax")):
+        _numerics_mod.warn_once(
+            "facade_grad_mean_lossy",
+            f"collectives: forced lossy codec {_codec!r} routes the "
+            "shard_map grad mean-reductions WITHOUT error feedback "
+            "(docs/collectives.md): quantization error accumulates into "
+            "every update; enable numerics.enabled to measure the "
+            "realized wire error (numerics/wire_rel_err)")
     return comm_mod.all_reduce(g, live, op="mean")
 
 
@@ -131,6 +152,10 @@ class TrainState(NamedTuple):
     # unless the diagnostics block enables in-step health probes, so the
     # disabled path compiles the identical program.
     health: Any = None
+    # Cross-replica divergence-sentinel state (telemetry/numerics.py
+    # NumericsState) — None unless the numerics block enables the in-jit
+    # sentinel; same disabled-path identity contract as ``health``.
+    numerics: Any = None
 
 
 class DeepSpeedTPUEngine:
@@ -255,6 +280,11 @@ class DeepSpeedTPUEngine:
         # ---- diagnostics (before step compilation: the health probes trace
         # into the step and the recompile detector wraps the jitted fns) ----
         self._setup_diagnostics()
+
+        # ---- numerics observatory (after diagnostics: the drift/divergence
+        # alarms arm its profiler capture; before step compilation: the
+        # divergence sentinel traces into the step) -----------------------
+        self._setup_numerics()
 
         # ---- elastic snapshots (checkpoint/snapshot.py): cadenced async
         # sharded saves off the step clock; restore works onto any mesh ----
@@ -868,6 +898,107 @@ class DeepSpeedTPUEngine:
             return
         self.state = self.state._replace(
             health=jax.device_put(self._health.init_state(), self._health_sharding()))
+
+    # ---------------------------------------------------- numerics observatory
+    def _setup_numerics(self) -> None:
+        """Configure the process-global numerics observatory (``numerics``
+        config block) and fold the divergence-sentinel state into the train
+        state. Runs AFTER ``_setup_diagnostics`` (drift/divergence arm its
+        profiler capture) and BEFORE step compilation (the sentinel traces
+        into the step). Disabled => ``state.numerics = None`` and the
+        compiled program is identical to the no-numerics build."""
+        self._numerics = None
+        self._numerics_sentinel = None
+        ncfg = self.config.model.numerics
+        if not ncfg.enabled:
+            # process-global hygiene (selector/observatory precedent): an
+            # engine that does not enable it must not inherit a previous
+            # engine's routes or alarms
+            _num_mod = sys.modules.get("deepspeed_tpu.telemetry.numerics")
+            if _num_mod is not None and _num_mod.enabled():
+                _num_mod.configure(enabled=False)
+            return
+        from deepspeed_tpu.telemetry import numerics as numerics_mod
+
+        obs = numerics_mod.configure(
+            enabled=True, sample_every=ncfg.sample_every,
+            sentinel=ncfg.sentinel,
+            sentinel_sample_every=ncfg.sentinel_sample_every,
+            divergence_policy=ncfg.divergence_policy,
+            max_probe_elems=ncfg.max_probe_elems,
+            drift_ratio=ncfg.drift_ratio,
+            spec_accept_window=ncfg.spec_accept_window,
+            spec_accept_mads=ncfg.spec_accept_mads,
+            spec_accept_min_n=ncfg.spec_accept_min_n)
+        pc = (self.diagnostics.profiler_capture
+              if self.diagnostics is not None else None)
+        obs.install(profiler_arm=pc.arm if pc is not None else None)
+        self._numerics = obs
+        sentinel_on = ncfg.sentinel
+        if sentinel_on and self.offload_mode in ("host-jit", "nvme"):
+            # the digest shard_map needs the device mesh; the split-offload
+            # update runs on the host backend (Twin-Flow health precedent:
+            # a silently-dead knob is worse than a warning)
+            logger.warning(
+                "numerics.sentinel is not wired into the host-offload "
+                "update paths (offload device=cpu/nvme): divergence "
+                "sentinel disabled for this engine; wire/serving probes "
+                "stay on")
+            sentinel_on = False
+        if sentinel_on:
+            specs = jax.tree_util.tree_map(
+                lambda sh: getattr(sh, "spec", PartitionSpec()),
+                self.param_sharding)
+            self._numerics_sentinel = numerics_mod.DivergenceSentinel(
+                self.mesh, specs,
+                sample_every=ncfg.sentinel_sample_every)
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            nstate = jax.device_put(
+                numerics_mod.DivergenceSentinel.init_state(), rep)
+            self.state = self.state._replace(numerics=nstate)
+            self.state_sharding = self.state_sharding._replace(
+                numerics=jax.tree_util.tree_map(lambda _: rep, nstate))
+        log_dist(
+            f"numerics observatory enabled: sample_every={ncfg.sample_every} "
+            f"sentinel={'on' if self._numerics_sentinel is not None else 'off'}"
+            f" (every {ncfg.sentinel_sample_every})"
+            f" policy={ncfg.divergence_policy}",
+            ranks=[0])
+
+    def _numerics_on_step(self, step: int) -> None:
+        """Sampled host plane of the numerics observatory: standalone wire
+        probes, LoCo EF-residual gauges, and the sentinel's divergence fold
+        (policy ``log`` | ``abort``). The sentinel's event counter is
+        LATCHED in the carried state, so a host check can never miss a
+        detection — only see it a sample late."""
+        nm = self._numerics
+        nm.on_step(step)
+        ncfg = self.config.model.numerics
+        st = self.state
+        if st.numerics is not None:
+            every = max(1, int(ncfg.sentinel_sample_every))
+            # batch N runs the device probe at pre-increment step N-1
+            if (step - 1) % every == 0:
+                events, checksum = jax.device_get(
+                    (st.numerics.events, st.numerics.checksum))
+                new = nm.note_divergence_events(
+                    step, int(events), int(checksum) & 0xFFFFFFFF)
+                if new > 0 and ncfg.divergence_policy == "abort":
+                    from deepspeed_tpu.diagnostics.manager import (
+                        TrainingHealthError)
+
+                    dump_path = (self.diagnostics.dump(
+                        reason="numerics_divergence")
+                        if self.diagnostics is not None else None)
+                    raise TrainingHealthError(
+                        f"numerics divergence abort at step {step}: "
+                        f"cross-replica digest mismatch "
+                        f"({int(events)} cumulative event(s))",
+                        step, {"numerics/divergence_events": int(events)},
+                        dump_path)
+        if (ncfg.sample_every > 0 and step % ncfg.sample_every == 0
+                and st.comm_error is not None):
+            nm.note_ef_residuals(st.comm_error)
 
     def _wrap_jit(self, name: str, fn: Callable, arg_names=None) -> Callable:
         """Recompile-detector wrap for a jitted callable (identity when
@@ -1771,14 +1902,30 @@ class DeepSpeedTPUEngine:
         new_ls, new_step, metrics = self._post_update_bookkeeping(
             finite, gnorm, state.step, state.loss_scale, apply_ok=apply_ok)
         metrics.update(health_metrics)
+        sel_params = sel(new_params, state.params)
+        # Divergence sentinel (telemetry/numerics.py) on the COMMITTED input
+        # params, not the freshly computed update: the inputs are at-rest
+        # device buffers, bit-replicated by construction, so a digest
+        # mismatch is real corruption — mid-step values are whatever GSPMD's
+        # chosen collective schedule rounds them to per device (observed:
+        # per-device reduction-order jitter flagging healthy steps). A
+        # lax.cond samples 1-in-N steps; disabled traces no digest
+        # (jaxpr-identical).
+        new_numerics = state.numerics
+        if (getattr(self, "_numerics_sentinel", None) is not None
+                and state.numerics is not None):
+            new_numerics, numerics_metrics = self._numerics_sentinel.probe(
+                state.numerics, state.params, state.step)
+            metrics.update(numerics_metrics)
         new_state = TrainState(
             step=new_step,
-            params=sel(new_params, state.params),
+            params=sel_params,
             opt_state=sel(new_opt, state.opt_state),
             loss_scale=new_ls,
             rng=new_rng_data,
             comm_error=state.comm_error,
             health=new_health,
+            numerics=new_numerics,
         )
         return new_state, metrics
 
@@ -2000,6 +2147,7 @@ class DeepSpeedTPUEngine:
             rng=new_rng,
             comm_error=state.comm_error,
             health=state.health,
+            numerics=state.numerics,
         )
         return metrics
 
@@ -2250,6 +2398,10 @@ class DeepSpeedTPUEngine:
             # sampled (1-in-N) timed probes of the routed collective
             # signatures — standalone dispatches, the step program untouched
             self._coll_observatory.on_step(step)
+        if self._numerics is not None:
+            # sampled wire-fidelity probes + the divergence-sentinel fold
+            # (which may raise under the abort policy)
+            self._numerics_on_step(step)
         if self.monitor is not None:
             scalars = {
                 "Train/loss": metrics["loss"],

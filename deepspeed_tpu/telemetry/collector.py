@@ -402,7 +402,11 @@ class FleetClient:
                 ("mem/live_array_bytes", "hbm_bytes_in_use"),
                 ("serving/queue_depth", "queue_depth"),
                 ("anomaly/step_straggler", "straggler"),
-                ("anomaly/step_regression", "regression")):
+                ("anomaly/step_regression", "regression"),
+                # cross-process divergence comparator (telemetry/numerics.py):
+                # the whole-tree xor digest is bit-stable across mesh shapes,
+                # so unequal values across processes mean diverged replicas
+                ("numerics/digest_checksum", "numerics_checksum")):
             if name in gauges and field not in hb:
                 hb[field] = gauges[name]
         return hb

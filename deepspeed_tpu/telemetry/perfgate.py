@@ -47,6 +47,11 @@ HEADLINE_PATTERNS: Dict[str, Tuple[str, ...]] = {
         "slo/goodput",
     ),
     "perf": ("*tokens_per_sec*",),
+    # accuracy trajectories (telemetry/numerics.py): wire codec fidelity,
+    # divergence detection latency, and the fp8-vs-fp32 KV token-divergence
+    # step gate on the SAME median+MAD machinery as latency
+    "numerics": ("wire_rel_err/*", "*divergence_detect_steps",
+                 "*token_divergence_step"),
 }
 
 #: matched AFTER the headline patterns: derived ratios ride along with a

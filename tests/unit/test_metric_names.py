@@ -34,6 +34,7 @@ ALLOWED_SUBSYSTEMS = {
     "health",
     "mem",
     "moe",
+    "numerics",
     "perf",
     "program",
     "recompile",
@@ -118,7 +119,10 @@ def test_lint_scans_telemetry_and_serving_sources():
                   "fleet.py", "collector.py",
                   # perf observatory (ISSUE 16): the gate mints the
                   # perf/trajectory + perf/regression_events series
-                  "perfgate.py")
+                  "perfgate.py",
+                  # numerics observatory (ISSUE 17): wire/serving fidelity
+                  # + divergence series
+                  "numerics.py")
     } | {
         # step-time attribution gauges (ISSUE 16)
         os.path.join("deepspeed_tpu", "profiling", "attribution.py"),
@@ -130,6 +134,7 @@ def test_lint_scans_telemetry_and_serving_sources():
                   "migrate.py")
     } | {os.path.join("tools", "bench_serving.py"),
          os.path.join("tools", "fleet_smoke.py"),
+         os.path.join("tools", "numerics_smoke.py"),
          os.path.join("tools", "trace_merge.py")}
     missing = expected - scanned
     assert not missing, f"metric-minting files escaped the lint walk: {sorted(missing)}"
@@ -163,7 +168,14 @@ def test_known_names_pass_and_bad_names_fail():
                  "perf/trajectory", "perf/regression_events",
                  "perf/attribution_wall_ms", "perf/attribution_compute_ms",
                  "perf/attribution_stall_ms", "perf/attribution_bound",
-                 "perf/roofline_flops_fraction", "perf/roofline_bw_fraction"):
+                 "perf/roofline_flops_fraction", "perf/roofline_bw_fraction",
+                 # numerics observatory (ISSUE 17): wire/serving fidelity,
+                 # the divergence sentinel, and the fleet digest comparator
+                 "numerics/wire_rel_err", "numerics/wire_drift_events",
+                 "numerics/ef_residual_norm", "numerics/divergence_events",
+                 "numerics/digest_checksum", "numerics/digest_gap",
+                 "numerics/kv_dequant_rel_err", "numerics/woq_matmul_rel_err",
+                 "numerics/spec_accept_alarm"):
         assert _check_name(good) is None, good
     for bad in ("ttft", "Serving/ttft", "serving ttft", "{x}/y", "bogus/name"):
         assert _check_name(bad) is not None, bad

@@ -294,6 +294,35 @@ def bench_kv_capacity(kv_dtypes=("bf16", "int8", "fp8"), pool_blocks_bf16=96,
         out["int8_capacity_gain"] = round(
             out["sweep"]["int8"]["max_concurrent_requests"]
             / out["sweep"]["bf16"]["max_concurrent_requests"], 3)
+
+    # Token-divergence step (ISSUE 17, numerics observatory): greedy-decode
+    # the SAME prompts against an fp32 KV pool and each quantized pool;
+    # report the first token index where a quantized pool's output departs
+    # from the fp32 reference (n_new = never diverged within the horizon).
+    # HIGHER is better — the number the perf gate trends per round under
+    # suite "numerics" (*token_divergence_step).
+    div_rows = 4
+    div_rng = np.random.RandomState(17)
+    div_prompts = [div_rng.randint(0, cfg.vocab_size, (prompt_len,))
+                   for _ in range(div_rows)]
+
+    def _greedy(kv_cache_dtype):
+        eng = InferenceEngineV2(cfg, params, {
+            "dtype": "fp32", "kv_block_size": block_size,
+            "kv_pool_bytes": pool_bytes, "kv_cache_dtype": kv_cache_dtype,
+            "max_seqs": 512, "hbm_check": "off"})
+        return eng.generate(div_prompts, max_new_tokens=n_new)
+
+    ref = _greedy("fp32")
+    for kvd in kv_dtypes:
+        got = _greedy(kvd)
+        step = n_new
+        for r, g in zip(ref, got):
+            for i, (a, b) in enumerate(zip(r, g)):
+                if int(a) != int(b):
+                    step = min(step, i)
+                    break
+        out["sweep"].setdefault(kvd, {})["token_divergence_step"] = step
     return out
 
 
@@ -972,6 +1001,22 @@ def _emit_perf_ledger(payload: dict) -> None:
             "serving", payload, round=default_round(),
             backend=default_backend(), run_id=get_identity().run_id,
             git_sha=resolve_git_sha(), time_unix=_time.time())
+        # Token-divergence steps additionally land under suite "numerics"
+        # (ISSUE 17): the numerics headline patterns
+        # (perfgate.HEADLINE_PATTERNS["numerics"]) gate that suite, not
+        # "serving", and the number is an accuracy trajectory, not a speed.
+        from deepspeed_tpu.telemetry.perfledger import make_row
+
+        sweep = (payload.get("kv_capacity") or {}).get("sweep") or {}
+        for kvd, cols in sweep.items():
+            if "token_divergence_step" in cols:
+                rows.append(make_row(
+                    "numerics", f"{kvd}/token_divergence_step",
+                    float(cols["token_divergence_step"]), "steps",
+                    direction="higher", method="probe", samples=1,
+                    round=default_round(), backend=default_backend(),
+                    run_id=get_identity().run_id,
+                    git_sha=resolve_git_sha(), time_unix=_time.time()))
         PerfLedger().append(rows)
     except Exception as e:  # noqa: BLE001 — evidence plane, not the bench
         print(f"[bench_serving] perf-ledger append skipped: {e}",

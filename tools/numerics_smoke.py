@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Numerics observatory smoke: detection + quiet, exit-gated BOTH ways.
+
+The nightly's proof that ISSUE 17's sentinel actually fires and actually
+stays quiet (``tools/run_nightly.sh`` commits ``NUMERICS_rNN.log``):
+
+  1. **Clean run MUST be quiet** — a 20-step train run with the sentinel
+     sampling every step raises ZERO divergence events and ZERO wire-drift
+     events. A sentinel that cries wolf gets ignored; a noisy round fails
+     the stage.
+  2. **Injected corruption MUST be detected within one sampled step** —
+     ``diagnostics.faultinject.FaultInjector.flip_param_bit`` flips one
+     mantissa bit in ONE dp replica's copy of one replicated fp32 param
+     (the classic silent-data-corruption fault), and the next sampled
+     train step must latch a divergence event. No detection => exit 1
+     (the inverted gate: green is evidence of a working sentinel, not a
+     silent one).
+  3. **Wire probes MUST cover every lossy codec** — each codec in
+     ``numerics.LOSSY_CODECS`` is routed through the grad-mean facade at
+     trace time, then one forced probe round must return a relative error
+     for each, inside its pinned ``WIRE_REL_ERR_BOUNDS`` envelope.
+  4. **Abort policy MUST raise** — with ``divergence_policy="abort"`` the
+     same injected flip must surface as ``TrainingHealthError``.
+
+Accuracy trajectories land in the perf ledger (``--ledger``), suite
+``numerics``: ``wire_rel_err/<codec>`` (direction=lower) and
+``divergence_detect_steps`` (direction=lower) — gated by the PR-16
+median+MAD machinery exactly like latency (see perfgate.HEADLINE_PATTERNS).
+
+Prints one JSON line of evidence (the committed-log artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+CLEAN_STEPS = 20
+
+
+def _engine(policy: str = "log", sentinel_every: int = 1):
+    import deepspeed_tpu
+
+    eng, *_ = deepspeed_tpu.initialize(
+        model=_model_spec(),
+        config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "steps_per_print": 10_000,
+            "numerics": {
+                "enabled": True,
+                "sample_every": 4,
+                "sentinel_sample_every": sentinel_every,
+                "divergence_policy": policy,
+            },
+        },
+    )
+    return eng
+
+
+def _model_spec():
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from unit.simple_model import simple_model_spec
+
+    return simple_model_spec()
+
+
+def _batch(eng, seed):
+    from unit.simple_model import random_batch
+
+    return random_batch(eng.train_batch_size, seed=seed)
+
+
+def run_smoke() -> dict:
+    import jax
+
+    from deepspeed_tpu.collectives import selector
+    from deepspeed_tpu.diagnostics.faultinject import FaultInjector
+    from deepspeed_tpu.diagnostics.manager import TrainingHealthError
+    from deepspeed_tpu.telemetry import numerics
+
+    evidence: dict = {"clean": {}, "inject": {}, "wire": {}, "abort": {}}
+    gates: dict = {}
+
+    # ---- gate 1: clean 20-step run stays quiet -------------------------
+    eng = _engine()
+    for s in range(CLEAN_STEPS):
+        eng.train_batch(batch=_batch(eng, seed=s))
+    obs = numerics.get_observatory()
+    evidence["clean"] = {
+        "steps": CLEAN_STEPS,
+        "divergence_events": obs.divergence_events_seen,
+        "wire_drift_events": obs.wire_drift_events,
+        "checked": int(jax.device_get(eng.state.numerics.checked)),
+    }
+    gates["clean_quiet"] = (obs.divergence_events_seen == 0
+                            and obs.wire_drift_events == 0
+                            and evidence["clean"]["checked"] == CLEAN_STEPS)
+
+    # ---- gate 2: injected bit flip detected within one sampled step ----
+    leaf = FaultInjector().flip_param_bit(eng)
+    before = obs.divergence_events_seen
+    detect_steps = -1
+    for extra in range(1, 4):
+        eng.train_batch(batch=_batch(eng, seed=100 + extra))
+        if obs.divergence_events_seen > before:
+            detect_steps = extra
+            break
+    evidence["inject"] = {"leaf": leaf, "detect_steps": detect_steps,
+                          "sentinel_sample_every": 1}
+    gates["inject_detected_within_one_sampled_step"] = detect_steps == 1
+
+    # ---- gate 3: wire probes cover every lossy codec -------------------
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepspeed_tpu.runtime.engine import _facade_grad_mean
+    from deepspeed_tpu.utils.compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    x = jnp.ones((8, 512), jnp.float32)
+    for codec in sorted(numerics.LOSSY_CODECS):
+        selector.configure(facade_algorithm="ring", facade_codec=codec,
+                           codecs=(codec,))
+
+        def make():
+            def f(g):
+                return _facade_grad_mean(g, "dp")
+
+            return shard_map(f, mesh=mesh, in_specs=P("dp"),
+                             out_specs=P("dp"), check_vma=False)
+
+        jax.make_jaxpr(make())(x)  # trace-time route registration
+    selector.configure()
+    rels = obs.sample_now()
+    covered = {k.split("/", 1)[1] for k in rels}
+    in_bounds = {
+        c: (rels.get(f"all_reduce/{c}") is not None
+            and 0.0 < rels[f"all_reduce/{c}"] < numerics.WIRE_REL_ERR_BOUNDS[c])
+        for c in sorted(numerics.LOSSY_CODECS)}
+    evidence["wire"] = {"rel_err": rels, "covered": sorted(covered)}
+    gates["wire_covers_every_lossy_codec"] = (
+        covered >= set(numerics.LOSSY_CODECS) and all(in_bounds.values()))
+
+    # ---- gate 4: abort policy raises ----------------------------------
+    eng2 = _engine(policy="abort")
+    eng2.train_batch(batch=_batch(eng2, seed=0))
+    FaultInjector().flip_param_bit(eng2)
+    raised = False
+    try:
+        eng2.train_batch(batch=_batch(eng2, seed=1))
+    except TrainingHealthError as e:
+        raised = True
+        evidence["abort"] = {"raised": True, "step": e.step,
+                             "dump": bool(e.dump_path)}
+    gates["abort_policy_raises"] = raised
+
+    evidence["gates"] = gates
+    evidence["pass"] = all(gates.values())
+    return evidence
+
+
+def emit_ledger(evidence: dict) -> int:
+    """Append the accuracy trajectories to the unified perf ledger (suite
+    ``numerics``). Best-effort like bench_serving: the smoke verdict never
+    depends on the ledger dir being writable."""
+    try:
+        from deepspeed_tpu.telemetry.fleet import get_identity
+        from deepspeed_tpu.telemetry.perfledger import (
+            PerfLedger, default_backend, default_round, make_row,
+            resolve_git_sha,
+        )
+
+        common = dict(backend=default_backend(), round=default_round(),
+                      run_id=get_identity().run_id,
+                      git_sha=resolve_git_sha(), time_unix=time.time())
+        rows = [make_row("numerics", "divergence_detect_steps",
+                         float(evidence["inject"]["detect_steps"]), "steps",
+                         direction="lower", method="probe", samples=1,
+                         **common)]
+        for key, rel in evidence["wire"]["rel_err"].items():
+            codec = key.split("/", 1)[1]
+            rows.append(make_row("numerics", f"wire_rel_err/{codec}",
+                                 float(rel), "rel", direction="lower",
+                                 method="probe", samples=1, **common))
+        return PerfLedger().append(rows)
+    except Exception as e:  # noqa: BLE001 — evidence plane, not the gate
+        print(f"[numerics_smoke] perf-ledger append skipped: {e}",
+              file=sys.stderr)
+        return 0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ledger", action="store_true",
+                    help="append accuracy rows to the unified perf ledger")
+    args = ap.parse_args()
+    evidence = run_smoke()
+    if args.ledger:
+        evidence["ledger_rows"] = emit_ledger(evidence)
+    print(json.dumps(evidence, sort_keys=True))
+    sys.exit(0 if evidence["pass"] else 1)
+
+
+if __name__ == "__main__":
+    main()

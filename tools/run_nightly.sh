@@ -174,6 +174,27 @@ fleet_rc=${PIPESTATUS[0]}
 [ "${fleet_rc}" -ne 0 ] && rc=1
 echo "# fleet smoke: ${FLEET_OUT} (exit ${fleet_rc})" >> "${OUT}"
 
+# Numerics observatory smoke (ISSUE 17), exit-gated BOTH ways: a clean
+# 20-step run must raise ZERO divergence/drift events AND an injected
+# single-replica bit flip (faultinject.flip_param_bit) must latch a
+# divergence event within one sampled step; wire probes must cover every
+# lossy codec inside its pinned bound; the abort policy must raise. The
+# accuracy trajectories (wire_rel_err/<codec>, divergence_detect_steps)
+# land in the unified perf ledger, suite "numerics", so the perf-gate
+# stage above MAD-gates them next round exactly like latency.
+NUMERICS_OUT="NUMERICS_${ROUND}.log"
+{
+  echo "# numerics observatory smoke — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  echo "# HEAD: ${HEAD_SHA}"
+  echo "# uncommitted-diff sha256: ${DIFF_SHA}"
+  echo "# cmd: python tools/numerics_smoke.py --ledger"
+} > "${NUMERICS_OUT}"
+JAX_PLATFORMS=cpu python tools/numerics_smoke.py --ledger 2>/dev/null \
+  | tee -a "${NUMERICS_OUT}"
+numerics_rc=${PIPESTATUS[0]}
+[ "${numerics_rc}" -ne 0 ] && rc=1
+echo "# numerics smoke: ${NUMERICS_OUT} (exit ${numerics_rc})" >> "${OUT}"
+
 # Perf-gate stage (ISSUE 16): (a) migrate-check — the committed ledger must
 # still cover every legacy *_rNN.json artifact; (b) the noise-aware gate
 # must PASS at HEAD against the committed history; (c) the same gate must
@@ -215,8 +236,8 @@ echo "# perf gate exit: ${perfgate_rc}" >> "${PERFGATE_OUT}"
 echo "# perf gate: ${PERFGATE_OUT} (exit ${perfgate_rc})" >> "${OUT}"
 
 {
-  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, disagg smoke: ${disagg_rc}, moe smoke: ${moe_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc}, perf gate: ${perfgate_rc})"
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, disagg smoke: ${disagg_rc}, moe smoke: ${moe_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc}, numerics smoke: ${numerics_rc}, perf gate: ${perfgate_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
-echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT} ${DISAGG_OUT} ${MOE_OUT} ${PERFGATE_OUT}"
+echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT} ${DISAGG_OUT} ${MOE_OUT} ${NUMERICS_OUT} ${PERFGATE_OUT}"
 exit "${rc}"
