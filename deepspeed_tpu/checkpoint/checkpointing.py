@@ -21,6 +21,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from deepspeed_tpu import telemetry
+from deepspeed_tpu.utils.compat import host_copy_unaliased
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 LATEST_FILE = "latest"
@@ -111,8 +112,10 @@ def _save_checkpoint(engine, save_dir, tag, client_state, save_latest,
         # list corruption on the second post-restore step), and a checkpoint
         # should not encode placement anyway. The masters are host-resident
         # already, so this costs one D2H of the small device partition.
-        payload = jax.tree_util.tree_map(
-            lambda x: np.asarray(jax.device_get(x)), payload)
+        # host_copy_unaliased, not a device_get view: async engines serialize
+        # this payload while training continues and a donated step can write
+        # through the zero-copy view (utils.compat.host_copy_unaliased).
+        payload = host_copy_unaliased(payload)
     if checkpoint_engine is None:
         checkpoint_engine = getattr(engine, "checkpoint_engine", None)
     if checkpoint_engine is None:

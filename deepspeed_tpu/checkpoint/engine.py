@@ -18,6 +18,7 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from deepspeed_tpu.utils.compat import host_copy_unaliased
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -98,8 +99,14 @@ class AsyncCheckpointEngine(CheckpointEngine):
                 self._queue.task_done()
 
     def save(self, payload: Any, path: str) -> None:
+        # Exclusively-owned host copies, not device_get views: the worker
+        # serializes this payload while training keeps stepping, and a donated
+        # step can write through a zero-copy D2H view
+        # (utils.compat.host_copy_unaliased) — the checkpoint would hold state
+        # from AFTER the save point.
         host = jax.tree_util.tree_map(
-            lambda x: jax.device_get(x) if isinstance(x, jax.Array) else x, payload
+            lambda x: host_copy_unaliased(x) if isinstance(x, jax.Array) else x,
+            payload,
         )
         self._queue.put(("save", host, path))
 

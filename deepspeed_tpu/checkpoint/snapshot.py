@@ -55,12 +55,13 @@ import os
 import shutil
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
 from deepspeed_tpu.checkpoint.universal import _tag_step
+from deepspeed_tpu.utils.compat import host_copy_unaliased
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 SNAPSHOT_DIR = "snapshots"
@@ -179,7 +180,11 @@ def engine_state_atoms(engine) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
     if canon is not None:
         state = state._replace(opt_state=canon(state.opt_state))
     tree = _fp32_state_tree(state)
-    host = jax.device_get(tree)
+    # Exclusively-owned copies, NOT device_get views: the background writer
+    # serializes these while the engine keeps stepping, and a donated step can
+    # write through a zero-copy D2H view (utils.compat.host_copy_unaliased) —
+    # the snapshot on disk would silently hold LATER state than its tag.
+    host = host_copy_unaliased(tree)
     atoms = {k: np.asarray(v) for k, v in _flatten(host).items() if v is not None}
     meta = {
         "step": int(np.asarray(host["step"])),
@@ -215,48 +220,55 @@ def _npy_bytes(arr: np.ndarray) -> bytes:
     return buf.getvalue()
 
 
-def write_snapshot(
-    atoms: Dict[str, np.ndarray],
-    meta: Dict[str, Any],
-    base_dir: str,
-    tag: str,
-    shard_bytes: int = 64 << 20,
-    fsync: bool = True,
-    fault_hook: Optional[Callable[[str, int], None]] = None,
-) -> str:
-    """Write one snapshot with atomic commit; returns the committed path.
+def partition_atoms(atoms: Dict[str, np.ndarray], process_count: int) -> List[List[str]]:
+    """Deterministic atom → writer-process assignment for multi-host writes.
 
-    ``fault_hook(event, index)`` is the fault-injection seam
-    (``diagnostics/faultinject.py``): called before each shard write
-    (``("shard", i)``), before the manifest (``("manifest", n)``) and before
-    the commit rename (``("commit", n)``); a hook that raises simulates a
-    writer crash at exactly that point.
+    Greedy largest-first into the currently lightest bin, ties broken by the
+    lower process index and by sorted key order, so every process computes
+    the IDENTICAL partition from the same canonical atom tree — no
+    coordination round is needed to agree on ownership. Returns one sorted
+    key list per process (some may be empty when atoms < processes).
     """
-    root = snapshot_root(base_dir)
-    os.makedirs(root, exist_ok=True)
-    final_path = os.path.join(root, tag)
-    tmp_path = f"{final_path}.tmp-{os.getpid()}"
-    if os.path.exists(tmp_path):
-        shutil.rmtree(tmp_path)
-    os.makedirs(os.path.join(tmp_path, "shards"))
+    if process_count < 1:
+        raise ValueError(f"process_count must be >= 1, got {process_count}")
+    bins: List[List[str]] = [[] for _ in range(process_count)]
+    weights = [0] * process_count
+    for key in sorted(atoms, key=lambda k: (-atoms[k].nbytes, k)):
+        p = min(range(process_count), key=lambda i: (weights[i], i))
+        bins[p].append(key)
+        weights[p] += int(atoms[key].nbytes)
+    return [sorted(b) for b in bins]
 
+
+def _write_shard_files(
+    atoms: Dict[str, np.ndarray],
+    keys: Sequence[str],
+    dest_dir: str,
+    rel_dir: str,
+    prefix: str,
+    shard_bytes: int,
+    fsync: bool,
+    fault_hook: Optional[Callable[[str, int], None]],
+) -> Tuple[List[Dict[str, Any]], int]:
+    """Write shard files for ``keys`` into ``dest_dir``; records name files
+    relative to the final snapshot dir (``rel_dir``/``prefix``NNNNN.npy)."""
+    owned = {k: atoms[k] for k in keys}
     shards: List[Dict[str, Any]] = []
     total_bytes = 0
-    for i, (key, start, stop, part) in enumerate(_iter_shards(atoms, shard_bytes)):
+    for i, (key, start, stop, part) in enumerate(_iter_shards(owned, shard_bytes)):
         if fault_hook is not None:
             fault_hook("shard", i)
         # NOT ascontiguousarray: it promotes 0-d atoms to shape (1,);
         # np.save copies non-contiguous input itself
         payload = _npy_bytes(np.asarray(part))
-        fname = os.path.join("shards", f"{i:05d}.npy")
-        fpath = os.path.join(tmp_path, fname)
-        with open(fpath, "wb") as f:
+        fname = f"{prefix}{i:05d}.npy"
+        with open(os.path.join(dest_dir, fname), "wb") as f:
             f.write(payload)
             if fsync:
                 f.flush()
                 os.fsync(f.fileno())
         shards.append({
-            "file": fname,
+            "file": os.path.join(rel_dir, fname) if rel_dir else fname,
             "atom": key,
             "dtype": str(part.dtype),
             "shape": list(part.shape),
@@ -265,6 +277,161 @@ def write_snapshot(
             "bytes": len(payload),
         })
         total_bytes += len(payload)
+    return shards, total_bytes
+
+
+def _part_dir(root: str, tag: str, process_index: int) -> str:
+    return os.path.join(root, f"{tag}.part{process_index}")
+
+
+def _write_part(
+    atoms: Dict[str, np.ndarray],
+    keys: Sequence[str],
+    root: str,
+    tag: str,
+    process_index: int,
+    shard_bytes: int,
+    fsync: bool,
+    fault_hook: Optional[Callable[[str, int], None]],
+) -> str:
+    """Non-zero rank's half of a multi-process snapshot: write owned shards
+    plus a ``part.json`` into ``<root>/<tag>.part<p>`` (tmp + rename, so
+    rank 0 only ever observes a COMPLETE part). Part dirs hold no
+    ``manifest.json`` and are therefore never listed as snapshots."""
+    final_path = _part_dir(root, tag, process_index)
+    tmp_path = f"{final_path}.tmp-{os.getpid()}"
+    if os.path.exists(tmp_path):
+        shutil.rmtree(tmp_path)
+    os.makedirs(tmp_path)
+    shards, total = _write_shard_files(
+        atoms, keys, tmp_path, rel_dir="shards",
+        prefix=f"p{process_index}_", shard_bytes=shard_bytes,
+        fsync=fsync, fault_hook=fault_hook)
+    part = {
+        "format_version": FORMAT_VERSION,
+        "tag": tag,
+        "process_index": process_index,
+        "shards": shards,
+        "payload_bytes": total,
+    }
+    with open(os.path.join(tmp_path, "part.json"), "w") as f:
+        json.dump(part, f)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    if os.path.exists(final_path):
+        shutil.rmtree(final_path)
+    os.replace(tmp_path, final_path)
+    if fsync:
+        _fsync_dir(root)
+    return final_path
+
+
+def _collect_parts(
+    root: str,
+    tag: str,
+    tmp_shards_dir: str,
+    process_count: int,
+    part_timeout_s: float,
+) -> Tuple[List[Dict[str, Any]], int, List[str]]:
+    """Rank 0's merge: wait for every peer's part dir, move its shard files
+    into the snapshot-in-progress, and return the merged shard records."""
+    shards: List[Dict[str, Any]] = []
+    total = 0
+    part_paths: List[str] = []
+    deadline = time.time() + part_timeout_s
+    for p in range(1, process_count):
+        path = _part_dir(root, tag, p)
+        while not os.path.isfile(os.path.join(path, "part.json")):
+            if time.time() > deadline:
+                raise SnapshotError(
+                    f"snapshot {tag}: timed out after {part_timeout_s:.0f}s "
+                    f"waiting for part {p}/{process_count - 1} at {path} — "
+                    f"a writer process died before publishing its shards")
+            time.sleep(0.05)
+        with open(os.path.join(path, "part.json")) as f:
+            part = json.load(f)
+        if part.get("tag") != tag or part.get("process_index") != p:
+            raise SnapshotError(
+                f"snapshot {tag}: part dir {path} holds "
+                f"tag={part.get('tag')!r} process={part.get('process_index')!r}")
+        for rec in part["shards"]:
+            fname = os.path.basename(rec["file"])
+            os.replace(os.path.join(path, fname),
+                       os.path.join(tmp_shards_dir, fname))
+            shards.append(rec)
+            total += int(rec["bytes"])
+        part_paths.append(path)
+    return shards, total, part_paths
+
+
+def write_snapshot(
+    atoms: Dict[str, np.ndarray],
+    meta: Dict[str, Any],
+    base_dir: str,
+    tag: str,
+    shard_bytes: int = 64 << 20,
+    fsync: bool = True,
+    fault_hook: Optional[Callable[[str, int], None]] = None,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+    part_timeout_s: float = 120.0,
+) -> str:
+    """Write one snapshot with atomic commit; returns the committed path.
+
+    ``fault_hook(event, index)`` is the fault-injection seam
+    (``diagnostics/faultinject.py``): called before each shard write
+    (``("shard", i)``), before the manifest (``("manifest", n)``) and before
+    the commit rename (``("commit", n)``); a hook that raises simulates a
+    writer crash at exactly that point.
+
+    Multi-process writes (ISSUE 18, elastic training on multi-host meshes):
+    ``process_index``/``process_count`` default to the jax runtime's. Every
+    process passes the SAME canonical atom tree (``engine_state_atoms`` is
+    partitioning-independent by construction) and :func:`partition_atoms`
+    deterministically assigns each atom one writer, so shard IO scales with
+    host count without any coordination round. Non-zero ranks publish their
+    shards to ``<root>/<tag>.part<p>`` (tmp + rename) and return that path;
+    rank 0 writes its own shards, waits up to ``part_timeout_s`` for every
+    part, merges the files into one snapshot dir, and commits the single
+    manifest — so loaders are unchanged and the commit stays atomic. With
+    ``process_count == 1`` the layout is byte-identical to the
+    single-process format.
+    """
+    if process_count is None:
+        process_count = jax.process_count()
+    if process_index is None:
+        process_index = jax.process_index()
+    if not 0 <= process_index < process_count:
+        raise ValueError(
+            f"process_index {process_index} out of range for "
+            f"process_count {process_count}")
+    root = snapshot_root(base_dir)
+    os.makedirs(root, exist_ok=True)
+
+    multi = process_count > 1
+    owned = partition_atoms(atoms, process_count) if multi else [sorted(atoms)]
+    if multi and process_index != 0:
+        return _write_part(atoms, owned[process_index], root, tag,
+                           process_index, shard_bytes, fsync, fault_hook)
+
+    final_path = os.path.join(root, tag)
+    tmp_path = f"{final_path}.tmp-{os.getpid()}"
+    if os.path.exists(tmp_path):
+        shutil.rmtree(tmp_path)
+    tmp_shards = os.path.join(tmp_path, "shards")
+    os.makedirs(tmp_shards)
+
+    shards, total_bytes = _write_shard_files(
+        atoms, owned[0], tmp_shards, rel_dir="shards",
+        prefix="p0_" if multi else "", shard_bytes=shard_bytes,
+        fsync=fsync, fault_hook=fault_hook)
+    part_paths: List[str] = []
+    if multi:
+        peer_shards, peer_bytes, part_paths = _collect_parts(
+            root, tag, tmp_shards, process_count, part_timeout_s)
+        shards = sorted(shards + peer_shards, key=lambda r: r["file"])
+        total_bytes += peer_bytes
 
     manifest = {
         "format_version": FORMAT_VERSION,
@@ -274,6 +441,7 @@ def write_snapshot(
                   for k, v in atoms.items()},
         "shards": shards,
         "payload_bytes": total_bytes,
+        "writer_processes": process_count,
         **meta,
     }
     if fault_hook is not None:
@@ -315,6 +483,8 @@ def write_snapshot(
         if fsync:
             _fsync_dir(root)
     _write_atomic(os.path.join(root, LATEST_FILE), tag, fsync=fsync)
+    for p in part_paths:  # shard files already moved in; reclaim the husks
+        shutil.rmtree(p, ignore_errors=True)
     return final_path
 
 
@@ -345,7 +515,12 @@ def prune_snapshots(base_dir: str, keep: int, protect: Tuple[str, ...] = (),
     pid = os.getpid()
     now = time.time()
     for entry in os.listdir(root):
-        if ".tmp-" in entry and not entry.endswith(f".tmp-{pid}"):
+        stale_tmp = ".tmp-" in entry and not entry.endswith(f".tmp-{pid}")
+        # committed multi-process part dirs are reclaimed by rank 0 at merge
+        # time; one still present past the age gate was orphaned by a rank-0
+        # death and will never be collected
+        orphan_part = ".tmp-" not in entry and ".part" in entry
+        if stale_tmp or orphan_part:
             path = os.path.join(root, entry)
             try:
                 age = now - os.path.getmtime(path)

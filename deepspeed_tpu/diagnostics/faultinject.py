@@ -63,6 +63,7 @@ class FaultInjector:
     def __init__(self):
         self.nan_steps_fired: list = []
         self.writer_kills_fired: int = 0
+        self.daemon_kills_fired: int = 0
 
     # ------------------------------------------------------------- NaN grads
     def nan_batch_fn(
@@ -225,6 +226,27 @@ class FaultInjector:
 
         manager.fault_hook = hook
 
+    # ----------------------------------------------------- fabric / process
+    def kill_replica_daemon(self, proc_or_pid) -> int:
+        """SIGKILL a serving-fabric replica daemon (ISSUE 18): the hard-death
+        case — no drain, no flush, the HTTP socket just goes away. The
+        router must detect it via heartbeat/dispatch failure and re-admit
+        the replica's admitted-but-unfinished requests elsewhere. Accepts a
+        ``subprocess.Popen`` or a raw pid; returns the pid killed."""
+        import signal
+
+        pid = int(getattr(proc_or_pid, "pid", proc_or_pid))
+        os.kill(pid, signal.SIGKILL)
+        wait = getattr(proc_or_pid, "wait", None)
+        if wait is not None:
+            try:
+                wait(timeout=10.0)  # reap so the test sees returncode set
+            except Exception:
+                pass
+        self.daemon_kills_fired += 1
+        logger.warning(f"faultinject: SIGKILLed replica daemon pid={pid}")
+        return pid
+
     # --------------------------------------------------- on-disk corruption
     @staticmethod
     def truncate_shard(base_dir: str, tag: Optional[str] = None,
@@ -264,4 +286,5 @@ class FaultInjector:
         return {
             "nan_steps_fired": list(self.nan_steps_fired),
             "writer_kills_fired": self.writer_kills_fired,
+            "daemon_kills_fired": self.daemon_kills_fired,
         }

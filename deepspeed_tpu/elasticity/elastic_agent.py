@@ -31,6 +31,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.elasticity.resilience import EXIT_PREEMPTED
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -40,6 +41,10 @@ class GenerationResult:
     world_size: int
     returncodes: Dict[str, int]
     ok: bool
+    # Hosts that exited with the preemption code (clean snapshot-then-exit,
+    # ``resilience.EXIT_PREEMPTED``). They are relaunched in the next
+    # generation rather than dropped from the roster.
+    preempted: List[str] = dataclasses.field(default_factory=list)
 
 
 class DSElasticAgent:
@@ -59,6 +64,7 @@ class DSElasticAgent:
         max_restarts: int = 3,
         min_hosts: int = 1,
         poll_interval_s: float = 0.5,
+        preempt_exit_code: int = EXIT_PREEMPTED,
     ):
         self.hosts = dict(hosts)
         self.elastic_config = elastic_config
@@ -66,6 +72,7 @@ class DSElasticAgent:
         self.max_restarts = max_restarts
         self.min_hosts = min_hosts
         self.poll_interval_s = poll_interval_s
+        self.preempt_exit_code = preempt_exit_code
         self.history: List[GenerationResult] = []
 
     # ------------------------------------------------------------------
@@ -84,14 +91,20 @@ class DSElasticAgent:
                 f"world size {world} is not elastic-compatible (valid: {valid})")
         return {"train_batch_size": batch, "train_micro_batch_size_per_gpu": micro}, world
 
-    def _wait_generation(self, procs: Dict[str, subprocess.Popen]) -> Tuple[Dict[str, int], List[str]]:
+    def _wait_generation(
+        self, procs: Dict[str, subprocess.Popen]
+    ) -> Tuple[Dict[str, int], List[str], List[str]]:
         """Block until all exit, or kill the generation on first failure
         (the launcher's peers-die-together contract).
 
-        Returns (exit codes, failed hosts). Survivors the AGENT terminated
-        exit non-zero too, but they did not fail — only hosts that died on
-        their own count (otherwise one crash would disqualify every host and
-        no restart could ever happen)."""
+        Returns (exit codes, failed hosts, preempted hosts). Survivors the
+        AGENT terminated exit non-zero too, but they did not fail — only
+        hosts that died on their own count (otherwise one crash would
+        disqualify every host and no restart could ever happen). A host that
+        self-exited with ``preempt_exit_code`` is *preempted*, not failed:
+        it took a clean snapshot on SIGTERM (``resilience.PreemptionGuard``)
+        and keeps its roster slot, but the generation still ends — peers
+        can't train past a departed rank — so the cascade fires for it too."""
         live = dict(procs)
         codes: Dict[str, int] = {}
         agent_killed: set = set()
@@ -123,8 +136,15 @@ class DSElasticAgent:
             time.sleep(self.poll_interval_s)
         for host, p in procs.items():
             codes.setdefault(host, p.returncode if p.returncode is not None else -1)
-        failed = [h for h, rc in codes.items() if rc != 0 and h not in agent_killed]
-        return codes, failed
+        preempted = [
+            h for h, rc in codes.items()
+            if rc == self.preempt_exit_code and h not in agent_killed
+        ]
+        failed = [
+            h for h, rc in codes.items()
+            if rc != 0 and h not in agent_killed and h not in preempted
+        ]
+        return codes, failed, preempted
 
     def run(self) -> GenerationResult:
         """Supervise generations until success or restart budget exhausted."""
@@ -133,16 +153,27 @@ class DSElasticAgent:
             cfg, world = self.resolve_config(hosts)
             logger.info(f"elastic generation {gen}: hosts={list(hosts)} world={world} cfg={cfg}")
             procs = self.launch_fn(list(hosts), gen, cfg)
-            codes, failed = self._wait_generation(procs)
-            result = GenerationResult(gen, world, codes, ok=not any(rc != 0 for rc in codes.values()))
+            codes, failed, preempted = self._wait_generation(procs)
+            result = GenerationResult(
+                gen, world, codes,
+                ok=not any(rc != 0 for rc in codes.values()),
+                preempted=preempted,
+            )
             self.history.append(result)
             if result.ok:
                 return result
-            # drop failed hosts; restart the survivors as a smaller world
+            # drop failed hosts; restart the survivors as a smaller world.
+            # Preempted hosts keep their slot — they exited cleanly with a
+            # durable snapshot and resume from it on relaunch.
             for h in failed:
                 hosts.pop(h, None)
             if len(hosts) < self.min_hosts:
                 logger.error(f"elastic agent: {len(hosts)} hosts left (< min {self.min_hosts}); giving up")
                 return result
-            logger.warning(f"elastic agent: workers failed on {failed}; restarting with {list(hosts)}")
+            if failed:
+                logger.warning(f"elastic agent: workers failed on {failed}; restarting with {list(hosts)}")
+            if preempted:
+                logger.warning(
+                    f"elastic agent: hosts preempted (clean exit {self.preempt_exit_code}): "
+                    f"{preempted}; relaunching with roster intact")
         return self.history[-1]

@@ -33,8 +33,9 @@ loop is a library feature, exercised by the fault-injection harness
 from __future__ import annotations
 
 import dataclasses
+import signal
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from deepspeed_tpu.checkpoint.snapshot import (
     SnapshotCorruptionError,
@@ -43,6 +44,77 @@ from deepspeed_tpu.checkpoint.snapshot import (
     read_manifest,
 )
 from deepspeed_tpu.utils.logging import log_dist, logger
+
+# Exit code of a preemption-clean exit (128 + SIGTERM, the conventional
+# spelling): the elastic agent treats it as "host preempted, relaunch and
+# resume" — NOT a failure that drops the host from the roster.
+EXIT_PREEMPTED = 143
+
+
+class PreemptionGuard:
+    """SIGTERM → snapshot at the next step boundary → clean exit.
+
+    A preemption notice (SIGTERM from the scheduler, SIGINT from an
+    operator) must never kill the process mid-optimizer-step: the handler
+    only sets a flag, and ``run_resilient`` checks it at each step
+    boundary — where engine state is consistent — takes a BLOCKING
+    snapshot, and raises ``SystemExit(EXIT_PREEMPTED)``. The restarted
+    process (same or different mesh shape — restore re-slices) resumes
+    from that snapshot with a bit-identical forward trajectory, because
+    ``batch_fn(step)`` is a deterministic mapping (asserted below).
+
+    Signal handlers install only on the main thread; elsewhere the guard
+    degrades to flag-only (callers can set ``requested`` directly — the
+    test seam, and the embedding story for frameworks that own signals).
+    """
+
+    def __init__(self, signals: Sequence[int] = (signal.SIGTERM,)):
+        self.requested = False
+        self._installed: List[Any] = []
+        for sig in signals:
+            try:
+                prev = signal.signal(sig, self._handler)
+            except ValueError:
+                logger.warning(
+                    f"PreemptionGuard: cannot install handler for signal "
+                    f"{sig} outside the main thread; set .requested "
+                    "directly to request a preemption exit")
+                continue
+            self._installed.append((sig, prev))
+
+    def _handler(self, signum, frame):  # noqa: ARG002 - signal contract
+        self.requested = True
+
+    def uninstall(self) -> None:
+        for sig, prev in self._installed:
+            try:
+                signal.signal(sig, prev)
+            except ValueError:
+                pass  # not the main thread anymore; nothing to restore
+        self._installed = []
+
+
+def assert_deterministic_batch_fn(batch_fn: Callable[[int], Any],
+                                  step: int) -> None:
+    """Pin the ``batch_fn(step)`` determinism contract: two calls at the
+    same step must return identical batches, leaf for leaf. A resumed run
+    replays the data stream from the restored step — a nondeterministic
+    batch_fn silently diverges the trajectory instead, which is exactly
+    the class of bug that survives every other resume check."""
+    import jax
+    import numpy as np
+
+    a = jax.tree_util.tree_leaves(batch_fn(step))
+    b = jax.tree_util.tree_leaves(batch_fn(step))
+    same = len(a) == len(b) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(a, b))
+    if not same:
+        raise ValueError(
+            f"batch_fn({step}) returned different batches on two calls: "
+            "run_resilient requires batch_fn(step) to be a DETERMINISTIC "
+            "mapping from step to batch (derive randomness from the step, "
+            "e.g. seed=step), or a preemption-resumed run will train on a "
+            "different data stream than the uninterrupted one")
 
 
 @dataclasses.dataclass
@@ -85,6 +157,10 @@ def run_resilient(
     policy=None,
     on_rewind: Optional[Callable[[Dict[str, Any]], None]] = None,
     fleet_client=None,
+    resume: str = "auto",
+    preemptible: bool = False,
+    preemption_signals: Optional[Sequence[int]] = None,
+    check_batch_determinism: bool = True,
 ) -> RecoveryReport:
     """Train ``engine`` to ``num_steps`` optimizer steps, surviving health
     aborts and snapshot corruption by rewinding to the last-good snapshot.
@@ -103,6 +179,22 @@ def run_resilient(
     push at every rewind and at give-up, stamped with the recovery state —
     the cluster health ledger sees a rewinding/failed process the moment it
     happens, not a heartbeat interval later.
+
+    Elastic/preemption extensions (ISSUE 18):
+
+    - ``resume="auto"`` (default): a FRESH process (``engine.global_steps
+      == 0``) pointed at a snapshot directory holding committed snapshots
+      restores the latest one before training — the restarted half of a
+      preemption. ``resume="never"`` keeps the pre-18 start-from-scratch.
+    - ``preemptible=True`` installs a :class:`PreemptionGuard` on
+      ``preemption_signals`` (default SIGTERM): at the step boundary after
+      the signal, a BLOCKING snapshot is taken and
+      ``SystemExit(EXIT_PREEMPTED)`` raised (``recovery_report`` attached).
+      The elastic agent recognizes the exit code and relaunches without
+      dropping the host.
+    - ``batch_fn(step)`` determinism is ASSERTED once at startup
+      (``check_batch_determinism``): the resumed data stream must equal
+      the uninterrupted one, or resume-bit-identity is silently lost.
     """
     pol = _policy(engine, policy)
     if fleet_client is None:
@@ -135,6 +227,29 @@ def run_resilient(
     def _sync_save_failures():
         report.save_failures = mgr.save_failures - sf0 + explicit_failures[0]
 
+    if resume not in ("auto", "never"):
+        raise ValueError(f"resume must be 'auto'|'never', got {resume!r}")
+    if resume == "auto" and mgr.last_good_tag is not None \
+            and int(engine.global_steps) == 0:
+        # restarted process (preemption, crash): pick up where the last
+        # committed snapshot left off — any mesh shape, restore re-slices
+        try:
+            tag = mgr.restore()
+            log_dist(
+                f"run_resilient: auto-restored snapshot {tag!r} "
+                f"(step {int(engine.global_steps)})", ranks=[0])
+        except (SnapshotError, SnapshotCorruptionError) as e:
+            logger.warning(
+                f"run_resilient: auto-restore failed ({e}); "
+                "training from scratch")
+
+    if check_batch_determinism:
+        assert_deterministic_batch_fn(batch_fn, int(engine.global_steps))
+
+    guard = PreemptionGuard(
+        signals=tuple(preemption_signals) if preemption_signals is not None
+        else (signal.SIGTERM,)) if preemptible else None
+
     if mgr.last_good_tag is None:
         # step-0 anchor: there must always be something to rewind to
         mgr.snapshot(blocking=True)
@@ -155,9 +270,35 @@ def run_resilient(
                if report.flight_record else ""))
         raise exc
 
+    def _preempt_exit(at_step: int):
+        """Step boundary after a preemption signal: durable snapshot, clean
+        exit. A failed snapshot write still exits — the restart resumes
+        from the previous good tag (steps replay, trajectory identical)."""
+        try:
+            mgr.snapshot(blocking=True)
+            report.snapshots_taken += 1
+        except SnapshotError as e:
+            explicit_failures[0] += 1
+            logger.warning(
+                f"run_resilient: preemption snapshot failed ({e}); exiting "
+                "on the previous good snapshot")
+        _sync_save_failures()
+        report.steps_completed = at_step
+        _fleet_push("preempted", step=at_step)
+        log_dist(
+            f"run_resilient: preemption signal honored at step {at_step} — "
+            f"snapshot committed, exiting {EXIT_PREEMPTED}", ranks=[0])
+        if guard is not None:
+            guard.uninstall()
+        exc = SystemExit(EXIT_PREEMPTED)
+        exc.recovery_report = report
+        raise exc
+
     step = int(engine.global_steps)
     report.steps_completed = step
     while step < num_steps:
+        if guard is not None and guard.requested:
+            _preempt_exit(step)
         last_tag_before = mgr.last_good_tag
         try:
             engine.train_batch(batch_fn(step))
@@ -229,6 +370,12 @@ def run_resilient(
         # healthy training — record it, the previous snapshot stays 'latest'
         explicit_failures[0] += 1
         logger.warning(f"run_resilient: final snapshot barrier reported: {e}")
+    if guard is not None:
+        if guard.requested:
+            # the signal landed inside the FINAL step: honor it anyway so
+            # the agent sees the preemption exit code, with state durable
+            _preempt_exit(int(engine.global_steps))
+        guard.uninstall()
     _sync_save_failures()
     report.steps_completed = int(engine.global_steps)
     return report

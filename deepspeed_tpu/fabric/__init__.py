@@ -1,0 +1,26 @@
+"""Cross-process serving fabric (ISSUE 18).
+
+A control plane over real OS-process boundaries, built on the same
+stdlib-HTTP ``RouteServer`` discipline as the fleet collector:
+
+- :mod:`deepspeed_tpu.fabric.wire` — JSON-safe byte-verbatim tensor and
+  ``MigrationBuffer`` serialization (blake2b block identity survives the
+  wire).
+- :mod:`deepspeed_tpu.fabric.replica_daemon` — wraps a v2 engine behind
+  POST ``/admit``, ``/chain_round``, ``/preempt``, ``/export_request``,
+  ``/import_request``, ``/drain`` (+ GET ``/healthz``) in its own
+  process, propagating ``fleet.TraceContext`` so per-request flow arrows
+  join across pids in ``tools/trace_merge.py``.
+- :mod:`deepspeed_tpu.fabric.remote` — ``RemoteReplica``, a client that
+  satisfies the router's replica protocol over RPC so the unchanged
+  ``ServingRouter`` scheduling drives a mixed roster of local and remote
+  replicas.
+
+See ``docs/serving_fabric.md`` for the endpoint table, roster lifecycle,
+liveness semantics, and the wire-vs-DMA migration split.
+"""
+
+from deepspeed_tpu.fabric.remote import RemoteReplica, RemoteReplicaDownError
+from deepspeed_tpu.fabric.replica_daemon import ReplicaDaemon
+
+__all__ = ["RemoteReplica", "RemoteReplicaDownError", "ReplicaDaemon"]

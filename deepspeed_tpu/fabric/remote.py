@@ -1,0 +1,405 @@
+"""RemoteReplica: the router's replica protocol over stdlib-HTTP RPC.
+
+``RemoteReplica`` duck-types ``InferenceEngineV2``'s serving surface —
+``try_admit``, ``_put_sample``, ``decode_chain``/``decode_spec_chain``,
+``chain_window``, ``_can_schedule_evicting``, KV export/import, flush —
+so the UNCHANGED ``ServingRouter`` scheduling (SLO admission, disagg
+roles, migration tickets, preempt-youngest) drives a mixed roster of
+local engines and daemons in other OS processes. Scheduling state stays
+router-side; the remote carries only per-dispatch batches and the
+replica's own pool state.
+
+Liveness rides a heartbeat thread polling ``GET /healthz``: after
+``heartbeat_miss_limit`` consecutive misses the replica flips
+``alive=False`` and the router re-admits its in-flight requests on
+survivors. A transport error during a dispatch raises
+:class:`RemoteReplicaDownError` (marker attribute ``replica_gone``) —
+the router converts it into the same mark-dead path instead of aborting
+the serve, which is how "admitted requests are never dropped" survives a
+SIGKILL mid-decode.
+
+Queue-depth and goodput signals ride the heartbeat into ``remote_load``,
+which the router folds into its load score — a saturated daemon repels
+new placements exactly like a deep local queue.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.fabric.wire import (
+    export_from_wire,
+    export_to_wire,
+    key_from_wire,
+    key_to_wire,
+)
+from deepspeed_tpu.telemetry.tracer import get_tracer
+
+__all__ = ["RemoteReplica", "RemoteReplicaDownError"]
+
+
+class RemoteReplicaDownError(RuntimeError):
+    """Transport-level failure talking to a replica daemon. The marker
+    attribute lets the router detect it without importing this module."""
+
+    replica_gone = True
+
+
+def _post(url: str, path: str, doc: Dict, timeout: float) -> Dict:
+    data = json.dumps(doc).encode()
+    req = urllib.request.Request(
+        url + path, data=data, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        # RouteServer answers 400 for handler ValueError/KeyError/TypeError:
+        # those are CONTRACT errors (layout mismatch, unknown uid) and must
+        # re-raise as ValueError — the in-process exception the router's
+        # migration machinery already handles. Anything else is transport.
+        if e.code == 400:
+            try:
+                msg = json.loads(e.read().decode()).get("error", str(e))
+            except Exception:  # noqa: BLE001 - body already lost
+                msg = str(e)
+            raise ValueError(msg) from None
+        raise RemoteReplicaDownError(f"{url}{path}: HTTP {e.code}") from None
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        raise RemoteReplicaDownError(f"{url}{path}: {e}") from None
+
+
+def _get(url: str, path: str, timeout: float) -> Dict:
+    try:
+        with urllib.request.urlopen(url + path, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        raise RemoteReplicaDownError(f"{url}{path}: {e}") from None
+
+
+class _RemotePoolLeaf:
+    """Shape-only stand-in for one pool tensor: the router's disagg layout
+    check reads ``pool.k.dtype``."""
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+
+
+class _RemotePool:
+    def __init__(self, quant: Optional[str], kv_dtype: str):
+        import jax.numpy as jnp
+
+        self.quant = quant
+        self.k = _RemotePoolLeaf(jnp.dtype(kv_dtype))
+
+
+class _RemotePrefixCache:
+    """Router-facing view of the daemon's prefix cache: existence gates the
+    post-import/post-prefill ``_insert_prefix`` calls; the hit rate rides
+    ``GET /stats``."""
+
+    def __init__(self, replica: "RemoteReplica"):
+        self._replica = replica
+
+    @property
+    def hit_rate(self) -> float:
+        return float(self._replica.stats().get("prefix_hit_rate", 0.0))
+
+
+class RemoteReplica:
+    """Client half of a replica daemon — see module docstring.
+
+    ``__init__`` fetches ``GET /spec`` and reconstructs the daemon's real
+    ``RaggedInferenceConfig`` from its dump, so every config-derived router
+    decision (role, SLO targets, chain length, spec mode, migration depth)
+    is computed from the daemon's OWN settings, not a client-side copy.
+    """
+
+    def __init__(self, url: str, timeout: float = 60.0,
+                 heartbeat_interval_s: float = 0.25,
+                 heartbeat_miss_limit: int = 4,
+                 start_heartbeat: bool = True,
+                 tracer=None):
+        from deepspeed_tpu.inference.engine_v2 import RaggedInferenceConfig
+
+        self.url = url.rstrip("/")
+        self.timeout = float(timeout)
+        self._tracer = tracer if tracer is not None else get_tracer()
+        spec = _get(self.url, "/spec", self.timeout)
+        self.config = RaggedInferenceConfig(**spec["config"])
+        self.num_kv_blocks = int(spec["num_kv_blocks"])
+        self.max_seq_len = int(spec["max_seq_len"])
+        self.pool = _RemotePool(spec["quant"], spec["kv_dtype"])
+        self.prefix_cache = (_RemotePrefixCache(self)
+                             if spec.get("prefix_cache") else None)
+        self.mesh = self._local_mesh()
+        # router-facing accounting attrs (same names as the local engine)
+        self.tokens_decoded = 0
+        self.dispatch_count = 0
+        self._recorder = None
+        # liveness + load signals (heartbeat-fed)
+        self.alive = True
+        self.draining = False
+        self.queue_depth = 0.0
+        self.goodput = 1.0
+        self.heartbeat_misses = 0
+        self.last_heartbeat: Optional[Dict] = None
+        self._hb_interval = float(heartbeat_interval_s)
+        self._hb_miss_limit = int(heartbeat_miss_limit)
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        if start_heartbeat:
+            self.start_heartbeat()
+
+    @staticmethod
+    def _local_mesh():
+        """A one-device local mesh: the router replicates its per-replica
+        PRNG key onto ``engine.mesh`` — for a remote replica the key only
+        needs a host-side home before it rides the wire."""
+        import jax
+        from jax.sharding import Mesh
+
+        return Mesh(np.asarray(jax.devices()[:1]), ("fabric",))
+
+    # ------------------------------------------------------------ liveness
+    def start_heartbeat(self) -> None:
+        if self._hb_thread is not None:
+            return
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, name="dstpu-fabric-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
+
+    def heartbeat_now(self) -> bool:
+        """One poll of ``GET /healthz``; updates load/liveness signals.
+        Returns True on a successful beat."""
+        try:
+            doc = _get(self.url, "/healthz",
+                       timeout=max(self._hb_interval, 0.2) * 2)
+        except RemoteReplicaDownError:
+            self.heartbeat_misses += 1
+            if self._tracer.enabled:
+                self._tracer.registry.counter(
+                    "fabric/heartbeat_misses").add(1)
+            if self.heartbeat_misses >= self._hb_miss_limit and self.alive:
+                self.alive = False
+                if self._tracer.enabled:
+                    self._tracer.registry.counter(
+                        "fabric/dead_replicas").add(1)
+            return False
+        self.heartbeat_misses = 0
+        self.last_heartbeat = doc
+        self.queue_depth = float(doc.get("queue_depth", 0.0))
+        self.goodput = float(doc.get("goodput", 1.0))
+        self.draining = bool(doc.get("draining", False))
+        return True
+
+    def _hb_loop(self) -> None:
+        while not self._hb_stop.wait(self._hb_interval):
+            self.heartbeat_now()
+            if not self.alive:
+                return
+
+    @property
+    def remote_load(self) -> float:
+        """Extra load score the router folds into placement: the daemon's
+        own queue depth plus its goodput deficit (mirrors ``_Replica.load``
+        for work the router did not dispatch itself)."""
+        if not self.alive:
+            return float("inf")
+        return self.queue_depth + (1.0 - self.goodput)
+
+    # ----------------------------------------------------------------- rpc
+    def _rpc(self, path: str, doc: Dict) -> Dict:
+        t0 = time.perf_counter()
+        ack = _post(self.url, path, doc, self.timeout)
+        if self._tracer.enabled:
+            self._tracer.registry.histogram("fabric/rpc_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+        return ack
+
+    def _ctx_wires(self, tracker, rids: Optional[Sequence[int]],
+                   n: int) -> List[Optional[Dict]]:
+        """Per-row wire TraceContexts (from the router-side lifecycle
+        tracker) so the daemon's dispatch spans join each request's flow."""
+        if tracker is None or rids is None:
+            return [None] * n
+        out: List[Optional[Dict]] = []
+        for rid in rids:
+            ctx = tracker.trace_context(rid)
+            out.append(None if ctx is None else ctx.to_wire())
+        return out
+
+    # ------------------------------------------------- admission/scheduling
+    def try_admit(self, uid: int, cand: np.ndarray, other_uids: Sequence[int],
+                  other_counts: Sequence[int]) -> Optional[np.ndarray]:
+        ack = self._rpc("/admit", {
+            "uid": int(uid), "cand": [int(t) for t in np.asarray(cand)],
+            "other_uids": [int(u) for u in other_uids],
+            "other_counts": [int(c) for c in other_counts]})
+        if self.draining or ack.get("draining"):
+            return None
+        s = ack.get("suffix")
+        return None if s is None else np.asarray(s, np.int32)
+
+    def _can_schedule_evicting(self, uids, counts) -> bool:
+        ack = self._rpc("/can_schedule", {
+            "uids": [int(u) for u in uids],
+            "counts": [int(c) for c in counts]})
+        return bool(ack["ok"])
+
+    def chain_window(self, budgets: Sequence[int], k: int) -> List[int]:
+        # pure config arithmetic — no RPC (same formula as the engine)
+        m = 1 + self.config.spec_decode
+        return [min(k * m, int(b)) + self.config.spec_decode
+                for b in budgets]
+
+    def query(self, uid: int) -> Tuple[int, int]:
+        ack = self._rpc("/query", {"uid": int(uid)})
+        return int(ack["seen"]), int(ack["free"])
+
+    def flush(self, uid: int) -> None:
+        self._rpc("/flush", {"uid": int(uid)})
+
+    def preempt(self, uid: int) -> None:
+        self._rpc("/preempt", {"uid": int(uid)})
+
+    def _insert_prefix(self, uid: int, full_tokens: np.ndarray) -> None:
+        self._rpc("/insert_prefix", {
+            "uid": int(uid),
+            "tokens": [int(t) for t in np.asarray(full_tokens)]})
+
+    # ----------------------------------------------------------- dispatches
+    def _put_sample(self, uids, token_lists, rng, sample_kw: Tuple,
+                    tracker=None, rids=None) -> Tuple[np.ndarray, Any]:
+        doc = {
+            "uids": [int(u) for u in uids],
+            "token_lists": [[int(t) for t in np.asarray(tl)]
+                            for tl in token_lists],
+            "rng": key_to_wire(rng),
+            "sample_kw": [list(p) for p in sample_kw],
+            "ctxs": self._ctx_wires(tracker, rids, len(uids)),
+        }
+        with self._tracer.span("serve:dispatch", kind="prefill",
+                               rows=len(uids), remote=self.url):
+            if tracker is not None and rids is not None:
+                tracker.mark_dispatch(rids, "prefill")
+            ack = self._rpc("/prefill", doc)
+        self.dispatch_count += 1
+        return np.asarray(ack["toks"], np.int32), key_from_wire(ack["rng"])
+
+    def decode_chain(self, uids, last_tokens, budgets, k, rng,
+                     eos_id: Optional[int] = None,
+                     sample_kw: Tuple = (("do_sample", False),),
+                     tracker=None, rids=None):
+        doc = {
+            "uids": [int(u) for u in uids],
+            "last_tokens": [int(t) for t in last_tokens],
+            "budgets": [int(b) for b in budgets],
+            "k": int(k), "rng": key_to_wire(rng),
+            "eos_id": None if eos_id is None else int(eos_id),
+            "sample_kw": [list(p) for p in sample_kw],
+            "spec": False,
+            "ctxs": self._ctx_wires(tracker, rids, len(uids)),
+        }
+        with self._tracer.span("serve:dispatch", kind="chain",
+                               rows=len(uids), k=int(k), remote=self.url):
+            if tracker is not None and rids is not None:
+                tracker.mark_dispatch(rids, "chain")
+            ack = self._rpc("/chain_round", doc)
+        self.dispatch_count += 1
+        return (np.asarray(ack["out"], np.int32),
+                np.asarray(ack["emitted"], np.int32),
+                key_from_wire(ack["rng"]))
+
+    def decode_spec_chain(self, uids, last_tokens, budgets, k, rng,
+                          histories, eos_id: Optional[int] = None,
+                          tracker=None, rids=None):
+        doc = {
+            "uids": [int(u) for u in uids],
+            "last_tokens": [int(t) for t in last_tokens],
+            "budgets": [int(b) for b in budgets],
+            "k": int(k), "rng": key_to_wire(rng),
+            "eos_id": None if eos_id is None else int(eos_id),
+            "spec": True,
+            "histories": [[int(t) for t in np.asarray(h)]
+                          for h in histories],
+            "ctxs": self._ctx_wires(tracker, rids, len(uids)),
+        }
+        with self._tracer.span("serve:dispatch", kind="spec_chain",
+                               rows=len(uids), k=int(k), remote=self.url):
+            if tracker is not None and rids is not None:
+                tracker.mark_dispatch(rids, "chain")
+            ack = self._rpc("/chain_round", doc)
+        self.dispatch_count += 1
+        return (np.asarray(ack["out"], np.int32),
+                np.asarray(ack["emitted"], np.int32),
+                key_from_wire(ack["rng"]))
+
+    # ------------------------------------------------------------ migration
+    def export_request(self, uid: int) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        ack = self._rpc("/export_request", {"uid": int(uid)})
+        export = export_from_wire(ack)
+        export.pop("ok", None)
+        if self._tracer.enabled:
+            self._tracer.registry.histogram(
+                "fabric/wire_migration_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+        return export
+
+    def can_import(self, n_blocks: int) -> bool:
+        ack = self._rpc("/can_import", {"n_blocks": int(n_blocks)})
+        return bool(ack["ok"])
+
+    def import_request(self, uid: int, export: Dict[str, Any],
+                       ctx=None) -> bool:
+        t0 = time.perf_counter()
+        doc = {"uid": int(uid), "export": export_to_wire(export),
+               "ctx": None if ctx is None else ctx.to_wire()}
+        ack = self._rpc("/import_request", doc)
+        if self._tracer.enabled:
+            self._tracer.registry.histogram(
+                "fabric/wire_migration_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+        return bool(ack["ok"])
+
+    def block_hashes(self, uid: int) -> List[str]:
+        return list(self._rpc("/block_hashes", {"uid": int(uid)})["hashes"])
+
+    # -------------------------------------------------------------- control
+    def drain(self) -> List[int]:
+        """Ask the daemon to quiesce admissions; returns its active uids.
+        The router's ``request_drain`` pairs this with peer handoff."""
+        ack = self._rpc("/drain", {})
+        self.draining = True
+        return [int(u) for u in ack.get("active_uids", ())]
+
+    def dump_trace(self, path: str) -> str:
+        return str(self._rpc("/dump_trace", {"path": path})["path"])
+
+    def request_shutdown(self) -> None:
+        try:
+            self._rpc("/shutdown", {})
+        except RemoteReplicaDownError:
+            pass  # already gone — that is what shutdown is for
+
+    def stats(self) -> Dict[str, Any]:
+        try:
+            return _get(self.url, "/stats", self.timeout)
+        except RemoteReplicaDownError:
+            return {}
+
+    def close(self) -> None:
+        self.stop_heartbeat()

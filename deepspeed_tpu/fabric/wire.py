@@ -1,0 +1,100 @@
+"""Byte-verbatim tensor serialization for the serving fabric.
+
+The cross-process control plane is JSON over stdlib HTTP (same transport
+discipline as ``FleetCollector``), so tensors ride as base64 of the raw
+buffer plus a dtype/shape header. Two properties matter:
+
+- **bytes verbatim**: the KV pool may be int8/fp8/bf16; quantized
+  payloads and their fp32 scales must cross the boundary bit-exact so
+  ``PagedKVPool._block_content_hash`` (blake2b over the raw slices)
+  yields the *same digest* on both sides — that digest equality is the
+  fabric's end-to-end migration-fidelity gate.
+- **dtype fidelity**: dtype names round-trip through ``jnp.dtype`` so
+  extended types (bfloat16, float8_*) resolve via the ml_dtypes registry
+  rather than numpy's builtin table.
+
+On TPU this wire path is the *control* plane only — bulk KV moves between
+co-resident chips use ``migrate.remote_copy_pages`` (device-to-device
+DMA); the wire path carries KV bytes when the hop crosses a host.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.paged import MigrationBuffer
+
+__all__ = [
+    "array_to_wire",
+    "array_from_wire",
+    "export_to_wire",
+    "export_from_wire",
+    "key_to_wire",
+    "key_from_wire",
+]
+
+
+def array_to_wire(a: Optional[Any]) -> Optional[Dict[str, Any]]:
+    """ndarray/jax.Array -> JSON-safe ``{"dtype", "shape", "data"}`` (or None)."""
+    if a is None:
+        return None
+    arr = np.asarray(a)
+    return {
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+        "data": base64.b64encode(arr.tobytes()).decode("ascii"),
+    }
+
+
+def array_from_wire(doc: Optional[Dict[str, Any]]) -> Optional[np.ndarray]:
+    """Inverse of :func:`array_to_wire`; returns a writable numpy array."""
+    if doc is None:
+        return None
+    dt = jnp.dtype(doc["dtype"])  # ml_dtypes-aware (bfloat16, float8_*)
+    raw = base64.b64decode(doc["data"])
+    return np.frombuffer(raw, dtype=dt).reshape(doc["shape"]).copy()
+
+
+def key_to_wire(rng: Any) -> Dict[str, Any]:
+    """PRNG key -> wire doc (legacy uint32[2] keys are plain arrays)."""
+    return array_to_wire(np.asarray(rng))
+
+
+def key_from_wire(doc: Dict[str, Any]) -> np.ndarray:
+    return array_from_wire(doc)
+
+
+def export_to_wire(export: Dict[str, Any]) -> Dict[str, Any]:
+    """``engine.export_request`` dict -> JSON-safe doc.
+
+    The ``MigrationBuffer`` leaves (k, v and optional per-block scales)
+    are serialized byte-verbatim; the scalar metadata (block geometry,
+    seen tokens, pool dtype/quant mode) passes through unchanged so the
+    importer's layout check is exactly the in-process one.
+    """
+    buf = export["buffer"]
+    doc = {k: v for k, v in export.items() if k != "buffer"}
+    doc["buffer"] = {
+        "k": array_to_wire(buf.k),
+        "v": array_to_wire(buf.v),
+        "k_scale": array_to_wire(buf.k_scale),
+        "v_scale": array_to_wire(buf.v_scale),
+    }
+    return doc
+
+
+def export_from_wire(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`export_to_wire`."""
+    wire_buf = doc["buffer"]
+    export = {k: v for k, v in doc.items() if k != "buffer"}
+    export["buffer"] = MigrationBuffer(
+        k=array_from_wire(wire_buf["k"]),
+        v=array_from_wire(wire_buf["v"]),
+        k_scale=array_from_wire(wire_buf.get("k_scale")),
+        v_scale=array_from_wire(wire_buf.get("v_scale")),
+    )
+    return export

@@ -147,6 +147,9 @@ class LifecycleTracker:
         self._recorder = recorder
         self._labels = {k: str(v) for k, v in (labels or {}).items()}
         self._records: Dict[int, RequestRecord] = {}
+        # rid -> fleet.TraceContext, kept off RequestRecord (records hold
+        # plain floats/ints only; the fabric needs the full context back)
+        self._contexts: Dict[int, Any] = {}
         self._emit = emit_metrics and getattr(tracer, "enabled", False)
         window = float(getattr(slo, "window_s", 30.0) or 30.0)
         self._window_s = window
@@ -195,6 +198,13 @@ class LifecycleTracker:
         if rec is not None:
             rec.flow_id = ctx.flow_id
             rec.flow_name = ctx.flow_name
+            self._contexts[rid] = ctx
+
+    def trace_context(self, rid: int):
+        """The attached ``fleet.TraceContext`` (or None) — the serving
+        fabric reads it back to forward the context on remote dispatches,
+        so a replica daemon in another process can join the flow."""
+        return self._contexts.get(rid)
 
     def records(self) -> Dict[int, RequestRecord]:
         return self._records

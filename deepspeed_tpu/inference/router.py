@@ -109,17 +109,28 @@ class _Replica:
         self.prefill_ema = 0.0
         self.chain_ema = 0.0
         self.dispatches = 0
+        # serving-fabric lifecycle (ISSUE 18): a draining replica takes no
+        # new admissions (its in-flight requests hand off to peers); a dead
+        # one — heartbeat timeout or a transport error mid-dispatch — has
+        # its requests re-admitted on survivors
+        self.draining = False
+        self.dead = False
 
     def load(self) -> float:
         """Queue-depth-based load score, goodput-discounted: replicas
-        missing their SLO window attract less new load."""
+        missing their SLO window attract less new load. A remote replica
+        adds its own heartbeat-reported load (``remote_load``) — work the
+        daemon carries that this router did not dispatch."""
+        if self.dead:
+            return float("inf")
         depth = len(self.assigned) + len(self.active)
         goodput = 1.0
         if self.tracker is not None and self.tracker._emit:
             g = self.tracker._g_goodput.value
             if g is not None and self.tracker._win_slo:
                 goodput = float(g)
-        return depth + (1.0 - goodput)
+        return depth + (1.0 - goodput) + float(
+            getattr(self.engine, "remote_load", 0.0))
 
     def ema(self, attr: str, value: float, alpha: float = 0.3) -> None:
         cur = getattr(self, attr)
@@ -235,6 +246,11 @@ class ServingRouter:
         self.migrations = 0
         self.migrated_blocks = 0
         self.migration_failures = 0
+        # serving-fabric accounting (ISSUE 18)
+        self.dead_replicas = 0
+        self.drains = 0
+        self.readmits_dead = 0
+        self._serve_state: Optional[_Serve] = None
         # distributed-trace contexts minted per request (fleet.TraceContext):
         # rid -> ctx; the wire form (`dispatch_context`) is what a real
         # process-boundary replica receives with its dispatch, and the flow
@@ -287,31 +303,44 @@ class ServingRouter:
         return cls(engines, roles=role_list, **kw)
 
     # ------------------------------------------------------------ placement
+    def _live(self) -> List[_Replica]:
+        return [r for r in self.replicas if not r.dead]
+
     def _prefill_candidates(self) -> List[_Replica]:
         """Replicas that take FRESH admissions: the prefill pool under
-        disagg, everyone otherwise (mixed replicas serve both phases)."""
+        disagg, everyone otherwise (mixed replicas serve both phases).
+        Dead replicas never qualify; draining ones only as a last resort —
+        an already-admitted request re-queued off a dead peer must land
+        SOMEWHERE (it is never dropped), even mid-drain."""
+        live = self._live()
+        if not live:
+            raise RuntimeError("no live replicas to admit on")
+        accepting = [r for r in live if not r.draining] or live
         if self.disagg:
-            pre = [r for r in self.replicas if r.role == "prefill"]
+            pre = [r for r in accepting if r.role == "prefill"]
             if pre:
                 return pre
-            return [r for r in self.replicas if r.role == "mixed"]
-        return list(self.replicas)
+            mixed = [r for r in accepting if r.role == "mixed"]
+            if mixed:
+                return mixed
+        return accepting
 
     def _migration_target(self, src: _Replica) -> Optional[_Replica]:
-        """Least-loaded decode-pool replica (mixed as fallback) to receive a
-        finished prefill's KV blocks; None = no target, serve mixed."""
-        cands = [r for r in self.replicas
-                 if r is not src and r.role == "decode"]
+        """Least-loaded live, non-draining decode-pool replica (mixed as
+        fallback) to receive a request's KV blocks; None = no target,
+        serve mixed."""
+        live = [r for r in self._live()
+                if r is not src and not r.draining]
+        cands = [r for r in live if r.role == "decode"]
         if not cands:
-            cands = [r for r in self.replicas
-                     if r is not src and r.role == "mixed"]
+            cands = [r for r in live if r.role == "mixed"]
         if not cands:
             return None
         return min(cands, key=lambda r: (r.load(), r.index))
 
     def _least_loaded(self, candidates: Optional[List[_Replica]] = None
                       ) -> _Replica:
-        cands = candidates if candidates is not None else self.replicas
+        cands = candidates if candidates is not None else self._live()
         return min(cands, key=lambda r: (r.load(), r.index))
 
     # ------------------------------------------------------------ admission
@@ -368,7 +397,10 @@ class ServingRouter:
         replicas."""
         prompts = [np.asarray(p, np.int32) for p in prompts]
         n_req = len(prompts)
-        spec = self.replicas[0].engine.config.spec_decode > 0
+        live = self._live()
+        if not live:
+            raise RuntimeError("ServingRouter.serve: no live replicas")
+        spec = live[0].engine.config.spec_decode > 0
         if spec and do_sample:
             raise ValueError(
                 "spec_decode is greedy-only (verify-and-accept compares "
@@ -379,7 +411,7 @@ class ServingRouter:
         # decode window lives on its migration destination), so its pool is
         # guarded for the prompt alone; mixed/decode replicas need the full
         # prompt + generation window like a standalone engine.
-        for rep in self.replicas:
+        for rep in live:
             eng = rep.engine
             pool_tokens = eng.num_kv_blocks * eng.config.kv_block_size
             margin = eng.config.spec_decode
@@ -419,7 +451,7 @@ class ServingRouter:
         # races a shared key (greedy output is key-independent)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        for rep in self.replicas:
+        for rep in live:
             rep.rng = jax.device_put(
                 jax.random.fold_in(jax.random.PRNGKey(seed), rep.index),
                 NamedSharding(rep.engine.mesh, P()))
@@ -460,10 +492,14 @@ class ServingRouter:
                     recorder=r.engine._recorder)
         self._handles = handles
 
-        if self.dispatch == "threads" and len(self.replicas) > 1:
-            self._serve_threaded(S)
-        else:
-            self._serve_serial(S)
+        self._serve_state = S
+        try:
+            if self.dispatch == "threads" and len(self.replicas) > 1:
+                self._serve_threaded(S)
+            else:
+                self._serve_serial(S)
+        finally:
+            self._serve_state = None
         if S.abort is not None:
             raise S.abort
         for rep in self.replicas:
@@ -484,12 +520,23 @@ class ServingRouter:
             with self._lock:
                 if S.abort is not None or not self._work_left(S):
                     return
+                self._check_liveness(S)
                 self._bind_arrivals(S)
             did_work = False
             for rep in self.replicas:
+                if rep.dead:
+                    continue
                 try:
                     did_work |= self._replica_round(rep, S)
                 except BaseException as e:  # noqa: BLE001 — propagate to caller
+                    if getattr(e, "replica_gone", False):
+                        # the replica's process died under a dispatch: fold
+                        # it into the liveness path (its admitted requests
+                        # re-queue on survivors), don't abort the serve
+                        with self._lock:
+                            self._mark_dead(rep, S)
+                        did_work = True
+                        continue
                     with self._lock:
                         S.abort = e
                     return
@@ -508,6 +555,10 @@ class ServingRouter:
                     with self._lock:
                         if S.abort is not None or not self._work_left(S):
                             return
+                        if rep.dead:
+                            # survivors carry the re-queued work; this
+                            # thread only waits for the serve to finish
+                            pass
                         # tight-poll only while a sibling might hand work
                         # over any moment; a drained roster waiting out an
                         # open-loop arrival gap (or a deferred request's
@@ -515,8 +566,18 @@ class ServingRouter:
                         # burning a core per replica on the shared lock
                         busy = any(r.active or r.migrate_in or r.await_export
                                    or r.tickets for r in self.replicas)
-                    if self._replica_round(rep, S):
-                        continue
+                    if not rep.dead:
+                        try:
+                            if self._replica_round(rep, S):
+                                continue
+                        except BaseException as e:  # noqa: BLE001
+                            if not getattr(e, "replica_gone", False):
+                                raise
+                            # process died under a dispatch — mark dead and
+                            # keep the serve alive on the survivors
+                            with self._lock:
+                                self._mark_dead(rep, S)
+                            continue
                     if busy:
                         time.sleep(0.0002)
                     else:
@@ -536,6 +597,7 @@ class ServingRouter:
                 with self._lock:
                     if S.abort is not None or not self._work_left(S):
                         break
+                    self._check_liveness(S)
                     self._bind_arrivals(S)
                 time.sleep(0.0005)
         finally:
@@ -568,8 +630,14 @@ class ServingRouter:
         now = self._clock()
         while S.pending and now - S.t_start >= S.arr[S.pending[0]]:
             idx = S.pending.popleft()
-            if S.affinity[idx] is not None:
-                rep = self.replicas[S.affinity[idx]]
+            aff = S.affinity[idx]
+            if aff is not None and (self.replicas[aff].dead
+                                    or self.replicas[aff].draining):
+                # the affine replica left the roster: its cached prefix is
+                # gone with it — rebind fresh on a survivor
+                S.affinity[idx] = aff = None
+            if aff is not None:
+                rep = self.replicas[aff]
                 self.affine_readmits += 1
                 if handles is not None:
                     handles["c_affine"].add(1.0)
@@ -577,6 +645,130 @@ class ServingRouter:
                 rep = self._least_loaded(self._prefill_candidates())
                 S.affinity[idx] = rep.index
             rep.assigned.append(idx)
+
+    # -------------------------------------------------- fabric roster lifecycle
+    def _check_liveness(self, S: _Serve) -> None:
+        """Lock held. Fold heartbeat-detected deaths (``engine.alive`` is
+        False after ``heartbeat_miss_limit`` consecutive missed beats on a
+        ``RemoteReplica``) into the roster."""
+        for rep in self.replicas:
+            if not rep.dead and getattr(rep.engine, "alive", True) is False:
+                self._mark_dead(rep, S)
+
+    def _mark_dead(self, rep: _Replica, S: Optional[_Serve]) -> None:
+        """Lock held. Remove ``rep`` from the roster and re-queue every
+        admitted request it held on the survivors — the PR-14 invariant
+        ("an admitted request is never dropped") extended across process
+        death. Generated tokens live router-side in ``S.gen``, so a
+        survivor re-prefills the full context and the output continues
+        exactly where the dead replica stopped."""
+        if rep.dead:
+            return
+        rep.dead = True
+        rep.draining = True
+        self.dead_replicas += 1
+        if self._tracer.enabled:
+            self._tracer.registry.counter("router/dead_replicas").add(1.0)
+        logger.warning(
+            f"replica {rep.index} marked dead "
+            f"({len(rep.active)} active, {len(rep.assigned)} assigned): "
+            "re-admitting its requests on survivors")
+        if S is None:
+            rep.active.clear()
+            rep.order.clear()
+            rep.assigned.clear()
+            rep.migrating.clear()
+            rep.await_export.clear()
+            rep.tickets = []
+            rep.migrate_in.clear()
+            return
+        # requests already safely en route to (or landed on) a live peer:
+        # the exported bytes live in router memory, so the import path
+        # carries them through — no re-prefill, no double-serve
+        safe = {t.idx for t in rep.tickets
+                if t.status in ("inflight", "done")
+                and not self.replicas[t.dst].dead}
+        rep.tickets = []
+        # bounce inbound tickets: their (live) sources see "failed" and
+        # resume mixed or retry toward a live destination
+        while rep.migrate_in:
+            rep.migrate_in.popleft().status = "failed"
+        while rep.assigned:
+            idx = rep.assigned.popleft()
+            S.affinity[idx] = None
+            S.pending.appendleft(idx)
+        for uid, idx in list(rep.active.items()):
+            if idx in safe or S.outputs.get(idx) is not None:
+                continue
+            S.affinity[idx] = None
+            S.pending.appendleft(idx)
+            self.readmits_dead += 1
+            if rep.tracker is not None:
+                rep.tracker.preempt(idx)
+        rep.active.clear()
+        rep.order.clear()
+        rep.migrating.clear()
+        rep.await_export.clear()
+        if not self._live():
+            S.abort = RuntimeError(
+                "all replicas dead: admitted requests cannot complete")
+
+    def request_drain(self, index: int) -> None:
+        """Quiesce replica ``index``: no new admissions, and every in-flight
+        request hands off to a peer over the ordinary migration-ticket
+        plane (wire KV for a remote peer — quantized bytes verbatim, prefix
+        cache re-indexed from the imported blocks). Safe to call mid-serve
+        from another thread; outside a serve it just marks the roster."""
+        rep = self.replicas[index]
+        drain_rpc = getattr(rep.engine, "drain", None)
+        if callable(drain_rpc):
+            drain_rpc()  # the daemon refuses admissions at its own door too
+        with self._lock:
+            rep.draining = True
+            self.drains += 1
+            if self._tracer.enabled:
+                self._tracer.registry.counter("router/drains").add(1.0)
+            S = self._serve_state
+            if S is None:
+                return
+            while rep.assigned:
+                idx = rep.assigned.popleft()
+                S.affinity[idx] = None
+                S.pending.appendleft(idx)
+            for uid, idx in rep.active.items():
+                if idx not in rep.migrating:
+                    rep.migrating.add(idx)
+                    rep.await_export.append(idx)
+
+    def join(self, engine: Any, role: Optional[str] = None) -> _Replica:
+        """Register a fresh replica (local engine or ``RemoteReplica``) into
+        the roster. Join happens at serve() boundaries only: a serve in
+        flight holds per-replica threads, metric handles and rng state
+        sized to the roster it started with."""
+        with self._lock:
+            if self._serve_state is not None:
+                raise RuntimeError(
+                    "join during an in-flight serve() is not supported; "
+                    "join between serve() calls")
+            rep = _Replica(len(self.replicas), engine, role=role)
+            if rep.role not in ("prefill", "decode", "mixed"):
+                raise ValueError(
+                    f"joining replica: role must be prefill|decode|mixed, "
+                    f"got {rep.role!r}")
+            if self.disagg:
+                ref = self.replicas[0].engine
+                e = engine
+                if (e.config.kv_block_size != ref.config.kv_block_size
+                        or e.pool.quant != ref.pool.quant
+                        or e.pool.k.dtype != ref.pool.k.dtype):
+                    raise ValueError(
+                        "joining replica must share the KV-pool layout: "
+                        f"(bs={e.config.kv_block_size}, quant={e.pool.quant}, "
+                        f"dtype={e.pool.k.dtype}) vs replica 0 (bs="
+                        f"{ref.config.kv_block_size}, quant={ref.pool.quant}, "
+                        f"dtype={ref.pool.k.dtype})")
+            self.replicas.append(rep)
+            return rep
 
     def _accept(self, rep: _Replica, S: _Serve, u: int, t: int) -> None:
         """Record token t for uid u on rep; retire the row if done. Lock
@@ -707,6 +899,14 @@ class ServingRouter:
                 else:
                     ticket.status = "failed"
                     self.migration_failures += 1
+                    if src_rep.dead and S.outputs.get(ticket.idx) is None:
+                        # a dead source cannot resume the request mixed —
+                        # re-admit it from scratch on a survivor instead
+                        # (the never-dropped invariant outranks the lost
+                        # prefix reuse)
+                        S.affinity[ticket.idx] = None
+                        S.pending.appendleft(ticket.idx)
+                        self.readmits_dead += 1
             if ok:
                 if rep.tracker is not None:
                     rep.tracker.admit(ticket.idx, new_uid, now=now)
@@ -760,9 +960,25 @@ class ServingRouter:
                     if rep.tracker is not None:
                         rep.tracker.migrate_retry(t.idx)
                     with self._lock:
+                        dst = self.replicas[t.dst]
+                        if dst.dead or dst.draining:
+                            # the destination left the roster mid-retry:
+                            # re-aim the ticket at a live one
+                            nd = self._migration_target(rep)
+                            if nd is None:
+                                # no live destination can host the window;
+                                # the request re-admits from scratch on
+                                # whatever survives
+                                rep.migrating.discard(t.idx)
+                                if S.outputs.get(t.idx) is None:
+                                    S.affinity[t.idx] = None
+                                    S.pending.appendleft(t.idx)
+                                continue
+                            t.dst = nd.index
+                            dst = nd
                         t.status = "inflight"
                         rep.tickets.append(t)
-                        self.replicas[t.dst].migrate_in.append(t)
+                        dst.migrate_in.append(t)
                     continue
                 with self._lock:
                     rep.migrating.discard(t.idx)
@@ -850,6 +1066,11 @@ class ServingRouter:
         if rep.tracker is not None:
             rep.tracker.emitted_batch(adm_rids, (1,) * len(adm_rids))
         with self._lock:
+            if rep.dead:
+                # heartbeat death folded in while this dispatch was in
+                # flight: _mark_dead already re-queued these requests on
+                # survivors, so the dead replica's tokens are discarded
+                return True
             for u, t in zip(adm_uids, toks):
                 self._accept(rep, S, u, t)
             # disagg hand-off: a prefill-pool replica's finished prefills
@@ -994,6 +1215,11 @@ class ServingRouter:
             "migrated_blocks": self.migrated_blocks,
             "migration_failures": self.migration_failures,
             "dispatches": [r.dispatches for r in self.replicas],
+            "dead": [r.index for r in self.replicas if r.dead],
+            "draining": [r.index for r in self.replicas if r.draining],
+            "dead_replicas": self.dead_replicas,
+            "drains": self.drains,
+            "readmits_dead": self.readmits_dead,
         }
 
     def reset_stats(self) -> None:

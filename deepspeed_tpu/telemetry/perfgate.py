@@ -52,6 +52,10 @@ HEADLINE_PATTERNS: Dict[str, Tuple[str, ...]] = {
     # step gate on the SAME median+MAD machinery as latency
     "numerics": ("wire_rel_err/*", "*divergence_detect_steps",
                  "*token_divergence_step"),
+    # cross-process serving fabric (ISSUE 18): the three wire costs a
+    # remote replica adds over a local one (bench_serving --remote)
+    "fabric": ("remote/dispatch_rtt_ms/p50", "remote/wire_migration_ms",
+               "remote/drain_handoff_ms"),
 }
 
 #: matched AFTER the headline patterns: derived ratios ride along with a

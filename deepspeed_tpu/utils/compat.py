@@ -224,3 +224,27 @@ def device_put_unaliased(arr, sharding):
         np.copyto(view, arr)
         arr = view
     return jax.device_put(arr, sharding)
+
+
+def host_copy_unaliased(tree):
+    """``jax.device_get`` into host memory the CALLER owns exclusively.
+
+    The D2H mirror of :func:`device_put_unaliased`. On the CPU backend
+    ``device_get`` of a committed array is ZERO-COPY — the numpy result is a
+    VIEW of the PJRT buffer. A donated step is supposed to copy rather than
+    alias when the input buffer has live external references, but executables
+    deserialized from the persistent compilation cache skip that protection
+    on this jax/XLA build (observed under
+    ``--xla_backend_optimization_level=1``, the test-harness setting): the
+    step writes THROUGH the view, so any ``device_get`` result that outlives
+    the next donated step — an async checkpoint writer's queued payload, the
+    snapshot boundary copy, a caller-held "state before" reference — silently
+    reads the LATER state. A torn/mutated host reference, not heap
+    corruption: the memory is PJRT-owned either way. ``np.array(copy=True)``
+    breaks the aliasing; every D2H that must stay frozen goes through here.
+    """
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda x: np.array(jax.device_get(x), copy=True) if x is not None else x,
+        tree)

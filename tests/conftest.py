@@ -36,7 +36,64 @@ force_cpu_backend()
 # Persistent compilation cache: the suite is dominated by XLA compiles of
 # near-identical tiny programs (round-2 verdict: 186 tests no longer fit one
 # 550 s run). Cache survives across pytest invocations in the repo tree.
-_CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_cache")
+#
+# Keyed per HEAD sha (ISSUE 18): jax's entry keys hash the traced program,
+# not the python that built it, so a source change that alters runtime
+# behavior without changing the HLO (donation tweaks, compile options read
+# from the environment, jax version-adjacent serialization drift) can serve
+# a stale executable across commits. One subdir per HEAD commit makes the
+# cache's validity domain explicit; stale sibling dirs (and pre-keying flat
+# entries) are pruned so the tree holds at most one commit's cache.
+_CACHE_ROOT = os.path.join(os.path.dirname(__file__), ".jax_cache")
+
+
+def _head_sha():
+    """Short HEAD sha of the repo this conftest sits in, or None when git
+    is unavailable / not a checkout (then the cache keys to 'nogit')."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10.0)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def jax_cache_dir(root=None, sha=None):
+    """The compilation-cache dir for one commit: ``<root>/<short-sha>``."""
+    return os.path.join(root or _CACHE_ROOT, sha or _head_sha() or "nogit")
+
+
+def _prune_stale_cache(keep, root=None):
+    """Remove sibling cache dirs from other commits and legacy flat cache
+    files from the pre-keyed layout. Returns the entry names removed."""
+    import shutil
+
+    root = root or _CACHE_ROOT
+    if not os.path.isdir(root):
+        return []
+    removed = []
+    for entry in os.listdir(root):
+        path = os.path.join(root, entry)
+        if os.path.abspath(path) == os.path.abspath(keep):
+            continue
+        try:
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.remove(path)
+            removed.append(entry)
+        except OSError:
+            pass  # racing a parallel pytest: its key is the same sha anyway
+    return removed
+
+
+_CACHE_DIR = jax_cache_dir()
+_prune_stale_cache(keep=_CACHE_DIR)
 jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

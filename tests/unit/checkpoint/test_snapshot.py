@@ -56,7 +56,10 @@ def _train(engine, steps, seed0=0):
 def _state_leaves(engine):
     tree = {"params": engine.state.params,
             "opt": engine.canonical_opt_state(engine.state.opt_state)}
-    return [np.asarray(x) for x in jax.tree_util.tree_leaves(jax.device_get(tree))]
+    # deep copies, not device_get views — these references outlive later
+    # donated train steps (utils.compat.host_copy_unaliased)
+    return [np.array(x, copy=True)
+            for x in jax.tree_util.tree_leaves(jax.device_get(tree))]
 
 
 def _assert_state_equal(a, b):
@@ -191,7 +194,7 @@ def test_mesh_reshape_restore_8_to_4_and_1(devices, tmp_path):
     tag = snap.latest_tag(str(tmp_path))
 
     _train(e8, 2, seed0=100)  # uninterrupted continuation -> baseline
-    baseline = jax.device_get(e8.state.params)
+    baseline = jax.device_get(e8.state.params)  # e8 never steps again
 
     def submesh(n):
         shape = [1] * len(MESH_AXES)

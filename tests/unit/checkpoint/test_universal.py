@@ -18,6 +18,7 @@ from deepspeed_tpu.checkpoint import (
     get_checkpoint_engine,
     get_fp32_state_dict_from_checkpoint,
 )
+from deepspeed_tpu.utils.compat import host_copy_unaliased
 from tests.unit.simple_model import random_batch, simple_model_spec
 
 
@@ -114,7 +115,9 @@ def test_regular_checkpoint_roundtrip_and_latest(devices, tmp_path):
     _train(e, 3)
     e.save_checkpoint(d, client_state={"epoch": 7})
     import jax
-    saved = jax.device_get(e.state.params)  # train_batch donates state buffers
+    # deep copy, not a device_get view: later donated train steps can write
+    # through the zero-copy view (utils.compat.host_copy_unaliased)
+    saved = host_copy_unaliased(e.state.params)
     _train(e, 2)  # drift
     path, client = e.load_checkpoint(d)
     assert path is not None and client["epoch"] == 7
@@ -136,7 +139,9 @@ def test_async_checkpoint_engine(devices, tmp_path):
 
     save_checkpoint(e, d, checkpoint_engine=eng)  # returns before durable
     import jax
-    saved = jax.device_get(e.state.params)  # train_batch donates state buffers
+    # deep copy, not a device_get view: later donated train steps can write
+    # through the zero-copy view (utils.compat.host_copy_unaliased)
+    saved = host_copy_unaliased(e.state.params)
     _train(e, 1)  # overlaps with the background write
     eng.commit("")  # durability barrier before reading
     e.load_checkpoint(d)

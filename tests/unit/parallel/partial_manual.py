@@ -1,17 +1,42 @@
-"""Shared xfail marker for pipeline tests hitting the upstream
-partial-manual shard_map bug.
+"""Shared knowledge of the upstream partial-manual shard_map bug.
 
 Partial-manual shard_map (manual subset of >1-sized mesh axes) is broken on
 this jax 0.4.37/XLA — the SPMD partitioner rejects the PartitionId
 instruction that pipeline_spmd's ppermute lowering emits under ``auto=``
-(see CHANGES PR 2). xfail(strict=False) keeps tier-1 green on the known bug
-while still surfacing any *new* failure mode in the marked tests. Delete
-this module (and the marks) when the jax/XLA stack is upgraded past the bug.
+(see CHANGES PR 2). Everything that must recognize the bug imports it from
+here: the pytest xfail marker for the pipeline tests, and
+``__graft_entry__``'s dryrun A, which skips-with-reason instead of failing
+the whole multichip sweep on a known-upstream lowering hole. Delete this
+module (and both call sites) when the jax/XLA stack is upgraded past the
+bug.
+
+The classifier and reason string live ABOVE the pytest import on purpose:
+``__graft_entry__`` runs outside the test harness and must not require
+pytest to be importable.
 """
 
-import pytest
-
-partial_manual_xfail = pytest.mark.xfail(
-    strict=False,
-    reason="upstream jax 0.4.37/XLA: PartitionId unsupported under partial-manual shard_map",
+PARTIAL_MANUAL_REASON = (
+    "upstream jax 0.4.37/XLA: PartitionId unsupported under partial-manual "
+    "shard_map"
 )
+
+
+def is_partition_id_error(exc: BaseException) -> bool:
+    """True when ``exc`` is the upstream PartitionId lowering failure.
+
+    XLA surfaces it as a generic error type whose message names the
+    rejected instruction, so classification is by message — checked against
+    ``type: message`` so a hypothetical exception TYPE named PartitionId
+    would also match.
+    """
+    return "PartitionId" in f"{type(exc).__name__}: {exc}"
+
+
+try:
+    import pytest
+except ImportError:  # pragma: no cover - non-test consumers (dryrun entry)
+    pytest = None
+
+if pytest is not None:
+    partial_manual_xfail = pytest.mark.xfail(
+        strict=False, reason=PARTIAL_MANUAL_REASON)

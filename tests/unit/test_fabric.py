@@ -1,0 +1,270 @@
+"""Cross-process serving fabric tests (ISSUE 18).
+
+Contract under test:
+  - wire serialization is byte-VERBATIM: arrays (bf16, int8 + quant
+    scales) and PRNG keys round-trip ``fabric/wire.py`` bit-identically;
+  - multi-host snapshot writes: ``partition_atoms`` is deterministic and
+    balanced, non-zero ranks publish part dirs, rank 0 merges into ONE
+    committed snapshot that loads bit-identically via unchanged loaders;
+  - preemption: ``PreemptionGuard`` latches SIGTERM without killing the
+    step, ``assert_deterministic_batch_fn`` rejects a nondeterministic
+    stream, and the elastic agent relaunches (not drops) a host that
+    exits with ``EXIT_PREEMPTED``;
+  - liveness: a replica whose engine reports dead mid-serve has its
+    admitted requests re-queued and completed on survivors (never
+    dropped); ``faultinject.kill_replica_daemon`` hard-kills a process;
+  - the multi-process integration smoke (``tools/fabric_smoke.py
+    --smoke``): real replica-daemon processes behind an unchanged
+    ServingRouter — remote greedy decode token-identical to a local
+    engine for bf16 AND int8 KV, cross-process migration preserves
+    per-block digests, drain completes without drops, and the merged
+    trace links request flows across >= 2 pids through serve:dispatch.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.checkpoint import snapshot as snap
+from deepspeed_tpu.diagnostics import FaultInjector
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+from deepspeed_tpu.elasticity.resilience import (
+    EXIT_PREEMPTED,
+    PreemptionGuard,
+    assert_deterministic_batch_fn,
+)
+from deepspeed_tpu.fabric import wire
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# ------------------------------------------------------------------- wire
+@pytest.mark.parametrize("dtype", ["bfloat16", "int8", "float32"])
+def test_wire_array_roundtrip_bit_identical(dtype):
+    rng = np.random.default_rng(0)
+    if dtype == "int8":
+        a = rng.integers(-128, 128, size=(3, 16, 4), dtype=np.int8)
+    else:
+        a = np.asarray(rng.standard_normal((3, 16, 4)), jnp.dtype(dtype))
+    doc = wire.array_to_wire(a)
+    json.dumps(doc)  # must be JSON-transportable as-is
+    back = wire.array_from_wire(doc)
+    assert back.dtype == a.dtype and back.shape == a.shape
+    assert a.tobytes() == back.tobytes()  # byte-verbatim, not just close
+
+
+def test_wire_export_roundtrip_preserves_buffers():
+    from deepspeed_tpu.inference.paged import MigrationBuffer
+
+    rng = np.random.default_rng(1)
+    buf = MigrationBuffer(
+        k=rng.integers(-128, 128, size=(2, 4, 16, 2, 8), dtype=np.int8),
+        v=rng.integers(-128, 128, size=(2, 4, 16, 2, 8), dtype=np.int8),
+        k_scale=np.asarray(rng.standard_normal((2, 4, 16, 2, 1)), np.float32),
+        v_scale=np.asarray(rng.standard_normal((2, 4, 16, 2, 1)), np.float32))
+    export = {"buffer": buf, "n_blocks": 4, "pages": [0, 1, 2, 3],
+              "seen_tokens": 37, "kv_dtype": "int8", "quant": "int8",
+              "block_size": 16}
+    doc = json.loads(json.dumps(wire.export_to_wire(export)))
+    back = wire.export_from_wire(doc)
+    assert back["seen_tokens"] == 37 and back["n_blocks"] == 4
+    b2 = back["buffer"]
+    for name in ("k", "v", "k_scale", "v_scale"):
+        assert getattr(buf, name).tobytes() == np.asarray(
+            getattr(b2, name)).tobytes()
+
+
+def test_wire_key_roundtrip():
+    key = jax.random.fold_in(jax.random.PRNGKey(42), 7)
+    back = wire.key_from_wire(json.loads(json.dumps(wire.key_to_wire(key))))
+    assert np.array_equal(np.asarray(key), np.asarray(back))
+    # and it still works as a key
+    jax.random.uniform(back)
+
+
+# --------------------------------------------------- multi-host snapshots
+def test_partition_atoms_deterministic_and_balanced():
+    atoms = {f"a{i}": np.zeros((i + 1, 64), np.float32) for i in range(7)}
+    p2 = snap.partition_atoms(atoms, 2)
+    assert snap.partition_atoms(atoms, 2) == p2  # deterministic
+    assert sorted(sum(p2, [])) == sorted(atoms)  # exact cover
+    weights = [sum(atoms[k].nbytes for k in part) for part in p2]
+    # greedy largest-first keeps the bins within one largest-atom of even
+    assert abs(weights[0] - weights[1]) <= max(a.nbytes for a in atoms.values())
+    assert snap.partition_atoms(atoms, 1) == [sorted(atoms)]
+    with pytest.raises(ValueError):
+        snap.partition_atoms(atoms, 0)
+
+
+def test_multiprocess_snapshot_write_merges_parts(tmp_path):
+    """Rank 1 publishes its part; rank 0 merges into ONE snapshot whose
+    unchanged loader returns the full atom tree bit-identically."""
+    rng = np.random.default_rng(3)
+    atoms = {f"k{i}": np.asarray(rng.standard_normal((8 + i, 6)), np.float32)
+             for i in range(5)}
+    meta = {"step": 4, "source_mesh": {"dp": 2}, "zero_stage": 1}
+    part = snap.write_snapshot(atoms, meta, str(tmp_path), "step000004",
+                               process_index=1, process_count=2, fsync=False)
+    assert os.path.basename(part) == "step000004.part1"
+    assert snap.list_snapshots(str(tmp_path)) == []  # parts never listed
+    final = snap.write_snapshot(atoms, meta, str(tmp_path), "step000004",
+                                process_index=0, process_count=2,
+                                part_timeout_s=10.0, fsync=False)
+    assert snap.latest_tag(str(tmp_path)) == "step000004"
+    assert not os.path.exists(part)  # rank 0 reclaimed the merged part
+    got, manifest = snap.load_snapshot_atoms(str(tmp_path), "step000004")
+    assert manifest["writer_processes"] == 2
+    assert set(got) == set(atoms)
+    for k in atoms:
+        assert atoms[k].tobytes() == got[k].tobytes()
+    assert final.endswith("step000004")
+
+
+def test_multiprocess_snapshot_times_out_on_missing_part(tmp_path):
+    atoms = {"a": np.zeros((4,), np.float32)}
+    with pytest.raises(snap.SnapshotError, match="timed out"):
+        snap.write_snapshot(atoms, {"step": 1}, str(tmp_path), "step000001",
+                            process_index=0, process_count=2,
+                            part_timeout_s=0.2, fsync=False)
+
+
+# ------------------------------------------------------------- preemption
+def test_preemption_guard_latches_and_uninstalls():
+    guard = PreemptionGuard(signals=(signal.SIGUSR1,))
+    try:
+        assert not guard.requested
+        os.kill(os.getpid(), signal.SIGUSR1)
+        time.sleep(0.05)
+        assert guard.requested  # latched, process NOT killed
+    finally:
+        guard.uninstall()
+
+
+def test_assert_deterministic_batch_fn():
+    assert_deterministic_batch_fn(
+        lambda step: {"x": np.full((2,), step, np.float32)}, 3)
+    state = {"n": 0}
+
+    def nondet(step):
+        state["n"] += 1
+        return {"x": np.full((2,), state["n"], np.float32)}
+
+    with pytest.raises(ValueError, match="DETERMINISTIC"):
+        assert_deterministic_batch_fn(nondet, 0)
+
+
+def test_elastic_agent_relaunches_preempted_host():
+    """Exit code 143 (preemption-clean) must RELAUNCH the host, not drop
+    it — roster intact, next generation at the same world size."""
+    launches = []
+
+    def _proc(code):
+        return subprocess.Popen([sys.executable, "-c",
+                                 f"import sys; sys.exit({code})"])
+
+    def launch(hosts, gen, cfg):
+        launches.append(sorted(hosts))
+        # generation 0: host 'b' is preempted; generation 1: all succeed
+        return {h: _proc(EXIT_PREEMPTED if (gen == 0 and h == "b") else 0)
+                for h in hosts}
+
+    agent = DSElasticAgent(
+        {"a": 4, "b": 4},
+        {"enabled": True, "max_train_batch_size": 48,
+         "micro_batch_sizes": [1, 2, 4], "min_gpus": 1, "max_gpus": 64},
+        launch, max_restarts=2, poll_interval_s=0.05)
+    result = agent.run()
+    assert result.ok and result.generation == 1
+    assert launches == [["a", "b"], ["a", "b"]]  # roster NEVER shrank
+    gen0 = agent.history[0]
+    assert not gen0.ok and gen0.preempted == ["b"]
+    assert gen0.returncodes["b"] == EXIT_PREEMPTED
+
+
+# --------------------------------------------------------------- liveness
+def test_kill_replica_daemon_sigkills_process():
+    proc = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(120)"])
+    fi = FaultInjector()
+    pid = fi.kill_replica_daemon(proc)
+    assert pid == proc.pid
+    assert proc.returncode == -signal.SIGKILL
+    assert fi.daemon_kills_fired == 1
+
+
+def test_router_readmits_requests_of_dead_replica():
+    """A replica whose engine reports dead mid-serve (the heartbeat path:
+    ``engine.alive`` False) is removed from the roster and its admitted
+    requests complete on the survivor — never dropped."""
+    from deepspeed_tpu.fabric.replica_daemon import _build_model
+    from deepspeed_tpu.inference.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.inference.router import ServingRouter
+
+    mc, params = _build_model()
+    cfg = {"dtype": "bf16", "kv_block_size": 16, "num_kv_blocks": 96,
+           "max_seqs": 2}
+    engines = [InferenceEngineV2(mc, params, dict(cfg)) for _ in range(2)]
+    router = ServingRouter(engines, dispatch="threads")
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(1, 512, size=12).astype(np.int32)
+               for _ in range(4)]
+    box = {}
+
+    def run():
+        box["outs"] = router.serve(prompts, max_new_tokens=24)
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.time() + 120.0
+    flipped = False
+    while time.time() < deadline and t.is_alive():
+        if router.replicas[1].active:
+            engines[1].alive = False  # what a missed-heartbeat limit sets
+            flipped = True
+            break
+        time.sleep(0.005)
+    t.join(600.0)
+    assert not t.is_alive()
+    outs = box["outs"]
+    assert len(outs) == len(prompts) and all(o is not None for o in outs)
+    if flipped:  # death landed while it still held work
+        assert router.dead_replicas == 1
+        assert router.stats()["dead"] == [1]
+
+
+# -------------------------------------------- multi-process fabric smoke
+def test_multiprocess_fabric_smoke(tmp_path):
+    """The acceptance gate: real replica-daemon processes driven by an
+    unchanged ServingRouter. Remote greedy decode token-identical to a
+    local engine (bf16 AND int8 KV), cross-process migration preserves
+    per-block blake2b digests, drain completes without drops, and the
+    merged trace links flows from >= 2 pids through serve:dispatch."""
+    from tests.conftest import _CACHE_DIR
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "fabric_smoke.py"),
+         "--smoke", "--out", str(tmp_path)],
+        capture_output=True, timeout=1500,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             # daemons reuse the suite's keyed compile cache across runs
+             "JAX_COMPILATION_CACHE_DIR": _CACHE_DIR},
+        cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stdout.decode() + out.stderr.decode()[-800:]
+    doc = json.loads(out.stdout.decode().strip().splitlines()[-1])
+    assert doc["ok"] and not doc["leg_failures"]
+    assert doc["tokens_identical_bf16"] and doc["migrations_bf16"] >= 1
+    assert doc["tokens_identical_int8"] and doc["migrations_int8"] >= 1
+    assert doc["digests_identical"] and doc["digest_blocks"] >= 1
+    assert doc["drain_complete"] and doc["drain_ok"]
+    assert doc["trace_ok"] and doc["trace_dispatch_pids"] >= 2

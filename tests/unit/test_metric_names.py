@@ -28,6 +28,7 @@ ALLOWED_SUBSYSTEMS = {
     "comm",
     "compile",
     "data",
+    "fabric",
     "fleet",
     "flops",
     "hbm",
@@ -132,7 +133,13 @@ def test_lint_scans_telemetry_and_serving_sources():
                   # disagg serving (ISSUE 14): migration transport rides the
                   # serving metric families minted in router/lifecycle
                   "migrate.py")
+    } | {
+        # cross-process serving fabric (ISSUE 18): the remote proxy and the
+        # daemon mint the fabric/* RPC + liveness series
+        os.path.join("deepspeed_tpu", "fabric", f)
+        for f in ("remote.py", "replica_daemon.py")
     } | {os.path.join("tools", "bench_serving.py"),
+         os.path.join("tools", "fabric_smoke.py"),
          os.path.join("tools", "fleet_smoke.py"),
          os.path.join("tools", "numerics_smoke.py"),
          os.path.join("tools", "trace_merge.py")}
@@ -175,7 +182,13 @@ def test_known_names_pass_and_bad_names_fail():
                  "numerics/ef_residual_norm", "numerics/divergence_events",
                  "numerics/digest_checksum", "numerics/digest_gap",
                  "numerics/kv_dequant_rel_err", "numerics/woq_matmul_rel_err",
-                 "numerics/spec_accept_alarm"):
+                 "numerics/spec_accept_alarm",
+                 # cross-process serving fabric (ISSUE 18): remote-replica
+                 # RPC/liveness series and the router's roster-change events
+                 "fabric/rpcs", "fabric/rpc_ms", "fabric/heartbeat_misses",
+                 "fabric/dead_replicas", "fabric/wire_migration_ms",
+                 "fabric/wire_bytes", "fabric/drains", "fabric/preempts",
+                 "router/dead_replicas", "router/drains"):
         assert _check_name(good) is None, good
     for bad in ("ttft", "Serving/ttft", "serving ttft", "{x}/y", "bogus/name"):
         assert _check_name(bad) is not None, bad

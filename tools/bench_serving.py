@@ -982,12 +982,106 @@ def router_smoke(replicas=2) -> Dict:
     return out
 
 
-def _emit_perf_ledger(payload: dict) -> None:
+def bench_remote(n_rtt=40, n_new=24, chain=8) -> Dict:
+    """Cross-process serving-fabric bench (ISSUE 18): replica DAEMONS in
+    other OS processes behind the unchanged router, measuring the three
+    costs the fabric adds over a local replica — per-dispatch RPC RTT,
+    wire KV migration (quantized bytes verbatim), and a mid-burst drain
+    handoff. Rows land under perf-ledger suite ``fabric``."""
+    import statistics
+    import tempfile
+    import threading
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from fabric_smoke import _engine_cfg, _prompts, shutdown_daemon, spawn_daemon
+
+    import jax
+
+    from deepspeed_tpu.fabric.remote import RemoteReplica, _get
+    from deepspeed_tpu.fabric.wire import export_to_wire
+    from deepspeed_tpu.inference.router import ServingRouter
+
+    out_dir = tempfile.mkdtemp(prefix="bench_remote_")
+    run_id = f"bench-remote-{os.getpid():x}"
+    da = spawn_daemon(1, run_id, _engine_cfg(), out_dir)
+    db = spawn_daemon(2, run_id, _engine_cfg(), out_dir)
+    ra = rb = None
+    try:
+        ra = RemoteReplica(da.url, start_heartbeat=False)
+        rb = RemoteReplica(db.url, start_heartbeat=False)
+        # --- dispatch RTT: the fixed per-hop tax every remote dispatch pays
+        rtts = []
+        for _ in range(n_rtt):
+            t0 = time.perf_counter()
+            _get(da.url, "/healthz", timeout=5.0)
+            rtts.append((time.perf_counter() - t0) * 1e3)
+        rtts.sort()
+        # --- wire migration: export a live request on A, import on B
+        prompt = _prompts(n=1)[0]
+        suffix = ra.try_admit(21, prompt, [], [])
+        rng = jax.random.PRNGKey(0)
+        toks, rng = ra._put_sample([21], [suffix.tolist()], rng,
+                                   (("do_sample", False),))
+        ra.decode_chain([21], [int(np.asarray(toks).ravel()[0])],
+                        [n_new], chain, rng)
+        t0 = time.perf_counter()
+        export = ra.export_request(21)
+        imported = rb.import_request(22, export)
+        wire_ms = (time.perf_counter() - t0) * 1e3
+        wire_bytes = len(json.dumps(export_to_wire(export)))
+        ra.flush(21)
+        rb.flush(22)
+        # --- drain handoff: quiesce daemon A mid-burst; its in-flight
+        # requests migrate to B over the same wire plane
+        router = ServingRouter([ra, rb])
+        box: Dict = {}
+
+        def run():
+            box["outs"] = router.serve(_prompts(), max_new_tokens=48)
+
+        t = threading.Thread(target=run)
+        t.start()
+        deadline = time.time() + 120.0
+        while time.time() < deadline and not router.replicas[0].active:
+            time.sleep(0.002)
+        t_drain = time.perf_counter()
+        router.request_drain(0)
+        while time.time() < deadline and (router.replicas[0].active
+                                          or router.replicas[0].migrating):
+            time.sleep(0.002)
+        drain_ms = (time.perf_counter() - t_drain) * 1e3
+        t.join(600.0)
+        outs = box.get("outs") or []
+        return {
+            "replicas": 2, "transport": "http/json",
+            "dispatch_rtt_ms": {
+                "p50": round(statistics.median(rtts), 3),
+                "p95": round(rtts[int(0.95 * (len(rtts) - 1))], 3),
+                "n": n_rtt,
+            },
+            "wire_migration_ms": round(wire_ms, 3),
+            "wire_kv_bytes": wire_bytes,
+            "wire_import_ok": bool(imported),
+            "drain_handoff_ms": round(drain_ms, 3),
+            "drain_handoffs": router.stats()["migrations"],
+            "completed": sum(1 for o in outs if o is not None),
+            "requests": len(outs),
+        }
+    finally:
+        for r in (ra, rb):
+            if r is not None:
+                r.close()
+        shutdown_daemon(da)
+        shutdown_daemon(db)
+
+
+def _emit_perf_ledger(payload: dict, suite: str = "serving") -> None:
     """Append this run's numeric tree to the unified perf ledger, suite
     ``serving`` (ISSUE 16) — the SAME flattener migration uses on the
     legacy SERVING_rNN artifacts, so a number emitted today and one
-    migrated from r12 are directly comparable rows. Best-effort: the bench
-    must never fail because the ledger dir is unwritable."""
+    migrated from r12 are directly comparable rows. The fabric bench
+    (``--remote``) lands under suite ``fabric`` instead. Best-effort: the
+    bench must never fail because the ledger dir is unwritable."""
     try:
         import time as _time
 
@@ -998,7 +1092,7 @@ def _emit_perf_ledger(payload: dict) -> None:
         from deepspeed_tpu.telemetry.perfmigrate import rows_from_tree
 
         rows = rows_from_tree(
-            "serving", payload, round=default_round(),
+            suite, payload, round=default_round(),
             backend=default_backend(), run_id=get_identity().run_id,
             git_sha=resolve_git_sha(), time_unix=_time.time())
         # Token-divergence steps additionally land under suite "numerics"
@@ -1063,8 +1157,23 @@ def main() -> None:
                          "nonzero unless zero dropped-but-admitted, >=1 "
                          "migration, and migrated output token-identical "
                          "to a never-migrated run on bf16 AND int8 pools")
+    ap.add_argument("--remote", action="store_true",
+                    help="run the cross-process fabric bench: replica "
+                         "daemons in separate OS processes (dispatch RTT, "
+                         "wire KV migration, drain handoff; perf-ledger "
+                         "suite 'fabric')")
     ap.add_argument("--output", type=str, default=None)
     args = ap.parse_args()
+
+    if args.remote:
+        res = {"remote": bench_remote(chain=args.chain)}
+        text = json.dumps(res, indent=2)
+        print(text)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text + "\n")
+        _emit_perf_ledger(res, suite="fabric")
+        sys.exit(0)
 
     if args.disagg_smoke:
         res = disagg_smoke()

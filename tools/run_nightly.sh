@@ -195,6 +195,35 @@ numerics_rc=${PIPESTATUS[0]}
 [ "${numerics_rc}" -ne 0 ] && rc=1
 echo "# numerics smoke: ${NUMERICS_OUT} (exit ${numerics_rc})" >> "${OUT}"
 
+# Cross-process serving fabric smoke (ISSUE 18): real replica-daemon
+# processes behind the unchanged router. Exit-gates: remote greedy decode
+# token-identical to a local engine on bf16 AND int8 KV, cross-process
+# migration preserves every per-block blake2b digest, drain completes
+# without drops, merged trace links >= 2 pids through serve:dispatch,
+# a SIGKILLed daemon mid-burst loses ZERO admitted requests, and a
+# SIGTERMed trainer (exit 143) restarts bit-identically — including onto
+# a different mesh shape (dp=2 -> dp=4). Committed as its own artifact so
+# the fabric's liveness/identity story is auditable per round.
+FABRIC_OUT="FABRIC_${ROUND}.log"
+{
+  echo "# serving fabric smoke — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  echo "# HEAD: ${HEAD_SHA}"
+  echo "# uncommitted-diff sha256: ${DIFF_SHA}"
+  echo "# cmd: python tools/fabric_smoke.py --out telemetry_out/fabric"
+} > "${FABRIC_OUT}"
+JAX_PLATFORMS=cpu python tools/fabric_smoke.py \
+  --out telemetry_out/fabric 2>/dev/null | tee -a "${FABRIC_OUT}"
+fabric_rc=${PIPESTATUS[0]}
+[ "${fabric_rc}" -ne 0 ] && rc=1
+echo "# fabric smoke: ${FABRIC_OUT} (exit ${fabric_rc})" >> "${OUT}"
+
+# Fabric wire-cost bench: remote dispatch RTT / wire KV migration / drain
+# handoff as perf-ledger suite "fabric" rows (gated by the perf stage once
+# history reaches quorum).
+JAX_PLATFORMS=cpu python tools/bench_serving.py --remote 2>/dev/null \
+  | tail -20 | sed 's/^/bench-remote: /' | tee -a "${FABRIC_OUT}"
+[ "${PIPESTATUS[0]}" -ne 0 ] && { fabric_rc=1; rc=1; }
+
 # Perf-gate stage (ISSUE 16): (a) migrate-check — the committed ledger must
 # still cover every legacy *_rNN.json artifact; (b) the noise-aware gate
 # must PASS at HEAD against the committed history; (c) the same gate must
@@ -236,8 +265,8 @@ echo "# perf gate exit: ${perfgate_rc}" >> "${PERFGATE_OUT}"
 echo "# perf gate: ${PERFGATE_OUT} (exit ${perfgate_rc})" >> "${OUT}"
 
 {
-  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, disagg smoke: ${disagg_rc}, moe smoke: ${moe_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc}, numerics smoke: ${numerics_rc}, perf gate: ${perfgate_rc})"
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, disagg smoke: ${disagg_rc}, moe smoke: ${moe_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc}, numerics smoke: ${numerics_rc}, fabric smoke: ${fabric_rc}, perf gate: ${perfgate_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
-echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT} ${DISAGG_OUT} ${MOE_OUT} ${NUMERICS_OUT} ${PERFGATE_OUT}"
+echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT} ${DISAGG_OUT} ${MOE_OUT} ${NUMERICS_OUT} ${FABRIC_OUT} ${PERFGATE_OUT}"
 exit "${rc}"
