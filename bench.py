@@ -1339,6 +1339,104 @@ def bench_numerics_overhead():
     }
 
 
+def bench_schedule_compiler():
+    """ISSUE 19 evidence: the two ``schedule``-suite headline rows.
+
+    1. ``compiled_vs_hand/pred_ratio`` — the schedule compiler's best
+       synthesized program vs the best hand-written algorithm, both costed
+       by the SAME (possibly refit-calibrated) cost model, at the
+       representative int8 1 MB all_reduce query. Drifting UP means the
+       search started losing to its own baseline — a compiler regression
+       the noise-aware gate catches without any hardware in the loop.
+    2. ``fused_gemm/step_time_ratio`` — fused all-gather+matmul forward+
+       backward step vs the unfused composition on the live backend (the
+       T3 payoff row; on TPU < 1.0 is the win, interpret-mode CPU values
+       are per-backend trajectories only).
+
+    Rows go straight to perf-ledger suite ``schedule``
+    (``perfgate.HEADLINE_PATTERNS["schedule"]``), like the sweep's
+    ``coll-sweep`` rows."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from deepspeed_tpu.collectives import fused_gemm, schedule, selector
+    from deepspeed_tpu.collectives.algorithms import ALGORITHMS
+    from deepspeed_tpu.parallel import zeropp
+    from deepspeed_tpu.utils.compat import shard_map
+
+    devs = jax.devices()
+    n = max(len(devs), 1)
+    nbytes, codec = 1 << 20, "int8"
+    cm = selector.cost_model()
+    hand = min(
+        selector.estimate_us("all_reduce", alg, codec, nbytes, n)
+        for alg in ALGORITHMS
+        if not (alg == "rhd" and (n & (n - 1))))
+    sched = schedule.compile_schedule("all_reduce", (("dp", n),), nbytes,
+                                      codec, cm=cm)
+    pred_ratio = (sched.est_us / hand) if (sched and hand > 0) else 1.0
+
+    mesh = Mesh(np.array(devs), ("fsdp",))
+    M, Ks, N = 64, 64, 128
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(M, n * Ks)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(n * Ks, N)).astype(np.float32))
+
+    def step_fn(xv, wv):
+        def loss(a, b):
+            y = zeropp.sharded_matmul(a, b, "fsdp", False, 256)
+            return jnp.sum(y * y)
+
+        return jax.grad(loss, argnums=1)(xv, wv)
+
+    def clock(fused):
+        fused_gemm.configure(enabled=fused)
+        f = jax.jit(shard_map(step_fn, mesh=mesh, in_specs=(P(), P("fsdp")),
+                              out_specs=P("fsdp"), check_vma=False))
+        np.asarray(f(x, w))  # compile off the clock
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = f(x, w)
+        np.asarray(out)
+        return time.perf_counter() - t0
+
+    try:
+        t_unfused = clock(False)
+        t_fused = clock(True)
+    finally:
+        fused_gemm.configure(enabled=False)
+    step_ratio = t_fused / t_unfused if t_unfused > 0 else 1.0
+
+    result = {
+        "world": n,
+        "compiled_signature": sched.signature if sched else None,
+        "compiled_pred_us": round(sched.est_us, 3) if sched else None,
+        "hand_pred_us": round(hand, 3),
+        "pred_ratio": round(pred_ratio, 4),
+        "ms_step_unfused": round(t_unfused / 5 * 1e3, 3),
+        "ms_step_fused": round(t_fused / 5 * 1e3, 3),
+        "step_time_ratio": round(step_ratio, 4),
+    }
+    try:
+        from deepspeed_tpu.telemetry.perfledger import PerfLedger, make_row
+
+        backend = jax.default_backend()
+        PerfLedger().append([
+            make_row("schedule", "compiled_vs_hand/pred_ratio", pred_ratio,
+                     "ratio", direction="lower", backend=backend),
+            make_row("schedule", "fused_gemm/step_time_ratio", step_ratio,
+                     "ratio", direction="lower", backend=backend),
+        ])
+    except Exception as e:  # noqa: BLE001 — evidence plane, not the bench
+        import sys
+
+        print(f"[bench] schedule-suite ledger append skipped: {e}",
+              file=sys.stderr)
+    return result
+
+
 # Confidence-ordered registry (safest first): a relay wedge mid-queue loses
 # everything after it, so known-good shapes go first and the big/novel
 # configs last. Each entry: name -> (fn(peak_flops)->dict, timeout_s).
@@ -1350,6 +1448,7 @@ EXTRA_BENCHES = {
     "fleet_export_overhead": (lambda peak: bench_fleet_overhead(), 420),
     "perf_ledger_overhead": (lambda peak: bench_perf_ledger_overhead(), 420),
     "numerics_overhead": (lambda peak: bench_numerics_overhead(), 420),
+    "schedule_compiler": (lambda peak: bench_schedule_compiler(), 420),
     "llama_550m_zero3_remat": (bench_train_llama_z3, 420),
     "mixtral_style_moe": (bench_train_moe, 420),
     "inference_v1_gpt2_125m": (lambda peak: bench_inference(), 420),

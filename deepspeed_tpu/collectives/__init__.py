@@ -53,3 +53,16 @@ from deepspeed_tpu.collectives.overlap import (
     double_buffered,
     double_buffered_scan,
 )
+from deepspeed_tpu.collectives.costmodel import (
+    CostModel,
+)
+from deepspeed_tpu.collectives.schedule import (
+    CompiledSchedule,
+    Level,
+    compile_schedule,
+    parse_signature,
+)
+from deepspeed_tpu.collectives.fused_gemm import (
+    all_gather_matmul,
+    matmul_reduce_scatter,
+)

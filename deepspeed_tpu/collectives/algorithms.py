@@ -645,7 +645,18 @@ def all_reduce(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
         raise ValueError(f"reduce op {op!r} unsupported by algorithmic all_reduce")
     axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
     flat = x.reshape(-1)
-    if algorithm in PALLAS_ALGORITHMS:
+    if algorithm == "compiled" or algorithm.startswith("compiled:"):
+        # synthesized hierarchical schedule (collectives/schedule.py): the
+        # level programs run the same sub-ring machinery as ring2d, so this
+        # branch only resolves WHICH levels
+        from deepspeed_tpu.collectives import schedule as _schedule
+
+        levels = _schedule.resolve(
+            algorithm, "all_reduce", axes, x.size * x.dtype.itemsize,
+            codec, x.dtype.itemsize, block_size)
+        out = (_schedule.compiled_all_reduce(x, levels, block_size).reshape(-1)
+               if levels else flat)
+    elif algorithm in PALLAS_ALGORITHMS:
         # same schedules, remote-DMA hops (fused quantized hops on the
         # reduce phases — see collectives/pallas_backend.py); axis tuples
         # run the mesh-axis-factored hierarchy like every other algorithm
@@ -680,6 +691,26 @@ def all_reduce(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
 
 def all_gather(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
                concat_axis: int = 0, block_size: Optional[int] = None) -> jax.Array:
+    if algorithm == "compiled" or algorithm.startswith("compiled:"):
+        # the schedule compiler is the ONE algorithmic gather that takes
+        # mesh-axis tuples: levels are rank-ordered (minor axis digit
+        # first), so the output matches lax.all_gather over the same tuple
+        from deepspeed_tpu.collectives import schedule as _schedule
+
+        axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+        levels = _schedule.resolve(
+            algorithm, "all_gather", axes, x.size * x.dtype.itemsize,
+            codec, x.dtype.itemsize, block_size)
+        n = 1
+        for a in axes:
+            n *= axis_size(a)
+        moved = jnp.moveaxis(x, concat_axis, 0)
+        lead, rest = moved.shape[0], moved.shape[1:]
+        flat = moved.reshape(-1)
+        gathered = (_schedule.compiled_all_gather_flat(flat, levels, block_size)
+                    if levels else flat)
+        full = gathered.reshape((n * lead,) + rest)
+        return jnp.moveaxis(full, 0, concat_axis)
     if isinstance(axis, (tuple, list)):
         if len(axis) != 1:
             raise ValueError(f"algorithmic all_gather takes one axis, got {axis}")
@@ -708,14 +739,42 @@ def reduce_scatter(x: jax.Array, axis, *, algorithm: str = "ring", codec="none",
                    scatter_axis: int = 0, op: str = "sum",
                    block_size: Optional[int] = None,
                    err: Optional[jax.Array] = None):
+    if err is not None and algorithm != "ring":
+        raise ValueError(
+            f"error feedback is implemented for algorithm='ring' only, got {algorithm!r}")
+    if algorithm == "compiled" or algorithm.startswith("compiled:"):
+        # tuple-axis capable, rank-ordered levels (see all_gather above);
+        # tiled psum_scatter semantics: rank i gets slice i of the sum
+        from deepspeed_tpu.collectives import schedule as _schedule
+
+        axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+        levels = _schedule.resolve(
+            algorithm, "reduce_scatter", axes, x.size * x.dtype.itemsize,
+            codec, x.dtype.itemsize, block_size)
+        n = 1
+        for a in axes:
+            n *= axis_size(a)
+        moved = jnp.moveaxis(x, scatter_axis, 0)
+        lead, rest = moved.shape[0], moved.shape[1:]
+        if lead % n:
+            raise ValueError(
+                f"reduce_scatter dim {lead} not divisible by axis size {n}")
+        rows = moved.reshape(n, -1)
+        red = (_schedule.compiled_reduce_scatter_rows(rows, levels, block_size)
+               if levels else rows.reshape(-1))
+        out = red.reshape((lead // n,) + rest).astype(x.dtype)
+        out = jnp.moveaxis(out, 0, scatter_axis)
+        if op in ("mean", "avg"):
+            out = out / n
+        elif op != "sum":
+            raise ValueError(
+                f"reduce op {op!r} unsupported by algorithmic reduce_scatter")
+        return out
     if isinstance(axis, (tuple, list)):
         if len(axis) != 1:
             raise ValueError(f"algorithmic reduce_scatter takes one axis, got {axis}")
         axis = axis[0]
     c = get_codec(codec, block_size)
-    if err is not None and algorithm != "ring":
-        raise ValueError(
-            f"error feedback is implemented for algorithm='ring' only, got {algorithm!r}")
     if algorithm in PALLAS_ALGORITHMS:
         # remote-DMA hops; a fusable codec runs the EQuARX fused hop kernel
         # (ring2d degrades to ring for a lone reduce-scatter, same as below)

@@ -1,0 +1,95 @@
+"""The ONE alpha-beta cost object shared by the selector, the schedule
+compiler, and the observatory calibrator.
+
+Before the schedule compiler existed, the alpha/beta constants lived as
+plain fields on ``selector.SelectorConfig`` and the observatory's refit
+wrote into its ``backend_ab`` dict. The compiler's search needs the same
+constants as its objective — and it must FEEL a refit immediately, or the
+measured-vs-predicted loop would tune a model the search no longer reads.
+So the constants live here, in one mutable ``CostModel`` instance that
+
+- ``selector.configure`` builds from the config block (sharing the
+  ``backend_ab`` dict with the installed ``SelectorConfig``, so existing
+  ``get_config().backend_ab`` consumers keep seeing calibrations),
+- ``selector.estimate_us`` charges from,
+- ``selector.calibrate`` (the observatory refit's landing point) writes
+  into, bumping :attr:`version` so schedule-compile caches invalidate, and
+- ``schedule.compile_schedule`` reads as its search objective via
+  ``selector.cost_model()`` — the SAME object, by identity.
+
+The per-hop charge is the classic point-to-point model::
+
+    T(hop) = alpha_us + wire_mb * beta_us_per_mb
+
+with per-backend (alpha, beta) overrides once the observatory has fit
+observed hop timings, and an optional per-tier beta scaling for
+hierarchical schedules (a GC3-style search only places codecs per phase
+when the tiers cost differently — on a real pod the outer links are the
+slow ones, which is exactly where an int8 wire pays).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+
+class CostModel:
+    """Mutable alpha-beta constants: static defaults + calibrated
+    per-backend overrides. NOT thread-safe on its own — the selector's lock
+    guards mutation (``calibrate``), and readers only do dict lookups."""
+
+    def __init__(self, alpha_us: float = 1.0, beta_us_per_mb: float = 10.0,
+                 pallas_alpha_scale: float = 0.5,
+                 backend_ab: Optional[Dict[str, Tuple[float, float]]] = None):
+        self.alpha_us = float(alpha_us)
+        self.beta_us_per_mb = float(beta_us_per_mb)
+        self.pallas_alpha_scale = float(pallas_alpha_scale)
+        # shared BY REFERENCE with selector.SelectorConfig.backend_ab: a
+        # refit through either handle is visible through both
+        self.backend_ab: Dict[str, Tuple[float, float]] = (
+            backend_ab if backend_ab is not None else {})
+        # beta multiplier per schedule level (innermost tier first); levels
+        # past the end reuse the last entry. Empty = every tier costs the
+        # same link. The schedule compiler's codec-placement search only
+        # has a gradient when this is non-flat (or a calibration is).
+        self.tier_beta_scale: Tuple[float, ...] = ()
+        # bumped on every mutation: schedule-compile caches key on it so a
+        # refit re-runs the search instead of serving stale winners
+        self.version = 0
+
+    def calibrate(self, backend: str, alpha_us: float,
+                  beta_us_per_mb: float) -> None:
+        self.backend_ab[backend] = (float(alpha_us), float(beta_us_per_mb))
+        self.version += 1
+
+    def set_tier_beta_scale(self, scales: Tuple[float, ...]) -> None:
+        self.tier_beta_scale = tuple(float(s) for s in scales)
+        self.version += 1
+
+    def constants(self, backend: str = "ppermute", *,
+                  discount: bool = False) -> Tuple[float, float]:
+        """(alpha_us, beta_us_per_mb) for one hop backend. ``discount``
+        applies the pallas per-hop launch discount to the STATIC alpha
+        (a calibration subsumes it, same as the selector always did)."""
+        fitted = self.backend_ab.get(backend)
+        if fitted is not None:
+            return fitted
+        alpha = self.alpha_us * (self.pallas_alpha_scale if discount else 1.0)
+        return alpha, self.beta_us_per_mb
+
+    def tier_beta(self, backend: str, depth: int, *,
+                  discount: bool = False) -> float:
+        """beta for a schedule level at ``depth`` (0 = innermost tier)."""
+        _, beta = self.constants(backend, discount=discount)
+        scales = self.tier_beta_scale
+        if not scales:
+            return beta
+        return beta * scales[min(depth, len(scales) - 1)]
+
+    def estimate_us(self, hops: float, wire_mb: float,
+                    backend: str = "ppermute", *,
+                    discount: bool = False) -> float:
+        """The flat two-term charge — what ``selector.estimate_us`` applies
+        to ``model_terms`` regressors."""
+        alpha, beta = self.constants(backend, discount=discount)
+        return hops * alpha + wire_mb * beta

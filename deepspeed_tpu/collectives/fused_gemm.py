@@ -1,0 +1,539 @@
+"""Fused matmul⇄collective Pallas kernels — the T3 endgame.
+
+Reference: T3 (arxiv 2401.16677) fuses a GEMM producer/consumer with the
+collective that feeds or drains it so the interconnect time hides behind
+the matmul's own compute; Google's GC3/async-collective work does the same
+inside XLA. Here the fusion is explicit: each ring hop is ONE Pallas
+kernel whose grid step ``j`` computes chunk ``j``'s partial matmul while
+chunk ``j``'s wire DMA is in flight — the 2-slot VMEM wire staging, DMA
+semaphore pairing, credit-based flow control, and entry barrier are the
+PR-8 EQuARX fused-hop pattern (``pallas_backend._fused_hop``) with the
+dequant-accumulate replaced by a ``dot_general``.
+
+Two fused ops:
+
+- :func:`all_gather_matmul` — ``y = x @ all_gather(w_shard, rows)``: the
+  ZeRO-3 weight gather fused into its consumer GEMM. Hop ``k`` holds one
+  originating rank's shard; while that shard's chunks stream to the next
+  neighbor the kernel contracts them against the matching ``x`` columns
+  (``out_block=True`` instead emits the independent output-column block
+  ``x @ held.T`` — the backward ``dx`` form, no accumulation).
+- :func:`matmul_reduce_scatter` — ``reduce_scatter(x @ w, rows)``: the
+  gradient-shard GEMM fused into its producer ring. Hop ``k`` computes the
+  outgoing row-block's partial product chunk-by-chunk, shipping chunk
+  ``j`` while chunk ``j+1`` computes.
+
+Both take an optional int8/fp8 wire codec (the shared ``ops.quant`` block
+math): the shard/partial crosses the interconnect quantized and is
+dequantized in the receiving kernel, ZeRO++-style. Exact wires stage the
+raw fp32 chunks through the same slots, so the fused result is
+bit-identical to the unfused composition on integer-valued payloads.
+
+Execution modes mirror ``pallas_backend``: compiled Mosaic on TPU,
+``interpret=True`` elsewhere (single-named-axis meshes only — the
+interpreter cannot discharge remote DMA on multi-axis meshes, and these
+helpers fall back to the unfused lax composition there). The module-level
+``configure(enabled=...)`` knob (driven by
+``CollectivesConfig.fused_gemm_collectives``) gates every caller: with it
+off, :func:`sharded-matmul callers <deepspeed_tpu.parallel.tp>` emit the
+plain lax composition — byte-identical programs to a build without this
+module.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import threading
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepspeed_tpu.collectives import pallas_backend
+from deepspeed_tpu.collectives.codecs import Codec, get_codec
+from deepspeed_tpu.collectives.pallas_backend import (
+    _block_math,
+    _compiler_params,
+    _entry_barrier,
+    _interpret,
+    _neighbor_logicals,
+)
+from deepspeed_tpu.utils.compat import axis_size
+
+# --------------------------------------------------------------- config knob
+
+_lock = threading.Lock()
+_enabled = False
+
+
+def configure(enabled: bool = False) -> None:
+    """Process-global gate (set from ``CollectivesConfig.fused_gemm_collectives``
+    by the engine, like ``selector.configure``)."""
+    global _enabled
+    with _lock:
+        _enabled = bool(enabled)
+
+
+def enabled() -> bool:
+    with _lock:
+        return _enabled
+
+
+def supported(axis) -> bool:
+    """Whether the fused kernels can express this trace context: a single
+    named mesh axis, and a hop transport the backend can discharge
+    (compiled Mosaic anywhere, the interpreter only on 1-axis meshes)."""
+    return isinstance(axis, str) and pallas_backend.remote_dma_supported()
+
+
+def _resolve_codec(codec, block_size: Optional[int]) -> Optional[Codec]:
+    if codec is None or codec == "none":
+        return None
+    c = codec if isinstance(codec, Codec) else get_codec(codec, block_size or 64)
+    if c.name not in ("int8", "fp8"):
+        raise ValueError(f"no fused GEMM wire for codec {c.name!r}")
+    return c
+
+
+def _chunks_of(rows: int) -> int:
+    """Grid chunks per hop: enough to overlap wire behind compute, exact
+    divisors only (chunk rows must tile the shard)."""
+    for d in (4, 3, 2):
+        if rows % d == 0:
+            return d
+    return 1
+
+
+def _wire_math(codec: Optional[Codec], B: int):
+    """(encode, decode, wire_dtype, qb): the VMEM wire staging math. Exact
+    wires pass raw fp32 through the same 2-slot buffers (qb spans the whole
+    chunk; the scale buffers stay untouched)."""
+    if codec is None:
+        return None, None, jnp.float32, B
+    encode, decode, wdtype = _block_math(codec)
+    qb = math.gcd(B, max(int(codec.block_size), 1))
+    return encode, decode, wdtype, qb
+
+
+# ----------------------------------------------------------- fused hop kernels
+
+
+def _wire_ops(send_w, send_s, recv_w, recv_s, sw_sem, ss_sem, rw_sem, rs_sem,
+              dst):
+    """Constructors for the two remote copies (values, scales) of one slot."""
+
+    def w_copy(s):
+        return pltpu.make_async_remote_copy(
+            src_ref=send_w.at[s], dst_ref=recv_w.at[s],
+            send_sem=sw_sem.at[s], recv_sem=rw_sem.at[s],
+            device_id=dst, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def s_copy(s):
+        return pltpu.make_async_remote_copy(
+            src_ref=send_s.at[s], dst_ref=recv_s.at[s],
+            send_sem=ss_sem.at[s], recv_sem=rs_sem.at[s],
+            device_id=dst, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    return w_copy, s_copy
+
+
+def _slot_send(j, slot, payload, w_copy, s_copy, send_w, send_s, cap_sem, *,
+               B, qb, encode, interpret):
+    """Stage chunk ``j`` (fp32 ``(rows, cols)``) into wire slot ``slot`` and
+    launch its remote DMA. Slot reuse waits our chunk ``j-2`` DMAs out and
+    (compiled) one downstream consumption credit — the _fused_hop
+    discipline verbatim."""
+
+    @pl.when(j >= 2)
+    def _():
+        w_copy(slot).wait_send()
+        if encode is not None:
+            s_copy(slot).wait_send()
+        if not interpret:
+            pltpu.semaphore_wait(cap_sem, 1)
+
+    if encode is not None:
+        q, sc = encode(payload.reshape(B // qb, qb))
+        send_w[slot] = q.reshape(B)
+        send_s[slot] = sc.reshape(B // qb)
+    else:
+        send_w[slot] = payload.reshape(B)
+    w_copy(slot).start()
+    if encode is not None:
+        s_copy(slot).start()
+
+
+def _slot_recv(prev, out_ref, w_copy, s_copy, recv_w, recv_s, cap_sem, src, *,
+               B, qb, decode, shape, interpret):
+    """Wait chunk ``prev``'s arrival, dequantize (or pass through) into the
+    blocked output, and grant the upstream sender one slot credit."""
+    w_copy(prev).wait_recv()
+    if decode is not None:
+        s_copy(prev).wait_recv()
+        deq = decode(recv_w[prev].reshape(B // qb, qb),
+                     recv_s[prev].reshape(B // qb, 1))
+        out_ref[...] = deq.reshape(shape).astype(jnp.float32)
+    else:
+        out_ref[...] = recv_w[prev].reshape(shape)
+    if not interpret:
+        pltpu.semaphore_signal(cap_sem, 1, device_id=src,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+
+def _slot_drain(C, w_copy, s_copy, cap_sem, *, encode, interpret):
+    """Semaphore balance at the final grid step (see _fused_hop_kernel):
+    wait the last min(C, 2) outstanding sends and drain leftover credits."""
+    for s in ([0] if C == 1 else [(C - 2) % 2, (C - 1) % 2]):
+        w_copy(s).wait_send()
+        if encode is not None:
+            s_copy(s).wait_send()
+    if not interpret:
+        pltpu.semaphore_wait(cap_sem, min(C, 2))
+
+
+def _ag_hop_kernel(idx_ref, x_blk, held_blk, yin_blk, y_blk, recv_blk,
+                   send_w, send_s, recv_w, recv_s,
+                   sw_sem, ss_sem, rw_sem, rs_sem, cap_sem,
+                   *, C: int, B: int, qb: int, out_block: bool,
+                   encode, decode, interpret: bool):
+    """One all-gather+matmul ring hop: grid step ``j`` ships chunk ``j`` of
+    the held weight shard to the next neighbor while contracting that SAME
+    chunk against ``x`` — the chunk's interconnect time hides behind its
+    own matmul. Step ``j`` also lands chunk ``j-1`` from the upstream
+    neighbor into the receive buffer (next hop's held shard)."""
+    j = pl.program_id(0)
+    slot = lax.rem(j, 2)
+    prev = lax.rem(j + 1, 2)
+    dst, src = idx_ref[0], idx_ref[1]
+    w_copy, s_copy = _wire_ops(send_w, send_s, recv_w, recv_s,
+                               sw_sem, ss_sem, rw_sem, rs_sem, dst)
+
+    @pl.when(j == 0)
+    def _():
+        _entry_barrier(dst, src, interpret)
+
+    @pl.when(j < C)
+    def _send_and_compute():
+        h = held_blk[...].astype(jnp.float32)
+        _slot_send(j, slot, h, w_copy, s_copy, send_w, send_s, cap_sem,
+                   B=B, qb=qb, encode=encode, interpret=interpret)
+        if out_block:
+            # backward-dx form: this shard's chunk yields an independent
+            # output-column block, x [M,N] @ held_chunk [Bk,N]^T
+            y_blk[...] = lax.dot_general(
+                x_blk[...].astype(jnp.float32), h,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            part = lax.dot_general(
+                x_blk[...].astype(jnp.float32), h,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+            @pl.when(j == 0)
+            def _():
+                y_blk[...] = yin_blk[...] + part
+
+            @pl.when(j > 0)
+            def _():
+                y_blk[...] = y_blk[...] + part
+
+    @pl.when(j > 0)
+    def _recv():
+        _slot_recv(prev, recv_blk, w_copy, s_copy, recv_w, recv_s, cap_sem,
+                   src, B=B, qb=qb, decode=decode, shape=recv_blk.shape,
+                   interpret=interpret)
+
+    @pl.when(j == C)
+    def _drain():
+        _slot_drain(C, w_copy, s_copy, cap_sem, encode=encode,
+                    interpret=interpret)
+
+
+def _rs_hop_kernel(idx_ref, x_blk, w_blk, rprev_blk, recv_blk,
+                   send_w, send_s, recv_w, recv_s,
+                   sw_sem, ss_sem, rw_sem, rs_sem, cap_sem,
+                   *, C: int, B: int, qb: int, encode, decode,
+                   interpret: bool):
+    """One matmul+reduce-scatter ring hop: grid step ``j`` computes chunk
+    ``j`` of the outgoing row-block's partial product (upstream partial +
+    local ``x_blk @ w``) and launches its DMA — chunk ``j``'s wire flies
+    while chunk ``j+1`` computes. The received chunks (the NEXT row-block's
+    upstream partials) land as this hop's output."""
+    j = pl.program_id(0)
+    slot = lax.rem(j, 2)
+    prev = lax.rem(j + 1, 2)
+    dst, src = idx_ref[0], idx_ref[1]
+    w_copy, s_copy = _wire_ops(send_w, send_s, recv_w, recv_s,
+                               sw_sem, ss_sem, rw_sem, rs_sem, dst)
+
+    @pl.when(j == 0)
+    def _():
+        _entry_barrier(dst, src, interpret)
+
+    @pl.when(j < C)
+    def _compute_and_send():
+        part = rprev_blk[...] + lax.dot_general(
+            x_blk[...].astype(jnp.float32), w_blk[...].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        _slot_send(j, slot, part, w_copy, s_copy, send_w, send_s, cap_sem,
+                   B=B, qb=qb, encode=encode, interpret=interpret)
+
+    @pl.when(j > 0)
+    def _recv():
+        _slot_recv(prev, recv_blk, w_copy, s_copy, recv_w, recv_s, cap_sem,
+                   src, B=B, qb=qb, decode=decode, shape=recv_blk.shape,
+                   interpret=interpret)
+
+    @pl.when(j == C)
+    def _drain():
+        _slot_drain(C, w_copy, s_copy, cap_sem, encode=encode,
+                    interpret=interpret)
+
+
+# -------------------------------------------------------------- hop wrappers
+
+
+def _hop_scratch(B: int, nb: int, wdtype):
+    return [
+        pltpu.VMEM((2, B), wdtype),               # send wire values
+        pltpu.VMEM((2, max(nb, 1)), jnp.float32),  # send wire scales
+        pltpu.VMEM((2, B), wdtype),               # recv wire values
+        pltpu.VMEM((2, max(nb, 1)), jnp.float32),  # recv wire scales
+        pltpu.SemaphoreType.DMA((2,)), pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)), pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.REGULAR,               # sender flow-control credits
+    ]
+
+
+def _ag_hop(x, held, y, s_held, dst, src, *, codec: Optional[Codec],
+            out_block: bool) -> Tuple[jax.Array, jax.Array]:
+    """One fused all-gather+matmul hop. ``held`` is shard ``s_held``'s
+    ``[Ks, N]`` rows (fp32); returns ``(y_or_block, received_shard)``."""
+    M = x.shape[0]
+    Ks, N = held.shape
+    C = _chunks_of(Ks)
+    Bk = Ks // C
+    B = Bk * N
+    encode, decode, wdtype, qb = _wire_math(codec, B)
+    interpret = _interpret()
+    idx = jnp.stack([dst, src, s_held.astype(jnp.int32)])
+    if out_block:
+        in_specs = [
+            pl.BlockSpec((M, N), lambda j, idx: (0, 0)),       # full x (= g)
+            pl.BlockSpec((Bk, N), lambda j, idx: (jnp.minimum(j, C - 1), 0)),
+            pl.BlockSpec((M, N), lambda j, idx: (0, 0)),       # unused y seed
+        ]
+        out_specs = [
+            pl.BlockSpec((M, Bk), lambda j, idx: (0, jnp.minimum(j, C - 1))),
+            pl.BlockSpec((Bk, N), lambda j, idx: (jnp.maximum(j - 1, 0), 0)),
+        ]
+        out_shape = [jax.ShapeDtypeStruct((M, Ks), jnp.float32),
+                     jax.ShapeDtypeStruct((Ks, N), jnp.float32)]
+    else:
+        in_specs = [
+            # x columns matching the held shard's K rows, chunk j
+            pl.BlockSpec((M, Bk), lambda j, idx: (0, idx[2] * C + jnp.minimum(j, C - 1))),
+            pl.BlockSpec((Bk, N), lambda j, idx: (jnp.minimum(j, C - 1), 0)),
+            pl.BlockSpec((M, N), lambda j, idx: (0, 0)),       # running y in
+        ]
+        out_specs = [
+            pl.BlockSpec((M, N), lambda j, idx: (0, 0)),       # running y out
+            pl.BlockSpec((Bk, N), lambda j, idx: (jnp.maximum(j - 1, 0), 0)),
+        ]
+        out_shape = [jax.ShapeDtypeStruct((M, N), jnp.float32),
+                     jax.ShapeDtypeStruct((Ks, N), jnp.float32)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(C + 1,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=_hop_scratch(B, B // qb, wdtype),
+    )
+    out, recv = pl.pallas_call(
+        functools.partial(_ag_hop_kernel, C=C, B=B, qb=qb,
+                          out_block=out_block, encode=encode, decode=decode,
+                          interpret=interpret),
+        out_shape=out_shape,
+        grid_spec=grid_spec,
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(idx, x, held, y)
+    return out, recv
+
+
+def _rs_hop(x, w, rprev, blk_idx, dst, src, *,
+            codec: Optional[Codec]) -> jax.Array:
+    """One fused matmul+reduce-scatter hop: send row-block ``blk_idx``'s
+    accumulated partial (``rprev + x[block] @ w``), return the received
+    row-block partials ``[Mb, N]``."""
+    K = x.shape[1]
+    N = w.shape[1]
+    Mb = rprev.shape[0]
+    C = _chunks_of(Mb)
+    Bm = Mb // C
+    B = Bm * N
+    encode, decode, wdtype, qb = _wire_math(codec, B)
+    interpret = _interpret()
+    idx = jnp.stack([dst, src, blk_idx.astype(jnp.int32)])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(C + 1,),
+        in_specs=[
+            # rows of the outgoing block, chunk j
+            pl.BlockSpec((Bm, K), lambda j, idx: (idx[2] * C + jnp.minimum(j, C - 1), 0)),
+            pl.BlockSpec((K, N), lambda j, idx: (0, 0)),
+            pl.BlockSpec((Bm, N), lambda j, idx: (jnp.minimum(j, C - 1), 0)),
+        ],
+        out_specs=pl.BlockSpec((Bm, N), lambda j, idx: (jnp.maximum(j - 1, 0), 0)),
+        scratch_shapes=_hop_scratch(B, B // qb, wdtype),
+    )
+    return pl.pallas_call(
+        functools.partial(_rs_hop_kernel, C=C, B=B, qb=qb,
+                          encode=encode, decode=decode, interpret=interpret),
+        out_shape=jax.ShapeDtypeStruct((Mb, N), jnp.float32),
+        grid_spec=grid_spec,
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(idx, x, w, rprev)
+
+
+# ------------------------------------------------------------ public fused ops
+
+
+def _record_hop(axis, nbytes: int, codec: Optional[Codec]):
+    from deepspeed_tpu.comm import comm as dist
+
+    proxy = jax.ShapeDtypeStruct((max(int(nbytes), 1),), jnp.int8)
+    return dist._record("remote_dma", axis, proxy, backend="pallas",
+                        fused=f"gemm+{codec.name if codec else 'none'}")
+
+
+def _wire_nbytes(rows: int, cols: int, codec: Optional[Codec]) -> int:
+    if codec is None:
+        return rows * cols * 4
+    return rows * cols + 4 * max(rows * cols // max(int(codec.block_size), 1), 1)
+
+
+def all_gather_matmul(x: jax.Array, w_shard: jax.Array, axis, *,
+                      codec=None, block_size: Optional[int] = None,
+                      out_block: bool = False,
+                      fused: Optional[bool] = None) -> jax.Array:
+    """``x [M, n*Ks] @ all_gather(w_shard [Ks, N], rows) -> [M, N]`` with the
+    gather fused into the GEMM (``out_block=True``: ``x [M, N]`` against
+    ``held.T`` per shard -> ``[M, n*Ks]``, the backward-``dx`` form).
+
+    ``fused=None`` follows the module knob; ``False`` forces the unfused
+    lax composition (all_gather then one dot — the config-off program);
+    ``True`` forces the kernels (falling back only when the trace context
+    cannot express remote DMA). Returns fp32 (callers cast at boundaries,
+    like the collective algorithms). Must run inside full-manual shard_map.
+    """
+    c = _resolve_codec(codec, block_size)
+    use = enabled() if fused is None else fused
+    n = axis_size(axis)
+    if n <= 1:
+        w32 = w_shard.astype(jnp.float32)
+        x32 = x.astype(jnp.float32)
+        dims = (((1,), (1,)), ((), ())) if out_block else (((1,), (0,)), ((), ()))
+        return lax.dot_general(x32, w32, dims,
+                               preferred_element_type=jnp.float32)
+    if not use or not supported(axis):
+        return _unfused_all_gather_matmul(x, w_shard, axis, out_block=out_block)
+    from deepspeed_tpu.collectives.algorithms import _ring_perm
+
+    Ks, N = w_shard.shape
+    M = x.shape[0]
+    i = lax.axis_index(axis)
+    dst, src = _neighbor_logicals(axis, _ring_perm(n, False))
+    x32 = x.astype(jnp.float32)
+    held = w_shard.astype(jnp.float32)
+    nbytes = _wire_nbytes(Ks, N, c)
+    if out_block:
+        y = jnp.zeros((M, n * Ks), jnp.float32)
+        for k in range(n - 1):
+            s_k = (i - k) % n
+            with _record_hop(axis, nbytes, c):
+                # x32 doubles as the (unused) y-seed operand: out_block mode
+                # writes whole blocks, there is no running accumulator
+                blk, held = _ag_hop(x32, held, x32, s_k, dst, src,
+                                    codec=c, out_block=True)
+            y = lax.dynamic_update_slice(y, blk, (0, s_k * Ks))
+        s_last = (i + 1) % n
+        blk = lax.dot_general(x32, held, (((1,), (1,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        return lax.dynamic_update_slice(y, blk, (0, s_last * Ks))
+    y = jnp.zeros((M, N), jnp.float32)
+    for k in range(n - 1):
+        s_k = (i - k) % n
+        with _record_hop(axis, nbytes, c):
+            y, held = _ag_hop(x32, held, y, s_k, dst, src,
+                              codec=c, out_block=False)
+    # the final received shard never crosses another wire: one plain dot
+    s_last = (i + 1) % n
+    xs = lax.dynamic_slice(x32, (0, s_last * Ks), (M, Ks))
+    return y + lax.dot_general(xs, held, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def matmul_reduce_scatter(x: jax.Array, w: jax.Array, axis, *,
+                          codec=None, block_size: Optional[int] = None,
+                          fused: Optional[bool] = None) -> jax.Array:
+    """``reduce_scatter(x [M, K] @ w [K, N], rows) -> [M/n, N]`` (sum over
+    the axis — rank ``i`` gets row block ``i``), with each ring hop's
+    partial-product GEMM fused into its own wire. fp32 out; full-manual
+    shard_map only. Falls back to the unfused lax composition when the
+    module knob is off, ``M`` does not tile, or remote DMA cannot be
+    expressed here."""
+    c = _resolve_codec(codec, block_size)
+    use = enabled() if fused is None else fused
+    n = axis_size(axis)
+    M = x.shape[0]
+    if n <= 1:
+        return lax.dot_general(x.astype(jnp.float32), w.astype(jnp.float32),
+                               (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    if not use or not supported(axis) or M % n != 0:
+        return _unfused_matmul_reduce_scatter(x, w, axis)
+    from deepspeed_tpu.collectives.algorithms import _ring_perm
+
+    Mb = M // n
+    N = w.shape[1]
+    i = lax.axis_index(axis)
+    dst, src = _neighbor_logicals(axis, _ring_perm(n, False))
+    x32 = x.astype(jnp.float32)
+    w32 = w.astype(jnp.float32)
+    rprev = jnp.zeros((Mb, N), jnp.float32)
+    nbytes = _wire_nbytes(Mb, N, c)
+    for k in range(n - 1):
+        b_k = (i - 1 - k) % n
+        with _record_hop(axis, nbytes, c):
+            rprev = _rs_hop(x32, w32, rprev, b_k, dst, src, codec=c)
+    # own row block: upstream partials + the local product, no wire
+    xs = lax.dynamic_slice(x32, (i * Mb, 0), (Mb, x.shape[1]))
+    return rprev + lax.dot_general(xs, w32, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+
+# -------------------------------------------------------- unfused references
+
+
+def _unfused_all_gather_matmul(x, w_shard, axis, *, out_block: bool = False):
+    """The config-off program: one tiled all-gather then one dot."""
+    wf = lax.all_gather(w_shard.astype(jnp.float32), axis, axis=0, tiled=True)
+    dims = (((1,), (1,)), ((), ())) if out_block else (((1,), (0,)), ((), ()))
+    return lax.dot_general(x.astype(jnp.float32), wf, dims,
+                           preferred_element_type=jnp.float32)
+
+
+def _unfused_matmul_reduce_scatter(x, w, axis):
+    """The config-off program: one dot then one tiled psum_scatter."""
+    p = lax.dot_general(x.astype(jnp.float32), w.astype(jnp.float32),
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    return lax.psum_scatter(p, axis, scatter_dimension=0, tiled=True)

@@ -400,7 +400,7 @@ class CollectiveObservatory:
 
         for pair in candidate_pairs(info.world,
                                     tuple(dict.fromkeys((info.codec, "none"))),
-                                    op=info.op):
+                                    op=info.op, axis=info.axis):
             if pair not in out:
                 out.append(pair)
         return out

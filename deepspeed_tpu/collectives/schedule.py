@@ -1,0 +1,499 @@
+"""Collective schedule compiler: synthesized hop programs (the GC3 shape).
+
+``algorithms.py`` picks among four HAND-WRITTEN schedules; ``ring2d``
+hard-codes one two-level factorization of one axis. This module replaces the
+menu with a search: given (op, mesh-axis tuple, payload bytes, codec) it
+enumerates **hierarchical schedules** — ordered sub-ring factorizations of
+every axis, mixed intra/inter orderings, per-level codec placement (exact
+inner rings, lossy outer rings: the ZeRO++ shape) — costs each candidate
+with the selector's :class:`~deepspeed_tpu.collectives.costmodel.CostModel`
+(the SAME object the observatory refit calibrates, so a live refit re-aims
+the search), and emits the winner as the hop-scope sub-ring programs
+``algorithms.py`` already runs (``_ring_reduce_scatter_rows(sub=...)`` /
+``_ring_all_gather_flat(sub=...)`` — ppermute hops, or Pallas remote-DMA /
+fused hops inside a hop scope).
+
+Schedule IR
+-----------
+A schedule is a tuple of :class:`Level`, in PROCESSING order (level 0 runs
+first = the innermost ring). Each level is one ring pass over a sub-ring of
+one mesh axis: ``size`` members at ``stride`` within the axis (member digit
+``(axis_index // stride) % size``). Strides follow the signature convention:
+a level's stride is the product of the sizes of PRIOR levels on the same
+axis, so the string form needs no explicit strides::
+
+    dp*2.none/dp*4.int8      # dp=8: exact stride-1 ring of 2, int8 stride-2
+                             # ring of 4 (ZeRO++: exact intra, lossy inter)
+    ep*2.none/dp*4.none      # mesh tuple ("dp","ep"): inner ep, outer dp
+
+Semantics per op (all telescoping to the flat ring's wire volume, with
+``sum(m_j - 1)`` hops instead of ``n - 1``):
+
+- ``all_reduce``      — recursive RS(level j) ... AR(rest) ... AG(level j);
+  any level order is valid (the sum commutes), so orderings are SEARCHED.
+- ``all_gather`` / ``reduce_scatter`` — level order is FIXED by output rank
+  order (minor rank digit first: last mesh axis, stride-1 first); only the
+  per-axis factorizations and codec placement are searched.
+
+Determinism: the search is a pure function of its arguments and the cost
+model's constants; ties break by (fewer lossy levels, signature string), so
+equal-cost candidates resolve identically everywhere — and on a free inner
+tier (``tier_beta_scale``) the tie-break IS what surfaces the ZeRO++
+exact-intra/lossy-inter placement over lossy-everywhere.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from deepspeed_tpu import telemetry
+from deepspeed_tpu.collectives.algorithms import (
+    _pad_to_chunks,
+    _ring_all_gather_flat,
+    _ring_reduce_scatter_rows,
+)
+from deepspeed_tpu.collectives.codecs import get_codec
+from deepspeed_tpu.collectives.costmodel import CostModel
+from deepspeed_tpu.utils.compat import axis_size
+
+# AxesSig: the mesh-axis tuple a collective runs over, with sizes —
+# (("dp", 8),) or (("dp", 4), ("ep", 2)). THE factorization identity the
+# selector's decision cache must key on (two meshes with equal world size
+# but different axis splits get different schedules).
+AxesSig = Tuple[Tuple[str, int], ...]
+
+SCHEDULED_OPS = ("all_reduce", "all_gather", "reduce_scatter")
+
+# search bounds: factor chains per axis and total level count are small on
+# purpose — every level is a serial ring pass, and past ~4 levels the alpha
+# term eats any wire win at realistic world sizes
+_MAX_LEVELS = 4
+_MAX_ORDERS = 24  # AR level-order permutations evaluated (4! covers depth 4)
+
+# lossiness rank for signature_codec (wire compression order): the stamped
+# codec of a mixed schedule is its LOSSIEST level, so the selector's
+# min_quant_bytes / allowed-codec guardrails see the worst wire it applies
+_LOSSY_RANK = {"none": 0, "fp32": 1, "bf16": 2, "fp8": 3, "int8": 4}
+
+
+@dataclass(frozen=True)
+class Level:
+    """One ring pass: a ``size``-member sub-ring at ``stride`` within
+    ``axis``, with its own wire ``codec``."""
+
+    axis: str
+    size: int
+    stride: int
+    codec: str
+
+
+@dataclass(frozen=True)
+class CompiledSchedule:
+    """A search winner: the executable levels plus the model's verdict."""
+
+    op: str
+    signature: str
+    levels: Tuple[Level, ...]
+    est_us: float
+    hops: int
+    wire_mb: float
+    candidates: int  # search-space size actually costed
+
+
+# ----------------------------------------------------------------- signatures
+
+
+def format_signature(levels: Sequence[Level]) -> str:
+    return "/".join(f"{lv.axis}*{lv.size}.{lv.codec}" for lv in levels)
+
+
+def parse_signature(sig: str) -> Tuple[Level, ...]:
+    """``axis*size.codec`` terms, "/"-joined, processing order; strides are
+    derived (cumulative product of prior same-axis sizes)."""
+    levels: List[Level] = []
+    strides: Dict[str, int] = {}
+    for term in sig.split("/"):
+        try:
+            ax, rest = term.split("*", 1)
+            size_s, codec = rest.split(".", 1)
+            size = int(size_s)
+        except ValueError:
+            raise ValueError(
+                f"bad schedule signature term {term!r} in {sig!r} "
+                "(want axis*size.codec, e.g. dp*4.int8)") from None
+        if size < 1 or not ax:
+            raise ValueError(f"bad schedule signature term {term!r} in {sig!r}")
+        if size > 1:  # size-1 levels are no-ops; never emitted, always legal
+            levels.append(Level(ax, size, strides.get(ax, 1), codec))
+        strides[ax] = strides.get(ax, 1) * size
+    if not levels:
+        raise ValueError(f"schedule signature {sig!r} has no non-trivial level")
+    return tuple(levels)
+
+
+def signature_codec(sig: str) -> str:
+    """The lossiest per-level codec — what a decision-table row for
+    ``compiled:<sig>`` is stamped with (selector codec guardrails)."""
+    worst = "none"
+    for lv in parse_signature(sig):
+        if _LOSSY_RANK.get(lv.codec, 99) > _LOSSY_RANK.get(worst, 99):
+            worst = lv.codec
+    return worst
+
+
+def _validate_levels(levels: Sequence[Level], axes_sig: AxesSig, op: str) -> None:
+    sizes: Dict[str, int] = {}
+    for lv in levels:
+        sizes[lv.axis] = sizes.get(lv.axis, 1) * lv.size
+    want = {name: n for name, n in axes_sig}
+    if sizes != {k: v for k, v in want.items() if v > 1}:
+        raise ValueError(
+            f"schedule {format_signature(levels)!r} does not factor the mesh "
+            f"axes {axes_sig} (covers {sizes})")
+    if op in ("all_gather", "reduce_scatter"):
+        canon = _canonical_axis_order(axes_sig)
+        got = [lv.axis for lv in levels]
+        # rank order fixes BOTH the axis grouping (contiguous, minor axis
+        # first) and the within-axis stride order (stride-increasing falls
+        # out of the signature convention once each axis is contiguous)
+        if got != sorted(got, key=canon.index):
+            raise ValueError(
+                f"{op} schedule {format_signature(levels)!r} is not in rank "
+                f"order (minor digit first: {'/'.join(canon)}); only "
+                "all_reduce may reorder levels")
+
+
+def _canonical_axis_order(axes_sig: AxesSig) -> List[str]:
+    """Axes minor-digit-first: lax's tuple collectives order output by the
+    FIRST listed axis major, so the innermost ring lives on the LAST axis."""
+    return [name for name, _n in reversed(axes_sig)]
+
+
+# ---------------------------------------------------------------- the search
+
+
+def _factor_chains(n: int, max_factors: int) -> List[Tuple[int, ...]]:
+    """All ordered chains of factors >= 2 with product n (incl. ``(n,)``)."""
+    if n == 1:
+        return [()]
+    out: List[Tuple[int, ...]] = []
+
+    def rec(rem: int, acc: Tuple[int, ...]):
+        if rem == 1:
+            out.append(acc)
+            return
+        if len(acc) == max_factors - 1:
+            out.append(acc + (rem,))
+            return
+        f = 2
+        while f <= rem:
+            if rem % f == 0:
+                rec(rem // f, acc + (f,))
+            f += 1
+
+    rec(n, ())
+    # deterministic enumeration order (rec already is, but make it explicit)
+    return sorted(set(out))
+
+
+def _level_plans(op: str, axes_sig: AxesSig) -> List[Tuple[Level, ...]]:
+    """Codec-free level tuples to cost: per-axis factor chains in canonical
+    order, plus (all_reduce only) bounded level-order permutations. Strides
+    are re-derived per plan from the signature convention, so a permuted
+    plan is itself a valid factorization."""
+    live = [(name, n) for name, n in axes_sig if n > 1]
+    if not live:
+        return []
+    budget = max(_MAX_LEVELS - (len(live) - 1), 1)
+    per_axis = [
+        [chain for chain in _factor_chains(n, budget)] for _name, n in live]
+    plans: List[Tuple[Level, ...]] = []
+    order = _canonical_axis_order(tuple(live))
+    for combo in itertools.product(*per_axis):
+        if sum(len(c) for c in combo) > _MAX_LEVELS:
+            continue
+        chain_of = dict(zip([name for name, _ in live], combo))
+        base = [(ax, m) for ax in order for m in chain_of[ax]]
+        seqs = [base]
+        if op == "all_reduce" and len(base) > 1:
+            seqs = list(itertools.islice(
+                itertools.permutations(base), _MAX_ORDERS))
+        for seq in seqs:
+            strides: Dict[str, int] = {}
+            levels = []
+            for ax, m in seq:
+                levels.append(Level(ax, m, strides.get(ax, 1), "none"))
+                strides[ax] = strides.get(ax, 1) * m
+            plans.append(tuple(levels))
+    # dedupe permutations that collide (repeated equal factors)
+    return list(dict.fromkeys(plans))
+
+
+def _codec_placements(levels: Tuple[Level, ...], codec: Optional[str]
+                      ) -> List[Tuple[Level, ...]]:
+    """Per-level codec assignments: exact everywhere, or ``codec`` on the
+    OUTER levels from some boundary out (the ZeRO++ placement family —
+    inner rings are the fast links where an exact wire stays cheap). A
+    forced lossy codec always lands on at least the outermost level."""
+    if codec in (None, "none"):
+        return [levels]
+    out = []
+    for k in range(len(levels)):  # k = first lossy level index
+        out.append(tuple(
+            Level(lv.axis, lv.size, lv.stride, codec if i >= k else "none")
+            for i, lv in enumerate(levels)))
+    return out
+
+
+def level_terms(op: str, levels: Sequence[Level], nbytes: int,
+                itemsize: int = 4, block_size: Optional[int] = None,
+                cm: Optional[CostModel] = None
+                ) -> Tuple[int, float]:
+    """(hops, effective wire_mb) for a schedule — the SAME two regressors
+    ``selector.model_terms`` returns for hand-written algorithms, so
+    ``estimate_us``/the observatory refit treat compiled rows identically.
+    ``nbytes`` follows the selector's convention: the LOCAL payload (full
+    pre-reduction array for all_reduce/reduce_scatter, the shard for
+    all_gather). Tier beta scaling folds into wire_mb (level 0 = tier 0)."""
+    if op not in SCHEDULED_OPS:
+        raise ValueError(f"no schedule form for op {op!r} (one of {SCHEDULED_OPS})")
+    cm = cm if cm is not None else CostModel()
+    scales = cm.tier_beta_scale
+    hops = 0
+    wire_mb = 0.0
+    payload = float(nbytes)  # shrinks (AR/RS) or grows (AG) through levels
+    for depth, lv in enumerate(levels):
+        m = lv.size
+        if op == "all_reduce":
+            level_hops, vol = 2 * (m - 1), 2.0 * (m - 1) / m * payload
+            payload /= m
+        elif op == "reduce_scatter":
+            level_hops, vol = m - 1, (m - 1) / m * payload
+            payload /= m
+        else:  # all_gather: each link relays m-1 blocks of the current size
+            level_hops, vol = m - 1, (m - 1) * payload
+            payload *= m
+        c = get_codec(lv.codec, block_size)
+        wire = c.wire_bytes(max(int(vol // itemsize), 1), itemsize)
+        scale = scales[min(depth, len(scales) - 1)] if scales else 1.0
+        hops += level_hops
+        wire_mb += scale * wire / 1e6
+    return hops, wire_mb
+
+
+def signature_terms(op: str, sig: str, nbytes: int, itemsize: int = 4,
+                    block_size: Optional[int] = None,
+                    cm: Optional[CostModel] = None) -> Tuple[int, float]:
+    """``level_terms`` from a ``compiled:<sig>`` string (the selector's
+    ``model_terms`` delegates here for compiled algorithms)."""
+    return level_terms(op, parse_signature(sig), nbytes, itemsize,
+                       block_size, cm)
+
+
+# compile cache: (search inputs, cost-model identity+version) -> winner.
+# cm.version bumps on every calibrate()/tier change, so a live observatory
+# refit invalidates exactly the schedules whose objective moved.
+_cache_lock = threading.Lock()
+_compile_cache: Dict[tuple, CompiledSchedule] = {}
+
+
+def invalidate_cache() -> None:
+    with _cache_lock:
+        _compile_cache.clear()
+
+
+def _bytes_bucket(nbytes: int) -> int:
+    return max(int(nbytes), 1).bit_length()
+
+
+def compile_schedule(op: str, axes_sig: AxesSig, nbytes: int,
+                     codec: Optional[str] = None, *, itemsize: int = 4,
+                     block_size: Optional[int] = None,
+                     cm: Optional[CostModel] = None,
+                     backend: str = "ppermute") -> Optional[CompiledSchedule]:
+    """Search factorizations x orderings x codec placements; return the
+    cheapest schedule under ``cm`` (None when the mesh tuple is trivial —
+    world size 1 has nothing to schedule). Deterministic: equal-cost
+    candidates resolve by (fewer lossy levels, signature string)."""
+    if op not in SCHEDULED_OPS:
+        return None
+    axes_sig = tuple((str(a), int(n)) for a, n in axes_sig)
+    if not axes_sig or all(n <= 1 for _a, n in axes_sig):
+        return None
+    cm = cm if cm is not None else _selector_cost_model()
+    key = (op, axes_sig, _bytes_bucket(nbytes), codec, int(itemsize),
+           block_size, backend, id(cm), cm.version)
+    with _cache_lock:
+        hit = _compile_cache.get(key)
+    if hit is not None:
+        return hit
+    best = None
+    best_key = None
+    n_cand = 0
+    for plan in _level_plans(op, axes_sig):
+        for levels in _codec_placements(plan, codec):
+            hops, wire_mb = level_terms(op, levels, nbytes, itemsize,
+                                        block_size, cm)
+            est = cm.estimate_us(hops, wire_mb, backend)
+            n_cand += 1
+            sig = format_signature(levels)
+            lossy = sum(1 for lv in levels if lv.codec != "none")
+            k = (est, lossy, sig)
+            if best_key is None or k < best_key:
+                best_key = k
+                best = CompiledSchedule(op, sig, tuple(levels), est, hops,
+                                        wire_mb, 0)
+    if best is None:
+        return None
+    best = CompiledSchedule(best.op, best.signature, best.levels, best.est_us,
+                            best.hops, best.wire_mb, n_cand)
+    with _cache_lock:
+        best = _compile_cache.setdefault(key, best)
+    tracer = telemetry.get_tracer()
+    if tracer.enabled:
+        reg = tracer.registry
+        reg.counter("coll/schedule_compiles").add(1)
+        reg.gauge("coll/schedule_candidates", op=op).set(float(n_cand))
+        reg.gauge("coll/schedule_pred_us", op=op).set(float(best.est_us))
+        reg.gauge("coll/schedule_levels", op=op).set(float(len(best.levels)))
+    return best
+
+
+def _selector_cost_model() -> CostModel:
+    from deepspeed_tpu.collectives import selector
+
+    return selector.cost_model()
+
+
+def candidate_signatures(op: str, axis: str, world: int,
+                         codecs: Sequence[str] = ("none",),
+                         nbytes: int = 1 << 20,
+                         itemsize: int = 2) -> List[str]:
+    """A bounded set of schedules worth MEASURING for one (op, axis, world):
+    the search winner per codec class at a representative payload. Feeds
+    ``benchmark.candidate_pairs`` so sweeps/probes stamp
+    ``algorithm="compiled:<sig>"`` rows and measured mode can prefer or
+    demote a synthesized schedule per bytes-bucket like any hand-written
+    one. Flat exact single-level winners are skipped (they time identically
+    to ``ring``, which is already swept)."""
+    if op not in SCHEDULED_OPS or world <= 1:
+        return []
+    out: List[str] = []
+    for cd in dict.fromkeys(tuple(codecs) + ("none",)):
+        sched = compile_schedule(op, ((axis, world),), nbytes, cd,
+                                 itemsize=itemsize)
+        if sched is None:
+            continue
+        trivial = len(sched.levels) == 1 and all(
+            lv.codec == "none" for lv in sched.levels)
+        if not trivial and sched.signature not in out:
+            out.append(sched.signature)
+    return out[:3]
+
+
+# ---------------------------------------------------------------- execution
+#
+# Every level runs through the existing sub-ring machinery
+# (algorithms._ring_reduce_scatter_rows / _ring_all_gather_flat with
+# ``sub=``), so compiled schedules inherit the whole transport stack:
+# facade-ppermute hops by default, remote-DMA / fused Pallas hops inside a
+# pallas_backend.hop_scope, codecs and their telemetry spans per hop.
+
+
+def _sub(level: Level, label: str):
+    """The ``sub=(n, rank, perm, label)`` handle for one level's sub-ring."""
+    total = axis_size(level.axis)
+    m, st = level.size, level.stride
+    perm = []
+    for s in range(total):
+        d = (s // st) % m
+        perm.append((s, s - st * d + st * ((d + 1) % m)))
+    import jax
+
+    idx = jax.lax.axis_index(level.axis) if total > 1 else 0
+    rank = (idx // st) % m
+    return (m, rank, perm,
+            f"{label}:compiled/{level.axis}*{level.size}s{level.stride}")
+
+
+def _ar_levels(flat, levels: Sequence[Level], block_size: Optional[int],
+               out_dtype):
+    """Recursive hierarchical all-reduce of a flat payload: RS over level 0,
+    all-reduce the shard over the remaining levels, AG back over level 0 —
+    the ``_flat_all_reduce_ring2d`` recursion generalized to any depth,
+    per-level codecs included."""
+    lv = levels[0]
+    codec = get_codec(lv.codec, block_size)
+    sub = _sub(lv, "all_reduce")
+    padded, N, _chunk = _pad_to_chunks(flat, lv.size)
+    shard, _ = _ring_reduce_scatter_rows(
+        padded.reshape(lv.size, -1), lv.axis, codec, sub=sub)
+    if len(levels) > 1:
+        shard = _ar_levels(shard, levels[1:], block_size, out_dtype).reshape(-1)
+    else:
+        shard = shard.astype(out_dtype)
+    gathered = _ring_all_gather_flat(shard, lv.axis, codec, sub=sub)
+    return gathered.reshape(-1)[:N].astype(out_dtype)
+
+
+def compiled_all_reduce(x, levels: Sequence[Level],
+                        block_size: Optional[int] = None):
+    flat = x.reshape(-1)
+    return _ar_levels(flat, list(levels), block_size, x.dtype).reshape(x.shape)
+
+
+def compiled_all_gather_flat(block, levels: Sequence[Level],
+                             block_size: Optional[int] = None):
+    """``[L] -> [n*L]`` in global rank order: gather the minor rank digit
+    first, each gathered block becoming the next level's payload (levels
+    must be rank-ordered — validated at resolve time)."""
+    for lv in levels:
+        codec = get_codec(lv.codec, block_size)
+        block = _ring_all_gather_flat(
+            block, lv.axis, codec, sub=_sub(lv, "all_gather")).reshape(-1)
+    return block
+
+
+def compiled_reduce_scatter_rows(rows, levels: Sequence[Level],
+                                 block_size: Optional[int] = None):
+    """``[n, L]`` destination rows -> this rank's summed row ``[L]``: each
+    level bundles rows by the level's rank digit (minor first) and
+    reduce-scatters the bundles on its sub-ring, shrinking the working set
+    by 1/size per level — the transpose-regroup recursion."""
+    for lv in levels:
+        m = lv.size
+        rest, L = rows.shape[0] // m, rows.shape[1]
+        bundles = rows.reshape(rest, m, L).transpose(1, 0, 2).reshape(m, rest * L)
+        codec = get_codec(lv.codec, block_size)
+        shard, _ = _ring_reduce_scatter_rows(
+            bundles, lv.axis, codec, sub=_sub(lv, "reduce_scatter"))
+        rows = shard.reshape(rest, L)
+    return rows.reshape(-1)
+
+
+def resolve(algorithm: str, op: str, axes: Sequence[str], nbytes: int,
+            codec, itemsize: int, block_size: Optional[int]
+            ) -> Tuple[Level, ...]:
+    """Turn ``"compiled"`` (search here, at trace time — deterministic and
+    cached) or ``"compiled:<sig>"`` (parse + validate) into executable
+    levels for the bound mesh axes."""
+    axes_sig = tuple((str(a), int(axis_size(a))) for a in axes)
+    if all(n <= 1 for _a, n in axes_sig):
+        return ()
+    if algorithm == "compiled":
+        cd = codec if isinstance(codec, str) else getattr(codec, "name", None)
+        sched = compile_schedule(op, axes_sig, nbytes, cd, itemsize=itemsize,
+                                 block_size=block_size)
+        assert sched is not None  # non-trivial axes_sig checked above
+        return sched.levels
+    sig = algorithm.split(":", 1)[1]
+    if not sig:
+        raise ValueError(f"empty compiled schedule signature in {algorithm!r}")
+    levels = parse_signature(sig)
+    _validate_levels(levels, axes_sig, op)
+    return levels

@@ -31,11 +31,22 @@ from typing import Dict, List, Optional, Tuple
 from deepspeed_tpu import telemetry
 from deepspeed_tpu.collectives.algorithms import ALGORITHMS, _factor_near_square
 from deepspeed_tpu.collectives.codecs import get_codec
+from deepspeed_tpu.collectives.costmodel import CostModel
 from deepspeed_tpu.collectives import pallas_backend
 from deepspeed_tpu.collectives.pallas_backend import PALLAS_ALGORITHMS
 from deepspeed_tpu.utils.logging import logger
 
 OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all")
+
+# AxesSig: ((axis_name, axis_size), ...) — the mesh-axis factorization a
+# query runs over. Part of the decision-cache key (two meshes with equal
+# world size but different axis splits must not share entries) and the
+# schedule compiler's search domain.
+AxesSig = Tuple[Tuple[str, int], ...]
+
+
+def _is_compiled(algorithm: str) -> bool:
+    return algorithm == "compiled" or algorithm.startswith("compiled:")
 
 
 @dataclass(frozen=True)
@@ -86,11 +97,22 @@ class SelectorConfig:
     # alpha/beta (and the pallas_alpha_scale discount) for that backend's
     # candidates, so model mode re-costs from what this mesh measured.
     backend_ab: Dict[str, Tuple[float, float]] = field(default_factory=dict)
+    # Let model mode SYNTHESIZE hierarchical schedules (collectives/
+    # schedule.py) as candidates next to the hand-written menu. Off by
+    # default: under a flat alpha-beta model a multi-level schedule
+    # strictly dominates ring on hops at equal wire, so enabling it shifts
+    # routing everywhere — an explicit opt-in (config `compiled_search`).
+    compiled_search: bool = False
 
 
 _lock = threading.Lock()
 _config = SelectorConfig()
-_cache: Dict[Tuple[str, int, int, Optional[str], int, str], Decision] = {}
+# THE shared alpha-beta object: selector estimates, observatory refits
+# (calibrate below) and the schedule compiler's search objective all read
+# this one instance. backend_ab is the SAME dict as _config.backend_ab, so
+# existing get_config().backend_ab consumers see calibrations unchanged.
+_cost_model = CostModel(backend_ab=_config.backend_ab)
+_cache: Dict[tuple, Decision] = {}
 _measured: List[dict] = []
 _stats = {"hits": 0, "misses": 0}
 
@@ -98,15 +120,28 @@ _stats = {"hits": 0, "misses": 0}
 def configure(config: Optional[SelectorConfig] = None, **kwargs) -> SelectorConfig:
     """Install selector tunables (process-global, like the telemetry tracer);
     clears the decision cache. Accepts a ``SelectorConfig`` or field kwargs."""
-    global _config
+    global _config, _cost_model
     with _lock:
         # copy, never mutate the caller's template instance
         cfg = dc_replace(config, **kwargs) if config is not None else SelectorConfig(**kwargs)
         cfg.backend_ab = dict(cfg.backend_ab)  # calibrate() mutates in place
         _config = cfg
+        # rebuild the shared cost model around the NEW config's constants,
+        # handing it the same backend_ab dict so calibrate() keeps writing
+        # through both handles
+        _cost_model = CostModel(
+            alpha_us=cfg.alpha_us, beta_us_per_mb=cfg.beta_us_per_mb,
+            pallas_alpha_scale=cfg.pallas_alpha_scale,
+            backend_ab=cfg.backend_ab)
         _cache.clear()
         _measured.clear()
         _stats["hits"] = _stats["misses"] = 0
+    from deepspeed_tpu.collectives import schedule as _schedule
+
+    # a fresh model instance orphans every cached compile (the cache keys
+    # on model identity + version) — drop them eagerly
+    _schedule.invalidate_cache()
+    with _lock:
         if cfg.decision_table and cfg.mode != "model":
             from deepspeed_tpu.collectives.table import load_table
 
@@ -129,12 +164,22 @@ def calibrate(backend: str, alpha_us: float, beta_us_per_mb: float) -> None:
     :func:`configure` (a fresh engine re-installs its config — persistent
     calibration rides the observatory's on-disk table instead)."""
     with _lock:
-        _config.backend_ab[backend] = (float(alpha_us), float(beta_us_per_mb))
+        # writes through the SHARED dict (_config.backend_ab is
+        # _cost_model.backend_ab) and bumps the model's version, so cached
+        # schedule compiles re-search under the refit constants
+        _cost_model.calibrate(backend, alpha_us, beta_us_per_mb)
         _cache.clear()
 
 
 def get_config() -> SelectorConfig:
     return _config
+
+
+def cost_model() -> CostModel:
+    """THE alpha-beta object: what ``estimate_us`` charges, ``calibrate``
+    refits, and the schedule compiler searches under — one instance, by
+    identity (the measured-vs-predicted loop tunes the search objective)."""
+    return _cost_model
 
 
 def cache_info() -> Dict[str, int]:
@@ -226,6 +271,19 @@ def model_terms(op: str, algorithm: str, codec: str, nbytes: int, n: int,
     SAME terms — one formula, or fitted constants would be applied to
     different regressors than they were fit against."""
     cfg = cfg or _config
+    if _is_compiled(algorithm):
+        # synthesized schedules carry per-level codecs in the signature;
+        # the codec argument is the row's stamped (lossiest) codec and the
+        # terms come from the schedule IR under the shared cost model
+        from deepspeed_tpu.collectives import schedule as _schedule
+
+        sig = algorithm.split(":", 1)[1]
+        if not sig:
+            raise ValueError("model_terms needs a concrete compiled:<sig>")
+        return _schedule.signature_terms(
+            op, sig, nbytes, itemsize,
+            block_size if block_size is not None else cfg.block_size,
+            cm=_cost_model)
     hops, vol = _hops_and_volume(op, algorithm, nbytes, n)
     c = get_codec(codec, block_size if block_size is not None else cfg.block_size)
     wire = c.wire_bytes(max(int(vol // itemsize), 1), itemsize)
@@ -255,7 +313,8 @@ def estimate_us(op: str, algorithm: str, codec: str, nbytes: int, n: int,
 
 
 def _model_pick(op: str, nbytes: int, n: int, codec: Optional[str],
-                cfg: SelectorConfig, itemsize: int = 4) -> Decision:
+                cfg: SelectorConfig, itemsize: int = 4,
+                axes_sig: Optional[AxesSig] = None) -> Decision:
     if nbytes < cfg.min_algorithmic_bytes and codec in (None, "none"):
         # the native lowering cannot apply a wire codec, so the lax floor
         # only covers queries that didn't force one
@@ -284,12 +343,51 @@ def _model_pick(op: str, nbytes: int, n: int, codec: Optional[str],
             est = estimate_us(op, alg, cd, nbytes, n, cfg, itemsize)
             if best is None or est < best.est_us:
                 best = Decision(op, alg, cd, est, "model")
+    if cfg.compiled_search and axes_sig:
+        from deepspeed_tpu.collectives import schedule as _schedule
+
+        if op in _schedule.SCHEDULED_OPS:
+            for cd in codecs:
+                sched = _schedule.compile_schedule(
+                    op, axes_sig, nbytes, cd, itemsize=itemsize,
+                    block_size=cfg.block_size, cm=_cost_model)
+                if sched is None:
+                    continue
+                # the decision's codec is the schedule's LOSSIEST level
+                # (what actually hits a wire), not the search input — a
+                # mixed placement may keep cd off the inner rings entirely
+                stamped = _schedule.signature_codec(sched.signature)
+                if best is None or sched.est_us < best.est_us:
+                    best = Decision(op, f"compiled:{sched.signature}",
+                                    stamped, sched.est_us, "model")
     assert best is not None
     return best
 
 
+def _row_mesh_ok(r: dict, op: str, axes_sig: Optional[AxesSig]) -> bool:
+    """A ``compiled:<sig>`` row names mesh axes and their factor sizes: it
+    may only route onto a query whose axis tuple the signature actually
+    factors (and, for rank-ordered ops, in executable order). Hand-written
+    algorithm rows are mesh-shape-agnostic — the world-size match the
+    caller already did is all they claim."""
+    alg = str(r.get("algorithm", ""))
+    if not _is_compiled(alg):
+        return True
+    if ":" not in alg or axes_sig is None:
+        return False
+    from deepspeed_tpu.collectives import schedule as _schedule
+
+    try:
+        levels = _schedule.parse_signature(alg.split(":", 1)[1])
+        _schedule._validate_levels(levels, axes_sig, op)
+    except ValueError:
+        return False
+    return True
+
+
 def _measured_pick(op: str, nbytes: int, n: int, codec: Optional[str],
-                   cfg: SelectorConfig, itemsize: int = 4) -> Optional[Decision]:
+                   cfg: SelectorConfig, itemsize: int = 4,
+                   axes_sig: Optional[AxesSig] = None) -> Optional[Decision]:
     if codec is not None:
         allowed = {codec}
     else:
@@ -301,7 +399,8 @@ def _measured_pick(op: str, nbytes: int, n: int, codec: Optional[str],
             allowed = {"none"}
     rows = [r for r in _measured
             if r.get("op") == op and int(r.get("world", 0)) == n
-            and r.get("codec", "none") in allowed and _row_backend_ok(r)]
+            and r.get("codec", "none") in allowed and _row_backend_ok(r)
+            and _row_mesh_ok(r, op, axes_sig)]
     # a mixed-itemsize table (online rows + sweeps at different dtypes)
     # keeps separate rows per element width because a lossy wire costs per
     # ELEMENT: answer from rows measured at the querying payload's width
@@ -366,16 +465,21 @@ def _bytes_bucket(nbytes: int) -> int:
 
 
 def select(op: str, nbytes: int, axis_size: int, codec: Optional[str] = None,
-           itemsize: int = 4) -> Decision:
+           itemsize: int = 4, axes_sig: Optional[AxesSig] = None) -> Decision:
     """Pick (algorithm, codec) for one collective; cached per
-    (op, bytes-bucket, axis-size, payload itemsize[, forced codec])."""
+    (op, bytes-bucket, axis-size, mesh factorization, payload itemsize
+    [, forced codec])."""
     if op not in OPS:
         raise ValueError(f"unknown op {op!r} (one of {OPS})")
     # the hop backend is part of the decision's identity: a cache warmed
     # while pallas hops were unavailable must not answer for a process (or
-    # restored table) where they are, and vice versa
-    key = (op, _bytes_bucket(nbytes), int(axis_size), codec, int(itemsize),
-           pallas_backend.backend_token())
+    # restored table) where they are, and vice versa. So is the mesh-axis
+    # FACTORIZATION (axes_sig): two meshes with equal world size but
+    # different axis splits — (("dp", 8),) vs (("dp", 4), ("ep", 2)) — take
+    # different schedules, so they must not share a cache entry (and a
+    # legacy axes_sig-less query must not answer a factorized one).
+    key = (op, _bytes_bucket(nbytes), int(axis_size), axes_sig, codec,
+           int(itemsize), pallas_backend.backend_token())
     with _lock:
         hit = _cache.get(key)
         if hit is not None:
@@ -390,9 +494,11 @@ def select(op: str, nbytes: int, axis_size: int, codec: Optional[str] = None,
         # A FORCED lossy codec needs an algorithmic path, so it bypasses it.
         decision = Decision(op, "lax", "none", 0.0, "model")
     elif cfg.mode == "measured" or (cfg.mode == "auto" and _measured):
-        decision = _measured_pick(op, nbytes, axis_size, codec, cfg, itemsize)
+        decision = _measured_pick(op, nbytes, axis_size, codec, cfg, itemsize,
+                                  axes_sig)
     if decision is None:
-        decision = _model_pick(op, nbytes, axis_size, codec, cfg, itemsize)
+        decision = _model_pick(op, nbytes, axis_size, codec, cfg, itemsize,
+                               axes_sig)
     with _lock:
         decision = _cache.setdefault(key, decision)
     tracer = telemetry.get_tracer()

@@ -103,19 +103,27 @@ def run_collective_bench(
 _SWEEP_OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all")
 
 
-def candidate_pairs(world: int, codecs, algorithms=None, op: Optional[str] = None):
+def candidate_pairs(world: int, codecs, algorithms=None, op: Optional[str] = None,
+                    axis: Optional[str] = None):
     """(algorithm, codec) measurement candidates for one axis size — THE
     enumeration shared by ``run_sweep`` and the observatory's probe queue,
     so online rows stay comparable with sweep rows: lax + the ppermute
     schedule families (+ the pallas algorithms when the backend is
     available), ``rhd`` only on power-of-two worlds (and never for
     ``all_to_all``, which has no recursive-halving form), the native
-    lowering never paired with a wire codec."""
+    lowering never paired with a wire codec. With ``axis`` (and an ``op``
+    the schedule compiler covers), the compiler's top synthesized
+    ``compiled:<sig>`` programs join the queue — measured mode then learns
+    real latencies for searched schedules, not just the hand-written
+    families; their codec column is the signature's lossiest level. An
+    EXPLICIT ``algorithms`` list is honored verbatim (no compiled rows):
+    a pinned sweep measures exactly what was asked."""
     from deepspeed_tpu.collectives import pallas_backend
     from deepspeed_tpu.collectives.algorithms import ALGORITHMS
     from deepspeed_tpu.collectives.pallas_backend import PALLAS_ALGORITHMS
 
-    if algorithms is None:
+    auto = algorithms is None
+    if auto:
         algorithms = ["lax"] + list(ALGORITHMS)
         if pallas_backend.available():
             algorithms += list(PALLAS_ALGORITHMS)
@@ -129,6 +137,15 @@ def candidate_pairs(world: int, codecs, algorithms=None, op: Optional[str] = Non
                 continue  # the lax lowering has no wire codec
             if (alg, cd) not in out:
                 out.append((alg, cd))
+    if auto and axis is not None:
+        from deepspeed_tpu.collectives import schedule as _schedule
+
+        if op in _schedule.SCHEDULED_OPS:
+            for sig in _schedule.candidate_signatures(op, axis, world,
+                                                      codecs=tuple(codecs)):
+                pair = (f"compiled:{sig}", _schedule.signature_codec(sig))
+                if pair not in out:
+                    out.append(pair)
     return out
 
 
@@ -215,7 +232,8 @@ def run_sweep(
         for size_mb in sizes_mb:
             elems = probe_elems(n, max(int(size_mb * 1e6 / itemsize), n))
             x = jax.device_put(jnp.ones((elems,), dtype), NamedSharding(mesh, P(axis)))
-            for alg, codec in candidate_pairs(n, codecs, algorithms, op=op):
+            for alg, codec in candidate_pairs(n, codecs, algorithms, op=op,
+                                              axis=axis):
                 fn = (_collective_fn(op, axis) if alg == "lax"
                       else _algorithmic_fn(op, axis, alg, codec, block_size))
                 out_spec = P() if op == "all_reduce" else P(axis)

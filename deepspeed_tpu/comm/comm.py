@@ -145,6 +145,23 @@ def _axis_size(axis) -> int:
     return axis_size(axis, default=1)
 
 
+def _axes_sig(axis):
+    """((name, size), ...) for the selector's decision-cache key and the
+    schedule compiler's search domain — two meshes with equal world size
+    but different axis factorizations must take different decisions. None
+    when any axis is unbound (size unknowable outside shard_map)."""
+    from deepspeed_tpu.utils.compat import axis_size
+
+    axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+    sig = []
+    for a in axes:
+        n = axis_size(a, default=0)
+        if n <= 0:
+            return None
+        sig.append((str(a), int(n)))
+    return tuple(sig)
+
+
 def _itemsize(x) -> int:
     try:
         return jnp.dtype(x.dtype).itemsize
@@ -254,7 +271,7 @@ def _algorithmic(op_name: str, x, axis, algorithm, codec, reduce_op: str = "sum"
                 getattr(x, "dtype", jnp.float32), jnp.floating):
             codec = "none"
         d = selector.select(op_name, _nbytes(x), _axis_size(axis), codec,
-                            itemsize=_itemsize(x))
+                            itemsize=_itemsize(x), axes_sig=_axes_sig(axis))
         if d.algorithm == "lax":
             # measured mode's "don't bother" verdict: the baseline won
             return None, None

@@ -503,6 +503,19 @@ class CollectivesConfig(DeepSpeedConfigModel):
     # T3-style double buffering of the zeropp qwZ gather wire: chunk count
     # (1 = off). Chunk k's dequantize overlaps chunk k+1's gather.
     overlap_chunks: int = 1
+    # Let model mode SYNTHESIZE hierarchical schedules (the GC3-style
+    # compiler, collectives/schedule.py) as candidates next to the
+    # hand-written menu, and accept `algorithm: "compiled"` /
+    # "compiled:<sig>" as facade defaults. Off by default: a multi-level
+    # schedule dominates ring on hop count under a flat alpha-beta model,
+    # so turning this on shifts auto routing across the board.
+    compiled_search: bool = False
+    # Fuse the ZeRO-3/zeropp weight-gather and tp-boundary matmuls with
+    # their collectives inside single Pallas kernels (all-gather+matmul /
+    # matmul+reduce-scatter, collectives/fused_gemm.py): grid step j
+    # computes output chunk j while chunk j-1's wire is in flight. Off by
+    # default; config-off leaves every hot path byte-identical.
+    fused_gemm_collectives: bool = False
     # The performance observatory: live hop timing, online calibration,
     # drift detection (active only when `enabled` above is too).
     observe: CollObserveConfig = Field(default_factory=CollObserveConfig)

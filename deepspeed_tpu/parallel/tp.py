@@ -1,0 +1,59 @@
+"""Tensor-parallel linear helpers over the fused GEMM⇄collective kernels.
+
+Megatron-style row/column-parallel linear layers expressed at the
+shard_map level (``autotp.py`` handles parameter *placement*; this module
+is the matching *execution* path). The row-parallel boundary — the one
+that actually moves bytes — routes through
+:mod:`deepspeed_tpu.collectives.fused_gemm` when the
+``collectives.fused_gemm_collectives`` knob is on, so the partial-product
+reduce-scatter overlaps the GEMM inside one Pallas kernel per hop (T3);
+with the knob off both helpers lower to the plain lax composition,
+byte-identical to hand-written layers.
+
+All helpers must run inside full-manual shard_map with ``axis`` bound.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.collectives import fused_gemm
+from deepspeed_tpu.utils.compat import axis_size
+
+
+def column_parallel_linear(x: jax.Array, w_col: jax.Array) -> jax.Array:
+    """``x [M, K] @ w_col [K, N/n] -> [M, N/n]``: the column-parallel half
+    moves no bytes (input replicated, output column-sharded) — it exists so
+    a col->row pair reads as a pair. fp32 out like the fused ops."""
+    return lax.dot_general(x.astype(jnp.float32), w_col.astype(jnp.float32),
+                           (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+def row_parallel_linear(x_shard: jax.Array, w_row: jax.Array, axis: str, *,
+                        scatter_output: bool = True,
+                        quantize: bool = False,
+                        block: Optional[int] = None) -> jax.Array:
+    """Row-parallel linear: ``x_shard [M, K/n] @ w_row [K/n, N]`` summed
+    over ``axis``.
+
+    ``scatter_output=True`` returns the sequence-parallel form — rank ``i``
+    gets row block ``i`` of ``[M/n, N]`` (fused: every ring hop's partial
+    GEMM computes while the previous chunk's wire flies; unfused: one dot
+    + ``psum_scatter``). ``False`` returns the replicated ``[M, N]``
+    (always the plain dot + ``psum`` — there is no wire to hide a GEMM
+    behind when every rank needs every row). ``quantize`` puts the int8
+    block wire on the fused hops. fp32 out; full-manual shard_map only."""
+    if not scatter_output:
+        p = lax.dot_general(x_shard.astype(jnp.float32),
+                            w_row.astype(jnp.float32),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        return lax.psum(p, axis) if axis_size(axis) > 1 else p
+    return fused_gemm.matmul_reduce_scatter(
+        x_shard, w_row, axis, codec="int8" if quantize else None,
+        block_size=block)

@@ -301,3 +301,52 @@ def gather_params_for_compute(params, plans, qw: bool, qg: bool, block: int = DE
                                           other, qw, err_beta, block, overlap_chunks)
 
     return jax.tree_util.tree_map(one_loco, params, errors, plans)
+
+
+# --------------------------------------------------------- fused-gather GEMM
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def sharded_matmul(x: jax.Array, w_shard: jax.Array, axis: str,
+                   quantize: bool = False, block: int = DEFAULT_BLOCK) -> jax.Array:
+    """``x [M, K] @ W [K, N]`` with ``W`` row-sharded over ``axis`` and the
+    stage-3 weight gather fused INTO the GEMM (T3): the forward never
+    materializes the full weight — each fused ring hop contracts the held
+    shard against ``x`` while its wire is in flight
+    (:func:`deepspeed_tpu.collectives.fused_gemm.all_gather_matmul`).
+
+    backward: ``dw_shard`` comes back through the fused
+    matmul+reduce-scatter (``reduce_scatter(x^T @ g, rows)`` — SUM over the
+    axis, matching per-rank-batch partials), and ``dx = g @ W^T`` through
+    the fused gather's independent-column-block form — neither direction
+    materializes the full weight or the full gradient.
+
+    ``quantize`` puts the int8 block wire (qwZ/qgZ) on every fused hop.
+    With ``fused_gemm.configure(enabled=False)`` (the default; engine knob
+    ``collectives.fused_gemm_collectives``) every path lowers to the plain
+    lax composition — programs byte-identical to a build without the fused
+    kernels. Must run inside full-manual shard_map; returns fp32.
+    """
+    from deepspeed_tpu.collectives import fused_gemm
+
+    return fused_gemm.all_gather_matmul(
+        x, w_shard, axis, codec="int8" if quantize else None, block_size=block)
+
+
+def _smm_fwd(x, w_shard, axis, quantize, block):
+    return sharded_matmul(x, w_shard, axis, quantize, block), (x, w_shard)
+
+
+def _smm_bwd(axis, quantize, block, res, g):
+    from deepspeed_tpu.collectives import fused_gemm
+
+    x, w_shard = res
+    codec = "int8" if quantize else None
+    dx = fused_gemm.all_gather_matmul(g, w_shard, axis, codec=codec,
+                                      block_size=block, out_block=True)
+    dw = fused_gemm.matmul_reduce_scatter(
+        jnp.swapaxes(x, 0, 1), g, axis, codec=codec, block_size=block)
+    return dx.astype(x.dtype), dw.astype(w_shard.dtype)
+
+
+sharded_matmul.defvjp(_smm_fwd, _smm_bwd)

@@ -426,6 +426,9 @@ class DeepSpeedTPUEngine:
                     "installed by a previously constructed engine; set "
                     "collectives.enabled in this engine's config to keep it")
             coll_selector.configure()
+            from deepspeed_tpu.collectives import fused_gemm as _fused_gemm
+
+            _fused_gemm.configure(enabled=False)
         else:
             # Facade defaults inject ppermute hops into EVERY default-routed
             # collective — including ones traced inside partial-manual
@@ -470,10 +473,17 @@ class DeepSpeedTPUEngine:
                 min_quant_bytes=ccfg.min_quant_bytes,
                 min_algorithmic_bytes=ccfg.min_algorithmic_bytes,
                 pallas_alpha_scale=ccfg.pallas_alpha_scale,
+                compiled_search=ccfg.compiled_search,
                 facade_algorithm=facade_alg,
                 # "auto" = no forced codec: the selector picks among `codecs`;
                 # a concrete name (incl. "none") pins that wire
                 facade_codec=ccfg.codec if ccfg.codec != "auto" else None)
+            # in-kernel compute-collective fusion (collectives/fused_gemm):
+            # process-global knob like the selector; the zeropp sharded
+            # matmuls and tp helpers consult it at trace time
+            from deepspeed_tpu.collectives import fused_gemm as _fused_gemm
+
+            _fused_gemm.configure(enabled=ccfg.fused_gemm_collectives)
             if ocfg.enabled:
                 from deepspeed_tpu.collectives import observatory as coll_obs
 

@@ -56,6 +56,12 @@ HEADLINE_PATTERNS: Dict[str, Tuple[str, ...]] = {
     # remote replica adds over a local one (bench_serving --remote)
     "fabric": ("remote/dispatch_rtt_ms/p50", "remote/wire_migration_ms",
                "remote/drain_handoff_ms"),
+    # collective schedule compiler + fused GEMM collectives (ISSUE 19):
+    # the compiled-vs-best-hand predicted-latency ratio must not drift up
+    # (the search regressing against its own cost model), and the fused
+    # ZeRO-3 step must not get slower relative to its unfused twin
+    "schedule": ("compiled_vs_hand/pred_ratio",
+                 "fused_gemm/step_time_ratio"),
 }
 
 #: matched AFTER the headline patterns: derived ratios ride along with a

@@ -138,10 +138,15 @@ def test_lint_scans_telemetry_and_serving_sources():
         # daemon mint the fabric/* RPC + liveness series
         os.path.join("deepspeed_tpu", "fabric", f)
         for f in ("remote.py", "replica_daemon.py")
+    } | {
+        # schedule compiler (ISSUE 19): compile_schedule mints the
+        # coll/schedule_* search census
+        os.path.join("deepspeed_tpu", "collectives", "schedule.py"),
     } | {os.path.join("tools", "bench_serving.py"),
          os.path.join("tools", "fabric_smoke.py"),
          os.path.join("tools", "fleet_smoke.py"),
          os.path.join("tools", "numerics_smoke.py"),
+         os.path.join("tools", "schedule_smoke.py"),
          os.path.join("tools", "trace_merge.py")}
     missing = expected - scanned
     assert not missing, f"metric-minting files escaped the lint walk: {sorted(missing)}"
@@ -188,7 +193,11 @@ def test_known_names_pass_and_bad_names_fail():
                  "fabric/rpcs", "fabric/rpc_ms", "fabric/heartbeat_misses",
                  "fabric/dead_replicas", "fabric/wire_migration_ms",
                  "fabric/wire_bytes", "fabric/drains", "fabric/preempts",
-                 "router/dead_replicas", "router/drains"):
+                 "router/dead_replicas", "router/drains",
+                 # schedule compiler (ISSUE 19): per-compile search census
+                 # next to the observatory's coll/* calibration family
+                 "coll/schedule_compiles", "coll/schedule_candidates",
+                 "coll/schedule_pred_us", "coll/schedule_levels"):
         assert _check_name(good) is None, good
     for bad in ("ttft", "Serving/ttft", "serving ttft", "{x}/y", "bogus/name"):
         assert _check_name(bad) is not None, bad

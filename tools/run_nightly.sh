@@ -195,6 +195,29 @@ numerics_rc=${PIPESTATUS[0]}
 [ "${numerics_rc}" -ne 0 ] && rc=1
 echo "# numerics smoke: ${NUMERICS_OUT} (exit ${numerics_rc})" >> "${OUT}"
 
+# Collective schedule compiler + fused GEMM smoke (ISSUE 19), exit-gated:
+# synthesized hop programs must execute bit-identically to jax.lax on the
+# CPU mesh (1D ring AND a (4,2) sub-ring factorization), the compiled
+# schedule must be >= parity with the best hand-written pick under the
+# selector's own calibrated cost model (and a beta-dominant refit must
+# flip the SAME query back to a hand pick — the model is live, not a
+# frozen copy), and the fused ZeRO-3 sharded_matmul trajectory must track
+# the unfused composition over a multi-step SGD loop. Headline ratios
+# (compiled_vs_hand/pred_ratio, fused_gemm/step_time_ratio) land in the
+# unified perf ledger, suite "schedule", for next round's MAD gate.
+SCHED_OUT="SCHED_${ROUND}.log"
+{
+  echo "# schedule compiler smoke — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  echo "# HEAD: ${HEAD_SHA}"
+  echo "# uncommitted-diff sha256: ${DIFF_SHA}"
+  echo "# cmd: python tools/schedule_smoke.py --ledger"
+} > "${SCHED_OUT}"
+JAX_PLATFORMS=cpu python tools/schedule_smoke.py --ledger 2>/dev/null \
+  | tee -a "${SCHED_OUT}"
+sched_rc=${PIPESTATUS[0]}
+[ "${sched_rc}" -ne 0 ] && rc=1
+echo "# schedule smoke: ${SCHED_OUT} (exit ${sched_rc})" >> "${OUT}"
+
 # Cross-process serving fabric smoke (ISSUE 18): real replica-daemon
 # processes behind the unchanged router. Exit-gates: remote greedy decode
 # token-identical to a local engine on bf16 AND int8 KV, cross-process
