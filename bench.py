@@ -1158,6 +1158,93 @@ def bench_fleet_overhead():
     }
 
 
+def bench_event_plane_overhead():
+    """Host overhead of the incident plane (``telemetry/events.py`` +
+    ``telemetry/alerts.py``) — the <2% bound ISSUE 20 commits to, same
+    paired-step discipline as the PR-5/7/11/13/16 guards.
+
+    On-steps emit one typed structured event right after the loss sync
+    (lock + ring append + counter mints + per-subscriber fanout) and every
+    ``cadence``-th on-step pays a full ``AlertEngine.evaluate()`` over the
+    default rule pack on the clock — the exact host work a detector site
+    and the alert cadence thread add to a production step. One event per
+    STEP plus an evaluate every 5 steps is far denser than any real run
+    (detectors only emit on anomalies; the cadence thread defaults to a
+    5-second wall-clock interval), so the bound holds with margin. The
+    step program itself never changes: emission is host-side only, which
+    the jaxpr-identity pin in tests/unit/test_events_alerts.py enforces."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, causal_lm_spec
+    from deepspeed_tpu.telemetry import alerts as alerts_mod
+    from deepspeed_tpu.telemetry import events as events_mod
+
+    cfg = TransformerConfig(
+        vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, max_seq_len=256,
+    )
+    seq, micro, cadence, pairs, warmup = 256, 4, 5, 60, 5
+    engine, *_ = deepspeed_tpu.initialize(
+        model=causal_lm_spec(cfg, example_seq_len=seq),
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 1},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10_000,
+            "telemetry": {"enabled": True},
+        })
+    stream = events_mod.configure_events(capacity=4096, jsonl_path=None)
+    stream.clear()
+    alert_eng = alerts_mod.configure_alerts()  # default rule pack, no sinks
+    emit = events_mod.emit_event
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, (engine.train_batch_size, seq), dtype=np.int32)}
+    for _ in range(warmup):
+        m = engine.train_batch(batch)
+    np.asarray(m["loss"])
+    alert_eng.evaluate()  # first evaluate (lazy rule state) off the clock
+
+    on_steps = [0]
+
+    def one_step(plane_on):
+        t0 = time.perf_counter()
+        m = engine.train_batch(batch)
+        if plane_on:
+            on_steps[0] += 1
+            emit("bench", "step_tick",
+                 f"bench event-plane tick {on_steps[0]}", severity="info",
+                 labels={"bench": "event_plane_overhead"}, step=on_steps[0])
+            if on_steps[0] % cadence == 0:
+                alert_eng.evaluate()
+        np.asarray(m["loss"])  # paired timing needs the per-step sync
+        return time.perf_counter() - t0
+
+    t_off = t_on = 0.0
+    for _ in range(pairs):  # pairs % cadence == 0: whole evaluate cycles
+        t_off += one_step(False)
+        t_on += one_step(True)
+
+    ms_off = t_off / pairs * 1e3
+    ms_on = t_on / pairs * 1e3
+    overhead_pct = (ms_on - ms_off) / ms_off * 100.0
+    return {
+        "model": "gpt2_cpu_bench_2L_128h_seq256_micro4",
+        "evaluate_every_n_steps": cadence,
+        "ms_per_step_events_off": round(ms_off, 3),
+        "ms_per_step_events_on": round(ms_on, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "bound_pct": 2.0,
+        "within_bound": bool(overhead_pct < 2.0),
+        "events_emitted": stream.total_emitted,
+        "alert_rules": len(alert_eng.rules),
+        "firing_alerts": [f["rule"] for f in alert_eng.firing()],
+    }
+
+
 def bench_perf_ledger_overhead():
     """Row-emission overhead of the unified perf ledger
     (``telemetry/perfledger.py``) — the <2% bound ISSUE 16 commits to, same
@@ -1446,6 +1533,7 @@ EXTRA_BENCHES = {
     "compile_observability": (lambda peak: bench_compile_observability(), 420),
     "coll_observability": (lambda peak: bench_coll_observability(), 420),
     "fleet_export_overhead": (lambda peak: bench_fleet_overhead(), 420),
+    "event_plane_overhead": (lambda peak: bench_event_plane_overhead(), 420),
     "perf_ledger_overhead": (lambda peak: bench_perf_ledger_overhead(), 420),
     "numerics_overhead": (lambda peak: bench_numerics_overhead(), 420),
     "schedule_compiler": (lambda peak: bench_schedule_compiler(), 420),
@@ -1727,6 +1815,13 @@ def main() -> None:
         extras["fleet_export_overhead"] = bench_fleet_overhead()
     except Exception as e:  # noqa: BLE001
         extras["fleet_export_overhead"] = {"error": str(e)[:200]}
+    # Incident-plane overhead (typed event emit per step + default-rule
+    # alert evaluate every 5 steps around an unchanged step program) is
+    # pure host work — CPU-measurable, same <2% bound as on chip (ISSUE 20).
+    try:
+        extras["event_plane_overhead"] = bench_event_plane_overhead()
+    except Exception as e:  # noqa: BLE001
+        extras["event_plane_overhead"] = {"error": str(e)[:200]}
     # MoE ep x tp collective dispatch: step-shape + numeric-bound evidence
     # for the quantized token wire (ISSUE 15); needs the 8-device CPU mesh.
     try:

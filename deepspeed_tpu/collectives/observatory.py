@@ -157,7 +157,14 @@ class CollectiveObservatory:
     def __init__(self):
         self.config = ObservatoryConfig()
         self._lock = threading.Lock()
-        self._warn_lock = threading.Lock()
+        # shared warn-once helper (telemetry/events.py): its OWN lock —
+        # callers (note_route's capacity branch) may already hold the
+        # non-reentrant self._lock — and every first warning also lands on
+        # the typed event stream
+        from deepspeed_tpu.telemetry.events import WarnOnceSet
+
+        self._warn_once_set = WarnOnceSet(subsystem="coll",
+                                          default_kind="observatory_warning")
         self._tls = threading.local()
         self._routes: Dict[Tuple, RouteInfo] = {}
         self._mesh = None
@@ -185,7 +192,6 @@ class CollectiveObservatory:
         self._fit_stats: Dict[str, List[float]] = {}
         self.calibration: Dict[str, Tuple[float, float]] = {}
         self.drift_events = 0
-        self._warned: set = set()
         # the ONE timing idiom (bench + sweep + probes), resolved lazily at
         # first probe; monkeypatchable in tests to inject a slow hop
         # without slowing the suite
@@ -216,7 +222,7 @@ class CollectiveObservatory:
             self._merged_samples = 0
             self._pending_program_wire = 0
             self.drift_events = 0
-            self._warned = set()
+            self._warn_once_set.reset()
             self._timer = None  # drop any injected test timer with the state
             # install() targets belong to the engine that configured us:
             # keeping a torn-down engine's mesh or diagnostics arm callable
@@ -773,13 +779,20 @@ class CollectiveObservatory:
             return
         self.drift_events += 1
         direction = "slower" if ratio > 1 else "faster"
-        logger.warning(
+        msg = (
             f"COLLECTIVE DRIFT: {op} routed {algorithm}/{codec} "
             f"({backend}, {nbytes}B x{world}) measured {latency_ms:.3f} ms "
             f"vs predicted {predicted / 1e3:.3f} ms — {ratio:.1f}x "
             f"{direction} than the cost model (threshold {thresh}x). The "
             "selector may be mis-routing this mesh; re-sweep or let the "
             "observatory's refit converge. Arming profiler capture.")
+        logger.warning(msg)
+        from deepspeed_tpu.telemetry.events import emit_event
+
+        emit_event("coll", "drift", msg, severity="warn",
+                   labels={"op": op, "algorithm": algorithm, "codec": codec,
+                           "backend": backend},
+                   dedup_key=f"coll:drift:{op}/{algorithm}/{codec}/{backend}")
         if tracer.enabled:
             tracer.registry.counter("coll/drift_events").add(1.0)
             tracer.instant("coll:drift", cat="coll", op=op,
@@ -898,13 +911,7 @@ class CollectiveObservatory:
             return list(self._routes.values())
 
     def _warn_once(self, key, msg: str) -> None:
-        # guarded by its OWN lock: callers (note_route's capacity branch)
-        # may already hold the non-reentrant self._lock
-        with self._warn_lock:
-            if key in self._warned:
-                return
-            self._warned.add(key)
-        logger.warning(msg)
+        self._warn_once_set(str(key), msg, log=logger)
 
 
 def _fit_alpha_beta(stats: List[float]) -> Optional[Tuple[float, float]]:

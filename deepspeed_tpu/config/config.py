@@ -262,6 +262,26 @@ class TelemetryConfig(DeepSpeedConfigModel):
     # (train | router | replica | collector | worker); None keeps the
     # $DSTPU_ROLE / default resolution.
     fleet_role: Optional[str] = None
+    # ---- incident plane (telemetry/events.py + telemetry/alerts.py) ----
+    # Structured event stream: bounded ring of typed detector events
+    # (always on — emission is a lock + deque append; the knobs below only
+    # size the ring / route the JSONL export next to the trace stream).
+    events_capacity: int = 2048
+    events_dedup_window_s: float = 300.0
+    # Event JSONL export path; None = $DSTPU_TELEMETRY_DIR/event_log.jsonl
+    # when telemetry is enabled, written at monitor flushes.
+    events_jsonl_path: Optional[str] = None
+    # Declarative alert engine over the registry + event stream. When
+    # enabled, the default rule pack (numerics divergence, collective
+    # drift, perf regressions, dead replicas, RPC failures, health aborts,
+    # recompile storms) evaluates on a daemon thread at this cadence.
+    alerts_enabled: bool = False
+    alerts_interval_s: float = 5.0
+    # Optional sinks beyond the log: JSONL notification stream, and a
+    # webhook POSTed from a worker thread that never raises (PR-13
+    # push_async discipline).
+    alerts_jsonl_path: Optional[str] = None
+    alerts_webhook_url: Optional[str] = None
 
 
 class HealthConfig(DeepSpeedConfigModel):

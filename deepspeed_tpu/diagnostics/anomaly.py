@@ -21,6 +21,7 @@ import collections
 import statistics
 from typing import Dict, Optional
 
+from deepspeed_tpu.telemetry.events import emit_event
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -62,27 +63,32 @@ class StepTimeAnomalyDetector:
         if dur_s > med + self.straggler_mads * mad:
             flags["straggler"] = True
             self.stragglers += 1
-            logger.warning(
-                f"[anomaly/{self.name}] straggler step"
-                + (f" {step}" if step is not None else "")
-                + f": {dur_s * 1e3:.1f} ms vs median {med * 1e3:.1f} ms "
-                f"(MAD {mad * 1e3:.2f} ms)")
+            msg = (f"[anomaly/{self.name}] straggler step"
+                   + (f" {step}" if step is not None else "")
+                   + f": {dur_s * 1e3:.1f} ms vs median {med * 1e3:.1f} ms "
+                   f"(MAD {mad * 1e3:.2f} ms)")
+            logger.warning(msg)
             self._tracer.instant(f"straggler:{self.name}", cat="diagnostics",
                                  dur_ms=round(dur_s * 1e3, 3),
                                  median_ms=round(med * 1e3, 3))
+            emit_event("anomaly", "straggler", msg, severity="warn",
+                       labels={"name": self.name}, step=step,
+                       dedup_key=f"anomaly:straggler:{self.name}")
         recent_n = max(len(self._durs) // 4, self.min_samples // 2)
         recent = list(self._durs)[-recent_n:]
         recent_med = statistics.median(recent)
         regressing = recent_med > self.regression_factor * med
         flags["regression"] = regressing
         if regressing and not self._regressing:
-            logger.warning(
-                f"[anomaly/{self.name}] sustained step-time regression: recent "
-                f"median {recent_med * 1e3:.1f} ms vs window median "
-                f"{med * 1e3:.1f} ms (> {self.regression_factor:.2f}x)")
+            msg = (f"[anomaly/{self.name}] sustained step-time regression: "
+                   f"recent median {recent_med * 1e3:.1f} ms vs window median "
+                   f"{med * 1e3:.1f} ms (> {self.regression_factor:.2f}x)")
+            logger.warning(msg)
             self._tracer.instant(f"regression:{self.name}", cat="diagnostics",
                                  recent_ms=round(recent_med * 1e3, 3),
                                  median_ms=round(med * 1e3, 3))
+            emit_event("anomaly", "regression", msg, severity="warn",
+                       labels={"name": self.name}, step=step)
         self._regressing = regressing
 
         reg = self._tracer.registry

@@ -175,12 +175,19 @@ class DiagnosticsManager:
                 dump_path = self.dump(reason="health_abort")
                 bad = [s for s in ("nonfinite_any", "grad_spike", "loss_spike")
                        if verdicts.get(f"health/{s}")]
+                msg = (f"training health abort at step {step}: "
+                       f"{', '.join(bad) or 'health signal'} fired "
+                       f"(verdicts: {verdicts})"
+                       + (f"; flight record: {dump_path}" if dump_path else ""))
+                from deepspeed_tpu.telemetry.events import emit_event
+
+                emit_event("health", "abort", msg, severity="critical",
+                           labels={"signals": ",".join(bad) or "unknown",
+                                   **({"dump": dump_path} if dump_path
+                                      else {})},
+                           step=step)
                 raise TrainingHealthError(
-                    f"training health abort at step {step}: "
-                    f"{', '.join(bad) or 'health signal'} fired "
-                    f"(verdicts: {verdicts})"
-                    + (f"; flight record: {dump_path}" if dump_path else ""),
-                    step=step, verdicts=verdicts, dump_path=dump_path)
+                    msg, step=step, verdicts=verdicts, dump_path=dump_path)
 
     # ------------------------------------------------------------------ dump
     def dump(self, reason: str = "manual", path: Optional[str] = None) -> Optional[str]:

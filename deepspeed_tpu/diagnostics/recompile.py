@@ -219,6 +219,11 @@ class RecompileDetector:
         ev["message"] = msg
         self._tracer.instant(f"recompile:{self.name}", cat="diagnostics",
                              label=label, diff=diff[:6])
+        from deepspeed_tpu.telemetry.events import emit_event
+
+        emit_event("recompile", "recompile", msg, severity="warn",
+                   labels={"detector": self.name, "program": label},
+                   dedup_key=f"recompile:{self.name}:{label}")
         self._recent.append(now)
         if (len(self._recent) == self.storm_threshold
                 and now - self._recent[0] <= self.storm_window_s):
@@ -232,5 +237,9 @@ class RecompileDetector:
                                     "message": storm})
                 self._tracer.instant(f"recompile_storm:{self.name}", cat="diagnostics",
                                      label=label)
+                from deepspeed_tpu.telemetry.events import emit_event
+
+                emit_event("recompile", "storm", storm, severity="critical",
+                           labels={"detector": self.name, "program": label})
         else:
             self._storm_reported = False

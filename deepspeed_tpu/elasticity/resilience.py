@@ -263,11 +263,16 @@ def run_resilient(
                                 or report.flight_record)
         exc.recovery_report = report
         _fleet_push("failed", reason=reason)
-        logger.error(
-            f"run_resilient: giving up after {report.rewinds} rewind(s) — "
-            f"{reason}"
-            + (f"; flight record: {report.flight_record}"
-               if report.flight_record else ""))
+        msg = (f"run_resilient: giving up after {report.rewinds} rewind(s) — "
+               f"{reason}"
+               + (f"; flight record: {report.flight_record}"
+                  if report.flight_record else ""))
+        logger.error(msg)
+        from deepspeed_tpu.telemetry.events import emit_event
+
+        emit_event("resilience", "give_up", msg, severity="critical",
+                   labels={"reason": reason, "rewinds": report.rewinds},
+                   step=int(engine.global_steps))
         raise exc
 
     def _preempt_exit(at_step: int):
@@ -285,6 +290,12 @@ def run_resilient(
         _sync_save_failures()
         report.steps_completed = at_step
         _fleet_push("preempted", step=at_step)
+        from deepspeed_tpu.telemetry.events import emit_event
+
+        emit_event("resilience", "preempted",
+                   f"run_resilient: preemption signal honored at step "
+                   f"{at_step} — snapshot committed, exiting {EXIT_PREEMPTED}",
+                   severity="warn", step=at_step)
         log_dist(
             f"run_resilient: preemption signal honored at step {at_step} — "
             f"snapshot committed, exiting {EXIT_PREEMPTED}", ranks=[0])
@@ -353,6 +364,14 @@ def run_resilient(
             if on_rewind is not None:
                 on_rewind(entry)
             _fleet_push("rewound", tag=tag, step=step)
+            from deepspeed_tpu.telemetry.events import emit_event
+
+            emit_event("resilience", "rewind",
+                       f"run_resilient: rewound to snapshot {tag!r} "
+                       f"(step {step}) after: {e}",
+                       severity="warn",
+                       labels={"tag": tag, "rewind": report.rewinds},
+                       step=step)
             if backoff > 0:
                 time.sleep(backoff)
             continue

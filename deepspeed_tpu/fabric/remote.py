@@ -197,6 +197,14 @@ class RemoteReplica:
                 if self._tracer.enabled:
                     self._tracer.registry.counter(
                         "fabric/dead_replicas").add(1)
+                from deepspeed_tpu.telemetry.events import emit_event
+
+                emit_event(
+                    "fabric", "replica_unreachable",
+                    f"remote replica {self.url} unreachable: "
+                    f"{self.heartbeat_misses} consecutive heartbeat misses",
+                    severity="critical", labels={"url": self.url},
+                    dedup_key=f"fabric:replica_unreachable:{self.url}")
             return False
         self.heartbeat_misses = 0
         self.last_heartbeat = doc
@@ -222,10 +230,28 @@ class RemoteReplica:
 
     # ----------------------------------------------------------------- rpc
     def _rpc(self, path: str, doc: Dict) -> Dict:
+        endpoint = path.lstrip("/")
         t0 = time.perf_counter()
-        ack = _post(self.url, path, doc, self.timeout)
+        try:
+            ack = _post(self.url, path, doc, self.timeout)
+        except RemoteReplicaDownError as e:
+            # a 400 (ValueError) is the replica answering — only transport
+            # failures count against the endpoint and land on the event
+            # stream (the alert engine's rpc_failures rule reads these)
+            if self._tracer.enabled:
+                self._tracer.registry.counter(
+                    "fabric/rpc_failures", endpoint=endpoint).add(1)
+            from deepspeed_tpu.telemetry.events import emit_event
+
+            emit_event("fabric", "rpc_failure",
+                       f"fabric RPC {endpoint} to {self.url} failed: {e}",
+                       severity="warn",
+                       labels={"endpoint": endpoint, "url": self.url},
+                       dedup_key=f"fabric:rpc_failure:{self.url}:{endpoint}")
+            raise
         if self._tracer.enabled:
-            self._tracer.registry.histogram("fabric/rpc_ms").observe(
+            self._tracer.registry.histogram(
+                "fabric/rpc_ms", endpoint=endpoint).observe(
                 (time.perf_counter() - t0) * 1e3)
         return ack
 

@@ -87,21 +87,24 @@ class ReplicaDaemon:
                 "/stats": self._get_stats,
             },
             post_routes={
-                "/admit": self._post_admit,
-                "/prefill": self._post_prefill,
-                "/chain_round": self._post_chain_round,
-                "/can_schedule": self._post_can_schedule,
-                "/query": self._post_query,
-                "/flush": self._post_flush,
-                "/preempt": self._post_preempt,
-                "/insert_prefix": self._post_insert_prefix,
-                "/export_request": self._post_export_request,
-                "/import_request": self._post_import_request,
-                "/can_import": self._post_can_import,
-                "/block_hashes": self._post_block_hashes,
-                "/drain": self._post_drain,
-                "/dump_trace": self._post_dump_trace,
-                "/shutdown": self._post_shutdown,
+                path: self._timed(path.lstrip("/"), fn)
+                for path, fn in {
+                    "/admit": self._post_admit,
+                    "/prefill": self._post_prefill,
+                    "/chain_round": self._post_chain_round,
+                    "/can_schedule": self._post_can_schedule,
+                    "/query": self._post_query,
+                    "/flush": self._post_flush,
+                    "/preempt": self._post_preempt,
+                    "/insert_prefix": self._post_insert_prefix,
+                    "/export_request": self._post_export_request,
+                    "/import_request": self._post_import_request,
+                    "/can_import": self._post_can_import,
+                    "/block_hashes": self._post_block_hashes,
+                    "/drain": self._post_drain,
+                    "/dump_trace": self._post_dump_trace,
+                    "/shutdown": self._post_shutdown,
+                }.items()
             },
             port=port, host=host, name="dstpu-replica-daemon")
 
@@ -123,6 +126,36 @@ class ReplicaDaemon:
     def _count(self, name: str, n: int = 1) -> None:
         if self._tracer.enabled:
             self._tracer.registry.counter(name).add(n)
+
+    def _timed(self, endpoint: str, fn):
+        """Per-endpoint server-side RPC accounting. Distinct metric names
+        from the client's ``fabric/rpc_ms{endpoint=}`` so federation never
+        merges client round-trip and server handler time into one
+        histogram. Failures re-raise unchanged (RouteServer's 400/500
+        mapping is the protocol) after counting + an event."""
+        def handler(doc: Dict) -> Dict:
+            t0 = time.perf_counter()
+            try:
+                out = fn(doc)
+            except Exception as e:
+                if self._tracer.enabled:
+                    self._tracer.registry.counter(
+                        "fabric/rpc_server_failures", endpoint=endpoint).add(1)
+                from deepspeed_tpu.telemetry.events import emit_event
+
+                emit_event(
+                    "fabric", "rpc_server_failure",
+                    f"replica daemon RPC {endpoint} raised "
+                    f"{type(e).__name__}: {e}",
+                    severity="warn", labels={"endpoint": endpoint},
+                    dedup_key=f"fabric:rpc_server_failure:{endpoint}")
+                raise
+            if self._tracer.enabled:
+                self._tracer.registry.histogram(
+                    "fabric/rpc_server_ms", endpoint=endpoint).observe(
+                    (time.perf_counter() - t0) * 1e3)
+            return out
+        return handler
 
     # ------------------------------------------------------------------ GET
     def _get_healthz(self) -> Tuple[bytes, str]:

@@ -669,10 +669,15 @@ class ServingRouter:
         self.dead_replicas += 1
         if self._tracer.enabled:
             self._tracer.registry.counter("router/dead_replicas").add(1.0)
-        logger.warning(
-            f"replica {rep.index} marked dead "
-            f"({len(rep.active)} active, {len(rep.assigned)} assigned): "
-            "re-admitting its requests on survivors")
+        msg = (f"replica {rep.index} marked dead "
+               f"({len(rep.active)} active, {len(rep.assigned)} assigned): "
+               "re-admitting its requests on survivors")
+        logger.warning(msg)
+        from deepspeed_tpu.telemetry.events import emit_event
+
+        emit_event("fabric", "replica_dead", msg, severity="critical",
+                   labels={"replica": rep.index, "role": rep.role,
+                           "active": len(rep.active)})
         if S is None:
             rep.active.clear()
             rep.order.clear()
@@ -877,10 +882,17 @@ class ServingRouter:
                 with span:
                     ok = rep.engine.import_request(new_uid, ticket.export)
             except Exception:  # noqa: BLE001 — failure degrades, never drops
-                logger.warning(
-                    f"migration of request {ticket.idx} to replica "
-                    f"{rep.index} failed; serving mixed on replica "
-                    f"{ticket.src}", exc_info=True)
+                msg = (f"migration of request {ticket.idx} to replica "
+                       f"{rep.index} failed; serving mixed on replica "
+                       f"{ticket.src}")
+                logger.warning(msg, exc_info=True)
+                from deepspeed_tpu.telemetry.events import emit_event
+
+                emit_event("fabric", "migration_failure", msg,
+                           severity="warn",
+                           labels={"replica": rep.index, "src": ticket.src},
+                           request_id=ticket.idx,
+                           dedup_key=f"fabric:migration_failure:{rep.index}")
             now = self._clock()
             with self._lock:
                 src_rep = self.replicas[ticket.src]

@@ -391,6 +391,27 @@ class DeepSpeedTPUEngine:
                     log_dist(
                         f"telemetry: /metrics on port {self._metrics_server.port}",
                         ranks=[0])
+        # Incident plane (telemetry/events.py + alerts.py): size the typed
+        # event ring and wire the JSONL export next to the trace stream;
+        # the alert engine's daemon-thread evaluation is its own opt-in.
+        from deepspeed_tpu.telemetry import events as events_mod
+
+        events_mod.configure_events(
+            capacity=tcfg.events_capacity,
+            dedup_window_s=tcfg.events_dedup_window_s,
+            jsonl_path=(tcfg.events_jsonl_path
+                        if tcfg.events_jsonl_path is not None
+                        else (os.path.join(
+                            telemetry_mod.default_output_dir(),
+                            "event_log.jsonl") if tcfg.enabled else None)))
+        self._alert_engine = None
+        if tcfg.alerts_enabled:
+            from deepspeed_tpu.telemetry import alerts as alerts_mod
+
+            self._alert_engine = alerts_mod.configure_alerts(
+                jsonl_path=tcfg.alerts_jsonl_path,
+                webhook_url=tcfg.alerts_webhook_url,
+                interval_s=tcfg.alerts_interval_s)
         self._fleet_client = None
         if tcfg.fleet_url:
             # fleet federation: register with the collector (identity +

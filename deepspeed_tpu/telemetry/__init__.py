@@ -34,8 +34,33 @@ log-bucket histograms merge bucket-wise, gauges keep last-per-process
 under ``{proc=}``), cross-process trace contexts whose flow arrows join
 in ``tools/trace_merge.py``, and a cluster health ledger of per-process
 heartbeats. See docs/telemetry.md "Fleet telemetry".
+
+The INCIDENT plane (``events.py`` + ``alerts.py`` + the collector's
+``/events``, ``/incidents``, ``/console`` routes) types the warnings:
+every detector also emits a structured :class:`Event` onto a bounded
+process-local stream, a declarative :class:`AlertEngine` evaluates
+threshold/absence/event-rate rules over the registry + stream with a
+pending→firing→resolved state machine, and the collector correlates
+shipped events into cross-process incidents. See docs/telemetry.md
+"Events, alerts, incidents".
 """
 
+from deepspeed_tpu.telemetry.alerts import (
+    AlertEngine,
+    AlertRule,
+    configure_alerts,
+    default_rules,
+    get_alert_engine,
+)
+from deepspeed_tpu.telemetry.events import (
+    Event,
+    EventStream,
+    WarnOnceSet,
+    configure_events,
+    emit_event,
+    get_event_stream,
+    warn_once,
+)
 from deepspeed_tpu.telemetry.exporters import (
     chrome_trace_events,
     default_output_dir,
@@ -77,7 +102,11 @@ from deepspeed_tpu.telemetry.tracer import (
 )
 
 __all__ = [
+    "AlertEngine",
+    "AlertRule",
     "Counter",
+    "Event",
+    "EventStream",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
@@ -87,16 +116,23 @@ __all__ = [
     "ProcessIdentity",
     "TraceContext",
     "Tracer",
+    "WarnOnceSet",
     "chrome_trace_events",
     "configure",
+    "configure_alerts",
+    "configure_events",
     "configure_identity",
     "default_output_dir",
+    "default_rules",
+    "emit_event",
     "enabled",
     "env_enabled",
     "export_chrome_trace",
     "export_json_snapshot",
     "export_jsonl",
     "export_prometheus",
+    "get_alert_engine",
+    "get_event_stream",
     "get_identity",
     "get_tracer",
     "make_row",
@@ -104,4 +140,5 @@ __all__ = [
     "render_prometheus",
     "serve_metrics",
     "span",
+    "warn_once",
 ]

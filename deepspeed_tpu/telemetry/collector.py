@@ -23,6 +23,26 @@ scrapes — every process of a run and merges them into ONE federated view:
                          measured mode from the whole mesh's measurements)
   - ``GET  /healthz``    the collector's own liveness
 
+The incident plane (ISSUE 20) rides the same transport:
+
+  - ``POST /events``     structured-event ingestion ({"identity",
+                         "events": [...]} — the ``telemetry/events.py``
+                         wire form; also accepted inline on ``/push``).
+                         Events APPEND (a bounded fleet-wide ring with a
+                         per-process seq guard against re-push duplicates)
+                         — unlike registry dumps, which replace.
+  - ``GET  /events``     the fleet event ring, filterable by
+                         ``?proc=&severity=&subsystem=&since=&limit=``
+  - ``GET  /incidents``  cross-process correlation: warn+ events grouped
+                         into incidents by (run_id, trailing time window,
+                         shared TraceContext flow/request id, or an
+                         explicit ``incident_key`` label — the causal-chain
+                         join a detector stamps on cause AND effect), with
+                         ids stable across repeated reads
+  - ``GET  /console``    one self-contained stdlib HTML ops page: health
+                         ledger, firing alerts, recent incidents, SLO
+                         rollups, perf-ledger sparklines
+
 Merging happens at READ time from the latest dump per process: pushes carry
 cumulative process-local snapshots, so the collector must replace a
 process's previous contribution, never add to it — re-merging from the
@@ -42,14 +62,119 @@ same merge path as push, for fleets where workers can't reach out.
 
 from __future__ import annotations
 
+import hashlib
+import html
 import json
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.telemetry import fleet
-from deepspeed_tpu.telemetry.registry import MetricsRegistry
+from deepspeed_tpu.telemetry.events import severity_rank
+from deepspeed_tpu.telemetry.registry import MetricsRegistry, decode_key
 from deepspeed_tpu.utils.logging import logger
+
+
+# ------------------------------------------------------ incident correlation
+def _event_run_id(ev: Dict[str, Any]) -> str:
+    return str((ev.get("identity") or {}).get("run_id", "?"))
+
+
+def _event_proc(ev: Dict[str, Any]) -> str:
+    ident = ev.get("identity") or {}
+    return f"{ident.get('run_id', '?')}/p{ident.get('process_index', '?')}"
+
+
+def _incident_id(run_id: str, first_ev: Dict[str, Any]) -> str:
+    """Stable across repeated correlations of the same state: derived from
+    the FIRST event's immutable coordinates, never from list position."""
+    basis = (f"{run_id}:{_event_proc(first_ev)}:{first_ev.get('seq', 0)}"
+             f":{first_ev.get('subsystem')}:{first_ev.get('kind')}"
+             f":{first_ev.get('ts')}")
+    return "inc-" + hashlib.sha1(basis.encode()).hexdigest()[:10]
+
+
+def correlate_events(events: List[Dict[str, Any]], window_s: float = 30.0,
+                     min_severity: str = "warn") -> List[Dict[str, Any]]:
+    """Group events (wire dicts) into incidents.
+
+    Join rules, applied over the time-sorted warn+ stream:
+      - same ``run_id`` AND within ``window_s`` of the incident's newest
+        event (the drift -> profiler-capture -> regression causal chain is
+        a cascade inside one window), OR
+      - a shared ``flow_id`` / ``request_id`` (the TraceContext join — a
+        request's failure on the router and its death on the replica are
+        one incident however far apart), OR
+      - a shared ``incident_key`` label (the explicit causal stamp a
+        detector puts on cause and effect).
+    An event bridging several open incidents MERGES them (the id of the
+    earliest survives). Shared by the collector's ``/incidents`` and
+    ``tools/incident_report.py`` — one correlation, two readers.
+    """
+    floor = severity_rank(min_severity)
+    sev = [e for e in events if severity_rank(str(e.get("severity", "info")))
+           >= floor]
+    sev.sort(key=lambda e: (float(e.get("ts", 0.0)), _event_proc(e),
+                            int(e.get("seq", 0))))
+    incidents: List[Dict[str, Any]] = []
+
+    def join_keys(ev: Dict[str, Any]) -> set:
+        keys = set()
+        if ev.get("flow_id") is not None:
+            keys.add(("flow", ev["flow_id"]))
+        if ev.get("request_id") is not None:
+            keys.add(("req", _event_run_id(ev), ev["request_id"]))
+        ik = (ev.get("labels") or {}).get("incident_key")
+        if ik:
+            keys.add(("key", ik))
+        return keys
+
+    for ev in sev:
+        run_id = _event_run_id(ev)
+        ts = float(ev.get("ts", 0.0))
+        keys = join_keys(ev)
+        matched = [
+            inc for inc in incidents
+            if (inc["run_id"] == run_id
+                and ts - inc["end_ts"] <= window_s)
+            or (keys & inc["_keys"])]
+        if not matched:
+            incidents.append({
+                "id": _incident_id(run_id, ev), "run_id": run_id,
+                "start_ts": ts, "end_ts": ts, "events": [ev],
+                "_keys": keys})
+            continue
+        primary = matched[0]
+        for other in matched[1:]:  # bridge: fold later incidents in
+            primary["events"].extend(other["events"])
+            primary["_keys"] |= other["_keys"]
+            primary["start_ts"] = min(primary["start_ts"], other["start_ts"])
+            primary["end_ts"] = max(primary["end_ts"], other["end_ts"])
+            incidents.remove(other)
+        primary["events"].append(ev)
+        primary["_keys"] |= keys
+        primary["start_ts"] = min(primary["start_ts"], ts)
+        primary["end_ts"] = max(primary["end_ts"], ts)
+    out = []
+    for inc in incidents:
+        evs = sorted(inc["events"], key=lambda e: float(e.get("ts", 0.0)))
+        worst = max(evs, key=lambda e: severity_rank(
+            str(e.get("severity", "info"))))
+        out.append({
+            "id": inc["id"], "run_id": inc["run_id"],
+            "start_ts": inc["start_ts"], "end_ts": inc["end_ts"],
+            "duration_s": round(inc["end_ts"] - inc["start_ts"], 3),
+            "severity": worst.get("severity", "warn"),
+            "event_count": sum(int(e.get("count", 1)) for e in evs),
+            "procs": sorted({_event_proc(e) for e in evs}),
+            "subsystems": sorted({str(e.get("subsystem", "")) for e in evs}),
+            "kinds": sorted({f"{e.get('subsystem')}/{e.get('kind')}"
+                             for e in evs}),
+            "events": evs,
+        })
+    out.sort(key=lambda i: i["start_ts"])
+    return out
 
 
 class FleetCollector:
@@ -58,17 +183,29 @@ class FleetCollector:
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  stale_after_s: float = 60.0,
                  straggler_mads: float = 6.0,
-                 table_path: Optional[str] = None):
+                 table_path: Optional[str] = None,
+                 events_capacity: int = 4096,
+                 incident_window_s: float = 30.0,
+                 ledger_root: Optional[str] = None):
         self._host = host
         self._requested_port = port
         self.stale_after_s = float(stale_after_s)
         self.straggler_mads = float(straggler_mads)
         self.table_path = table_path
+        self.incident_window_s = float(incident_window_s)
+        # perf-ledger root for the console sparklines (None = repo default)
+        self.ledger_root = ledger_root
         self._server = None  # exposition.RouteServer, built at start()
         self._lock = threading.Lock()
         # proc key -> {"identity", "dump", "heartbeat", "coll_rows",
-        #              "last_seen", "clock_offset_s", "origin_unix"}
+        #              "last_seen", "clock_offset_s", "origin_unix",
+        #              "events_seq"}
         self._procs: Dict[str, Dict[str, Any]] = {}
+        # fleet-wide event ring: APPEND semantics (each push carries only
+        # events past the sender's cursor; the per-proc seq guard below
+        # makes a re-push of the same tail idempotent)
+        self._events: deque = deque(maxlen=int(events_capacity))
+        self.events_ingested = 0
 
     # ------------------------------------------------------------- ingest
     def ingest(self, doc: Dict[str, Any],
@@ -105,6 +242,26 @@ class FleetCollector:
                 # EMA to identical data on every cadence push — the
                 # cross-process fold happens once per READ (table_rows)
                 entry["coll_rows"] = list(doc["coll_rows"])
+            if doc.get("events"):
+                # APPEND, unlike everything above: events are occurrences,
+                # not cumulative state. The per-proc high-seq guard makes a
+                # retried push (ack lost, client re-sends the same tail)
+                # idempotent.
+                high = int(entry.get("events_seq", 0))
+                for ev in doc["events"]:
+                    if not isinstance(ev, dict):
+                        raise ValueError("events entries must be objects")
+                    seq = int(ev.get("seq", 0))
+                    if seq and seq <= high:
+                        continue
+                    high = max(high, seq)
+                    ev = dict(ev)
+                    ev.setdefault("identity", ident.to_dict())
+                    ev["proc"] = ident.key()
+                    ev["recv_ts"] = now
+                    self._events.append(ev)
+                    self.events_ingested += 1
+                entry["events_seq"] = high
         if doc.get("coll_rows") and self.table_path:
             self.persist_table()
         return {"ok": True, "proc": ident.key(),
@@ -262,6 +419,236 @@ class FleetCollector:
         return {"time_unix": now, "processes": rows,
                 "coll_table_rows": len(self.table_rows())}
 
+    # ------------------------------------------------------------- events
+    def events(self, proc: Optional[str] = None,
+               min_severity: Optional[str] = None,
+               subsystem: Optional[str] = None,
+               since: Optional[float] = None,
+               limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """The fleet event ring, filtered. ``proc`` matches either the full
+        ``run_id/pN`` key or the short ``pN``; ``since`` is a unix ts over
+        the event's own ``ts``."""
+        with self._lock:
+            out = list(self._events)
+        if proc:
+            out = [e for e in out
+                   if e.get("proc") == proc
+                   or str(e.get("proc", "")).endswith("/" + proc)]
+        if min_severity:
+            floor = severity_rank(min_severity)
+            out = [e for e in out
+                   if severity_rank(str(e.get("severity", "info"))) >= floor]
+        if subsystem:
+            out = [e for e in out if e.get("subsystem") == subsystem]
+        if since is not None:
+            out = [e for e in out if float(e.get("ts", 0.0)) >= float(since)]
+        if limit is not None and limit >= 0:
+            out = out[-int(limit):]
+        return out
+
+    def incidents(self, window_s: Optional[float] = None,
+                  min_severity: str = "warn") -> List[Dict[str, Any]]:
+        """Cross-process incident correlation over the event ring (see
+        :func:`correlate_events`) — recomputed per read from the same
+        state, so ids are stable across repeated GETs."""
+        with self._lock:
+            evs = list(self._events)
+        return correlate_events(
+            evs, window_s=self.incident_window_s if window_s is None
+            else float(window_s), min_severity=min_severity)
+
+    def _events_doc(self, query: Dict[str, str]) -> bytes:
+        since = query.get("since")
+        limit = query.get("limit")
+        evs = self.events(
+            proc=query.get("proc") or None,
+            min_severity=query.get("severity") or None,
+            subsystem=query.get("subsystem") or None,
+            since=float(since) if since else None,
+            limit=int(limit) if limit else None)
+        return json.dumps({"time_unix": time.time(), "count": len(evs),
+                           "events": evs}).encode()
+
+    def _incidents_doc(self, query: Dict[str, str]) -> bytes:
+        window = query.get("window_s")
+        incs = self.incidents(
+            window_s=float(window) if window else None,
+            min_severity=query.get("severity") or "warn")
+        return json.dumps({"time_unix": time.time(), "count": len(incs),
+                           "incidents": incs}).encode()
+
+    # ------------------------------------------------------------- console
+    def _ledger_sparklines(self, width: int = 160, height: int = 28,
+                           max_series: int = 8) -> List[Dict[str, str]]:
+        """Inline-SVG sparklines of the perf ledger's headline series —
+        best-effort: no ledger on disk renders as no section, never an
+        error page."""
+        try:
+            from deepspeed_tpu.telemetry.perfgate import is_headline, GateConfig
+            from deepspeed_tpu.telemetry.perfledger import PerfLedger, row_key
+
+            ledger = PerfLedger(self.ledger_root)
+            cfg = GateConfig()
+            series: Dict[tuple, List[tuple]] = {}
+            for row in ledger.rows():
+                if not is_headline(row, cfg):
+                    continue
+                series.setdefault(row_key(row), []).append(
+                    (int(row["round"]), float(row["value"])))
+        except Exception:  # noqa: BLE001 - console stays up without a ledger
+            return []
+        out = []
+        for key in sorted(series)[:max_series]:
+            pts = sorted(series[key])
+            vals = [v for _r, v in pts]
+            if len(vals) < 2:
+                continue
+            lo, hi = min(vals), max(vals)
+            span = (hi - lo) or 1.0
+            step = width / max(len(vals) - 1, 1)
+            poly = " ".join(
+                f"{i * step:.1f},{height - 3 - (v - lo) / span * (height - 6):.1f}"
+                for i, v in enumerate(vals))
+            svg = (f'<svg width="{width}" height="{height}">'
+                   f'<polyline fill="none" stroke="#2b7" stroke-width="1.5" '
+                   f'points="{poly}"/></svg>')
+            out.append({"label": "/".join(key), "svg": svg,
+                        "last": f"{vals[-1]:.6g}", "n": str(len(vals))})
+        return out
+
+    def _console_html(self) -> bytes:
+        """GET /console: ONE self-contained page (inline CSS, inline SVG,
+        zero external assets — it must render from a curl dump during the
+        exact outage it exists for)."""
+        esc = html.escape
+        now = time.time()
+        led = self.ledger()
+        incidents = self.incidents()
+        recent = self.events(limit=30)
+        reg = self.federated_registry()
+        gauges = reg.gauges()
+        firing = []
+        for key, val in sorted(gauges.items()):
+            base, labels = decode_key(key)
+            if base == "alerts/firing" and val > 0:
+                firing.append((labels.get("rule", "?"), int(val)))
+        slo = {k: v for k, v in sorted(gauges.items())
+               if decode_key(k)[0] in (
+                   "fleet/goodput", "fleet/tokens_per_s",
+                   "fleet/step_rate_min", "fleet/processes",
+                   "fleet/role_processes")}
+        sev_color = {"info": "#8aa", "warn": "#c80", "critical": "#c22"}
+
+        def ts_fmt(ts):
+            try:
+                return time.strftime("%H:%M:%S", time.localtime(float(ts)))
+            except Exception:  # noqa: BLE001
+                return "?"
+
+        parts = [
+            "<!doctype html><html><head><meta charset='utf-8'>",
+            "<title>deepspeed_tpu fleet console</title><style>",
+            "body{font:13px/1.4 monospace;margin:1.2em;background:#fafafa;"
+            "color:#123}",
+            "h1{font-size:17px}h2{font-size:14px;margin:1.2em 0 .3em;"
+            "border-bottom:1px solid #ccc}",
+            "table{border-collapse:collapse}td,th{padding:2px 9px;"
+            "border:1px solid #ddd;text-align:left}",
+            ".ok{color:#2b7}.bad{color:#c22;font-weight:bold}"
+            ".warn{color:#c80}</style></head><body>",
+            f"<h1>fleet console</h1><p>{len(led['processes'])} processes · "
+            f"{len(firing)} firing alert(s) · {len(incidents)} incident(s) · "
+            f"rendered {ts_fmt(now)}</p>",
+        ]
+        # firing alerts
+        parts.append("<h2>firing alerts</h2>")
+        if firing:
+            parts.append("<table><tr><th>rule</th><th>instances</th></tr>")
+            for rule, n in firing:
+                parts.append(f"<tr><td class='bad'>{esc(rule)}</td>"
+                             f"<td>{n}</td></tr>")
+            parts.append("</table>")
+        else:
+            parts.append("<p class='ok'>none firing</p>")
+        # incidents
+        parts.append("<h2>recent incidents</h2>")
+        if incidents:
+            parts.append("<table><tr><th>id</th><th>severity</th><th>start"
+                         "</th><th>dur</th><th>procs</th><th>kinds</th>"
+                         "<th>events</th></tr>")
+            for inc in incidents[-10:][::-1]:
+                cls = "bad" if inc["severity"] == "critical" else "warn"
+                parts.append(
+                    f"<tr><td>{esc(inc['id'])}</td>"
+                    f"<td class='{cls}'>{esc(inc['severity'])}</td>"
+                    f"<td>{ts_fmt(inc['start_ts'])}</td>"
+                    f"<td>{inc['duration_s']:.1f}s</td>"
+                    f"<td>{esc(', '.join(inc['procs']))}</td>"
+                    f"<td>{esc(', '.join(inc['kinds']))}</td>"
+                    f"<td>{inc['event_count']}</td></tr>")
+            parts.append("</table>")
+        else:
+            parts.append("<p class='ok'>no incidents</p>")
+        # health ledger
+        parts.append("<h2>health ledger</h2><table><tr><th>proc</th>"
+                     "<th>role</th><th>age</th><th>step</th><th>rate</th>"
+                     "<th>straggler</th><th>stale</th></tr>")
+        for row in led["processes"]:
+            hb = row.get("heartbeat") or {}
+            stale = ("<td class='bad'>STALE</td>" if row["stale"]
+                     else "<td class='ok'>ok</td>")
+            strag = ("<td class='warn'>straggler</td>" if row["straggler"]
+                     else "<td class='ok'>ok</td>")
+            parts.append(
+                f"<tr><td>{esc(str(row['proc']))}</td>"
+                f"<td>{esc(str(row['identity'].get('role', '?')))}</td>"
+                f"<td>{row['last_seen_age_s']:.1f}s</td>"
+                f"<td>{esc(str(hb.get('step', '—')))}</td>"
+                f"<td>{esc(str(hb.get('step_rate', '—')))}</td>"
+                f"{strag}{stale}</tr>")
+        parts.append("</table>")
+        # SLO rollups
+        parts.append("<h2>SLO rollups</h2>")
+        if slo:
+            parts.append("<table><tr><th>metric</th><th>value</th></tr>")
+            for k, v in slo.items():
+                parts.append(f"<tr><td>{esc(k)}</td><td>{v:.6g}</td></tr>")
+            parts.append("</table>")
+        else:
+            parts.append("<p>no rollups yet</p>")
+        # perf sparklines
+        sparks = self._ledger_sparklines()
+        if sparks:
+            parts.append("<h2>perf ledger (headline trajectories)</h2>"
+                         "<table><tr><th>series</th><th>trend</th>"
+                         "<th>last</th><th>rounds</th></tr>")
+            for s in sparks:
+                parts.append(f"<tr><td>{esc(s['label'])}</td><td>{s['svg']}"
+                             f"</td><td>{esc(s['last'])}</td>"
+                             f"<td>{esc(s['n'])}</td></tr>")
+            parts.append("</table>")
+        # recent events
+        parts.append("<h2>recent events</h2>")
+        if recent:
+            parts.append("<table><tr><th>ts</th><th>proc</th><th>sev</th>"
+                         "<th>event</th><th>message</th><th>n</th></tr>")
+            for ev in recent[::-1]:
+                color = sev_color.get(str(ev.get("severity")), "#123")
+                parts.append(
+                    f"<tr><td>{ts_fmt(ev.get('ts'))}</td>"
+                    f"<td>{esc(str(ev.get('proc', '?')))}</td>"
+                    f"<td style='color:{color}'>"
+                    f"{esc(str(ev.get('severity')))}</td>"
+                    f"<td>{esc(str(ev.get('subsystem')))}/"
+                    f"{esc(str(ev.get('kind')))}</td>"
+                    f"<td>{esc(str(ev.get('message', ''))[:140])}</td>"
+                    f"<td>{int(ev.get('count', 1))}</td></tr>")
+            parts.append("</table>")
+        else:
+            parts.append("<p class='ok'>no events</p>")
+        parts.append("</body></html>")
+        return "".join(parts).encode()
+
     # -------------------------------------------------------------- serve
     def _coll_table_doc(self) -> bytes:
         from deepspeed_tpu.collectives.table import SCHEMA_VERSION
@@ -292,11 +679,21 @@ class FleetCollector:
                         json.dumps(self.ledger()).encode(), js),
                     "/coll_table": lambda: (self._coll_table_doc(), js),
                     "/healthz": lambda: (self._healthz_doc(), js),
+                    # incident plane (ISSUE 20): query-taking handlers get
+                    # the parsed query dict from RouteServer
+                    "/events": lambda query: (self._events_doc(query), js),
+                    "/incidents": lambda query: (
+                        self._incidents_doc(query), js),
+                    "/console": lambda: (
+                        self._console_html(),
+                        "text/html; charset=utf-8"),
                 },
-                # register/push/heartbeat all share the ingest shape — the
-                # paths differ only in what the sender chose to include
+                # register/push/heartbeat/events all share the ingest shape
+                # — the paths differ only in what the sender chose to
+                # include
                 post_routes={p: self.ingest
-                             for p in ("/register", "/push", "/heartbeat")},
+                             for p in ("/register", "/push", "/heartbeat",
+                                       "/events")},
                 port=self._requested_port, host=self._host,
                 name="dstpu-fleet-collector")
         self._server.start()
@@ -347,6 +744,10 @@ class FleetClient:
         self._pending_lock = threading.Lock()
         self._pending_event = threading.Event()
         self._worker: Optional[threading.Thread] = None
+        # event-stream push cursor: advanced only on an ACKED push, so a
+        # failed push's events ride the next one (the collector's per-proc
+        # seq guard dedups the overlap if the ack was merely lost)
+        self._events_sent_seq = 0
 
     def _identity_dict(self) -> Dict[str, Any]:
         ident = self._identity or fleet.get_identity()
@@ -426,6 +827,14 @@ class FleetClient:
             doc["registry"] = fleet.registry_dump(
                 registry=self._registry,
                 identity=self._identity or fleet.get_identity())
+        # structured events (ISSUE 20): ship the tail past the acked cursor
+        from deepspeed_tpu.telemetry.events import get_event_stream
+
+        stream = get_event_stream()
+        tail = stream.drain_since(self._events_sent_seq)
+        if tail:
+            doc["events"] = tail
+            doc["events_high_seq"] = tail[-1]["seq"]
         if coll_rows is not None:
             doc["coll_rows"] = list(coll_rows)
         elif include_table:
@@ -480,6 +889,9 @@ class FleetClient:
             self.pushes += 1
             if ack.get("clock_offset_s") is not None:
                 self.clock_offset_s = float(ack["clock_offset_s"])
+            high = doc.get("events_high_seq")
+            if high is not None and high > self._events_sent_seq:
+                self._events_sent_seq = int(high)
         return ack
 
     def _ensure_worker(self) -> None:

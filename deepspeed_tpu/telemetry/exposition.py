@@ -180,16 +180,43 @@ def export_json_snapshot(path: Optional[str] = None, registry=None) -> str:
     return _write(path, render_json_snapshot(registry) + "\n")
 
 
+def _takes_query(fn) -> bool:
+    """True when a GET handler declares a positional parameter (the parsed
+    query dict). Inspected once per handler and cached on the function —
+    signature inspection per request would be silly."""
+    cached = getattr(fn, "_dstpu_takes_query", None)
+    if cached is None:
+        import inspect
+
+        try:
+            params = [
+                p for p in inspect.signature(fn).parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+            cached = len(params) >= 1
+        except (TypeError, ValueError):
+            cached = False
+        try:
+            fn._dstpu_takes_query = cached
+        except AttributeError:  # bound methods/partials: recomputed per call
+            pass
+    return cached
+
+
 class RouteServer:
     """Tiny stdlib HTTP server over a route table — THE one
     daemon-thread/bind/handler implementation behind :class:`MetricsServer`
     and the fleet :class:`~deepspeed_tpu.telemetry.collector.FleetCollector`.
 
-    ``get_routes`` maps a path to ``fn() -> (body_bytes, content_type)``;
+    ``get_routes`` maps a path to ``fn() -> (body_bytes, content_type)``,
+    or — when the handler declares a positional parameter — to
+    ``fn(query) -> (body_bytes, content_type)`` with the parsed query
+    string as a flat ``{key: last_value}`` dict (the ``/events`` filters);
     ``post_routes`` maps a path to ``fn(doc) -> ack_dict`` (body parsed as
     JSON, ack serialized back; ``ValueError``/``KeyError`` from the handler
-    answer 400). ``port=0`` binds a free port (``.port`` holds the real
-    one). Handlers run per request, so every response reflects live state.
+    answer 400 — GET handlers get the same guard, so a malformed filter
+    answers 400 too). ``port=0`` binds a free port (``.port`` holds the
+    real one). Handlers run per request, so every response reflects live
+    state.
     """
 
     def __init__(self, get_routes, post_routes=None, port: int = 0,
@@ -219,11 +246,25 @@ class RouteServer:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802 - stdlib handler contract
-                fn = get_routes.get(self.path.split("?")[0])
+                path, _, qs = self.path.partition("?")
+                fn = get_routes.get(path)
                 if fn is None:
                     self.send_error(404)
                     return
-                body, ctype = fn()
+                try:
+                    if _takes_query(fn):
+                        import urllib.parse
+
+                        query = {k: v[-1] for k, v in
+                                 urllib.parse.parse_qs(qs).items()}
+                        body, ctype = fn(query)
+                    else:
+                        body, ctype = fn()
+                except (ValueError, KeyError, TypeError, AttributeError) as e:
+                    self._send(400, json.dumps(
+                        {"ok": False, "error": str(e)}).encode(),
+                        "application/json")
+                    return
                 self._send(200, body, ctype)
 
             def do_POST(self):  # noqa: N802 - stdlib handler contract

@@ -385,8 +385,10 @@ class NumericsObservatory:
     def __init__(self):
         self.config = NumericsConfig()
         self._lock = threading.Lock()
-        self._warn_lock = threading.Lock()
-        self._warned: set = set()
+        from deepspeed_tpu.telemetry.events import WarnOnceSet
+
+        self._warn_once_set = WarnOnceSet(subsystem="numerics",
+                                          default_kind="fidelity_warning")
         self._routes: Dict[Tuple, WireRoute] = {}
         self._probe_cache: Dict[Tuple, Callable] = {}
         self.profiler_arm: Optional[Callable[..., None]] = None
@@ -407,7 +409,7 @@ class NumericsObservatory:
             self.config = cfg
             self._routes.clear()
             self._probe_cache.clear()
-            self._warned = set()
+            self._warn_once_set.reset()
             self.wire_drift_events = 0
             self.divergence_events_seen = 0
             self.spec_accept_alarm = TrendAlarm(
@@ -422,15 +424,12 @@ class NumericsObservatory:
             self.profiler_arm = profiler_arm
 
     def warn_once(self, key: str, msg: str) -> bool:
-        """Log ``msg`` once per ``key`` per configure() epoch. Active even
-        when the observatory is disabled (the forced-lossy-codec warning
-        must fire regardless of whether anyone is measuring)."""
-        with self._warn_lock:
-            if key in self._warned:
-                return False
-            self._warned.add(key)
-        logger.warning(msg)
-        return True
+        """Log ``msg`` once per ``key`` per configure() epoch (shared
+        warn-once helper: the first occurrence also lands on the typed
+        event stream). Active even when the observatory is disabled (the
+        forced-lossy-codec warning must fire regardless of whether anyone
+        is measuring)."""
+        return self._warn_once_set(key, msg, log=logger)
 
     # ------------------------------------------------- trace-time registry
     def note_route(self, op: str, algorithm: str, codec: str, nbytes: int,
@@ -534,12 +533,16 @@ class NumericsObservatory:
                 self.wire_drift_events += 1
                 reg.counter("numerics/wire_drift_events", op=route.op,
                             codec=route.codec).add(1)
-                self.warn_once(
+                self._warn_once_set(
                     f"drift:{route.op}/{route.codec}",
                     f"numerics drift: {route.op}/{route.codec} wire rel err "
                     f"{rel:.3e} exceeds {self.config.drift_ratio:g}x the "
                     f"pinned bound {bound:.3e} "
-                    f"(algorithm={route.algorithm})")
+                    f"(algorithm={route.algorithm})",
+                    kind="wire_drift",
+                    labels={"op": route.op, "codec": route.codec,
+                            "algorithm": route.algorithm},
+                    log=logger)
                 if self.profiler_arm is not None:
                     try:
                         self.profiler_arm(
@@ -602,10 +605,16 @@ class NumericsObservatory:
                 float(int(checksum) & 0xFFFFFFFF))
         if new > 0:
             reg.counter("numerics/divergence_events").add(new)
-            logger.warning(
-                f"NUMERICS DIVERGENCE: cross-replica digest mismatch at "
-                f"step {step} ({new} new event(s), {events_cum} total) — "
-                f"dp/fsdp replicas no longer hold identical parameters")
+            msg = (f"NUMERICS DIVERGENCE: cross-replica digest mismatch at "
+                   f"step {step} ({new} new event(s), {events_cum} total) — "
+                   f"dp/fsdp replicas no longer hold identical parameters")
+            logger.warning(msg)
+            from deepspeed_tpu.telemetry.events import emit_event
+
+            emit_event("numerics", "divergence", msg, severity="critical",
+                       labels={"new_events": new, "total": events_cum},
+                       step=step,
+                       dedup_key="numerics:divergence")
             if self.profiler_arm is not None:
                 try:
                     self.profiler_arm(reason=f"numerics_divergence:{step}")

@@ -259,10 +259,21 @@ def publish(report: GateReport, registry=None, arm: bool = True,
         if v.mode != "info" or v.status == "no_history":
             registry.gauge("perf/trajectory", suite=suite, metric=metric,
                            backend=backend).set(float(v.row["value"]))
+    from deepspeed_tpu.telemetry.events import emit_event
+
     for v in report.regressions:
         backend, suite, metric = v.key
         registry.counter("perf/regression_events", suite=suite,
                          metric=metric, backend=backend).add(1)
+        emit_event(
+            "perf", "regression",
+            f"perf gate regression {'/'.join(v.key)}: "
+            f"{float(v.row['value']):.6g} ({v.detail})",
+            severity="warn",
+            labels={"suite": suite, "metric": metric, "backend": backend,
+                    "mode": v.mode,
+                    "incident_key": "perf_gate:" + "/".join(v.key)},
+            dedup_key="perf:regression:" + "/".join(v.key))
     if report.regressions and arm:
         from deepspeed_tpu.profiling.capture import arm_all
 

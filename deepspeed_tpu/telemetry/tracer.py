@@ -401,6 +401,12 @@ class Tracer:
             from deepspeed_tpu.telemetry import exposition
 
             exposition.export_prometheus(self.prometheus_path, registry=self.registry)
+        # the structured event stream (ISSUE 20) flushes next to the trace
+        # stream when IT has a path configured — same flush cadence, one
+        # artifact directory for the incident-report join
+        from deepspeed_tpu.telemetry import events as events_mod
+
+        events_mod.get_event_stream().maybe_export()
 
 
 def env_enabled() -> bool:

@@ -61,9 +61,11 @@ def print_rank_0(message: str) -> None:
 
 
 def warning_once(message: str) -> None:
-    _warn_once(message)
+    """Warn once per distinct message — delegates to the shared warn-once
+    helper (``telemetry/events.py``), which logs the line AND emits a typed
+    ``logging/warning_once`` event, so warn-once coverage and event
+    coverage cannot drift apart (ISSUE 20). Lazy import: this module is at
+    the bottom of the import graph; telemetry imports it, not vice versa."""
+    from deepspeed_tpu.telemetry.events import warn_once
 
-
-@functools.lru_cache(None)
-def _warn_once(message: str) -> None:
-    logger.warning(message)
+    warn_once(message)

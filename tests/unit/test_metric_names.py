@@ -22,8 +22,10 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__fi
 
 # one place to extend when a PR adds a legitimate new subsystem
 ALLOWED_SUBSYSTEMS = {
+    "alerts",
     "anomaly",
     "ckpt",
+    "events",
     "coll",
     "comm",
     "compile",
@@ -123,7 +125,10 @@ def test_lint_scans_telemetry_and_serving_sources():
                   "perfgate.py",
                   # numerics observatory (ISSUE 17): wire/serving fidelity
                   # + divergence series
-                  "numerics.py")
+                  "numerics.py",
+                  # incident plane (ISSUE 20): the event stream mints the
+                  # events/* series, the alert engine the alerts/* series
+                  "events.py", "alerts.py")
     } | {
         # step-time attribution gauges (ISSUE 16)
         os.path.join("deepspeed_tpu", "profiling", "attribution.py"),
@@ -142,8 +147,10 @@ def test_lint_scans_telemetry_and_serving_sources():
         # schedule compiler (ISSUE 19): compile_schedule mints the
         # coll/schedule_* search census
         os.path.join("deepspeed_tpu", "collectives", "schedule.py"),
-    } | {os.path.join("tools", "bench_serving.py"),
+    } | {os.path.join("tools", "alerts_smoke.py"),
+         os.path.join("tools", "bench_serving.py"),
          os.path.join("tools", "fabric_smoke.py"),
+         os.path.join("tools", "incident_report.py"),
          os.path.join("tools", "fleet_smoke.py"),
          os.path.join("tools", "numerics_smoke.py"),
          os.path.join("tools", "schedule_smoke.py"),
@@ -197,7 +204,16 @@ def test_known_names_pass_and_bad_names_fail():
                  # schedule compiler (ISSUE 19): per-compile search census
                  # next to the observatory's coll/* calibration family
                  "coll/schedule_compiles", "coll/schedule_candidates",
-                 "coll/schedule_pred_us", "coll/schedule_levels"):
+                 "coll/schedule_pred_us", "coll/schedule_levels",
+                 # incident plane (ISSUE 20): event-stream accounting, alert
+                 # engine state, and the per-endpoint fabric RPC series
+                 "events/emitted", "events/deduped", "events/buffered",
+                 "events/subscriber_failures",
+                 "alerts/firing", "alerts/fired", "alerts/resolved",
+                 "alerts/suppressed", "alerts/evaluations",
+                 "alerts/rule_errors", "alerts/sink_failures",
+                 "fabric/rpc_failures", "fabric/rpc_server_ms",
+                 "fabric/rpc_server_failures"):
         assert _check_name(good) is None, good
     for bad in ("ttft", "Serving/ttft", "serving ttft", "{x}/y", "bogus/name"):
         assert _check_name(bad) is not None, bad

@@ -195,6 +195,26 @@ numerics_rc=${PIPESTATUS[0]}
 [ "${numerics_rc}" -ne 0 ] && rc=1
 echo "# numerics smoke: ${NUMERICS_OUT} (exit ${numerics_rc})" >> "${OUT}"
 
+# Incident-plane smoke (ISSUE 20), exit-gated BOTH ways: a clean 20-step
+# run with the default alert rule pack and a live collector must stay ALL
+# quiet (zero warn+ events, zero firing alerts, zero incidents), and the
+# injected double fault (flip_param_bit + SIGKILLed replica daemon) must
+# correlate into exactly ONE incident naming both typed events at
+# GET /incidents, fire the matching alerts, and render through
+# tools/incident_report.py.
+ALERTS_OUT="ALERTS_${ROUND}.log"
+{
+  echo "# incident-plane smoke — $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+  echo "# HEAD: ${HEAD_SHA}"
+  echo "# uncommitted-diff sha256: ${DIFF_SHA}"
+  echo "# cmd: python tools/alerts_smoke.py"
+} > "${ALERTS_OUT}"
+JAX_PLATFORMS=cpu python tools/alerts_smoke.py 2>/dev/null \
+  | tee -a "${ALERTS_OUT}"
+alerts_rc=${PIPESTATUS[0]}
+[ "${alerts_rc}" -ne 0 ] && rc=1
+echo "# alerts smoke: ${ALERTS_OUT} (exit ${alerts_rc})" >> "${OUT}"
+
 # Collective schedule compiler + fused GEMM smoke (ISSUE 19), exit-gated:
 # synthesized hop programs must execute bit-identically to jax.lax on the
 # CPU mesh (1D ring AND a (4,2) sub-ring factorization), the compiled
@@ -288,8 +308,8 @@ echo "# perf gate exit: ${perfgate_rc}" >> "${PERFGATE_OUT}"
 echo "# perf gate: ${PERFGATE_OUT} (exit ${perfgate_rc})" >> "${OUT}"
 
 {
-  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, disagg smoke: ${disagg_rc}, moe smoke: ${moe_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc}, numerics smoke: ${numerics_rc}, fabric smoke: ${fabric_rc}, perf gate: ${perfgate_rc})"
+  echo "# exit code: ${rc} (fault smoke: ${smoke_rc}, pallas smoke: ${pallas_rc}, quant-serving smoke: ${quant_rc}, router smoke: ${router_rc}, disagg smoke: ${disagg_rc}, moe smoke: ${moe_rc}, program report: ${prog_rc}, coll report: ${coll_rc}, fleet smoke: ${fleet_rc}, numerics smoke: ${numerics_rc}, alerts smoke: ${alerts_rc}, fabric smoke: ${fabric_rc}, perf gate: ${perfgate_rc})"
   echo "# census: $(grep -aE '^[0-9]+ (passed|failed)' "${OUT}" | tail -1)"
 } >> "${OUT}"
-echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT} ${DISAGG_OUT} ${MOE_OUT} ${NUMERICS_OUT} ${FABRIC_OUT} ${PERFGATE_OUT}"
+echo "wrote ${OUT} ${PROG_OUT} ${COLL_OUT} ${FLEET_OUT} ${DISAGG_OUT} ${MOE_OUT} ${NUMERICS_OUT} ${ALERTS_OUT} ${FABRIC_OUT} ${PERFGATE_OUT}"
 exit "${rc}"
